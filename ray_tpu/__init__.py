@@ -1,0 +1,80 @@
+"""ray_tpu — a TPU-native distributed task/actor runtime.
+
+A ground-up re-design of the capabilities of Ray (reference:
+klwuibm/ray @ 2.0.0.dev0) for TPU clusters: task/actor programming model
+with an ownership-based distributed object store, per-node schedulers with a
+batched TPU bin-packing backend, a GCS-style control plane, placement
+groups, an autoscaler, collectives over XLA/ICI, and ML libraries built
+purely on this public API.
+
+Public surface parity: ``python/ray/__init__.py`` of the reference.
+"""
+
+from ray_tpu import exceptions  # noqa: F401
+from ray_tpu._private.ids import (  # noqa: F401
+    ActorID, JobID, NodeID, ObjectID, PlacementGroupID, TaskID, WorkerID)
+from ray_tpu._private.object_ref import ObjectRef  # noqa: F401
+from ray_tpu._private.worker import (  # noqa: F401
+    available_resources, cancel, cluster_resources, get, get_actor,
+    get_gpu_ids, get_tpu_ids, init, is_initialized, kill, nodes, put,
+    shutdown, timeline, wait)
+from ray_tpu.runtime_context import get_runtime_context  # noqa: F401
+
+__version__ = "0.1.0"
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "kill", "cancel", "get_actor", "nodes", "cluster_resources",
+    "available_resources", "get_runtime_context", "get_gpu_ids",
+    "get_tpu_ids", "timeline", "ObjectRef", "method", "exceptions",
+    "cross_language",
+]
+
+
+def remote(*args, **kwargs):
+    """The ``@remote`` decorator (reference worker.py:2221).
+
+    Bare form::
+
+        @ray_tpu.remote
+        def f(x): ...
+
+        @ray_tpu.remote
+        class A: ...
+
+    With options::
+
+        @ray_tpu.remote(num_cpus=2, num_tpus=1, max_retries=3)
+        def f(x): ...
+    """
+    import inspect
+
+    from ray_tpu.actor import make_actor_class
+    from ray_tpu.remote_function import RemoteFunction
+
+    def make(target, options):
+        if inspect.isclass(target):
+            return make_actor_class(target, options)
+        if not callable(target):
+            raise TypeError("@remote target must be a function or class")
+        return RemoteFunction(target, options)
+
+    if len(args) == 1 and not kwargs and (callable(args[0])):
+        return make(args[0], {})
+    if args:
+        raise TypeError("@remote takes keyword options only, e.g. "
+                        "@remote(num_cpus=2)")
+    return lambda target: make(target, kwargs)
+
+
+def method(num_returns: int = 1, **_):
+    """Per-method options decorator (reference ray.method)."""
+
+    def decorator(m):
+        m.__ray_num_returns__ = num_returns
+        return m
+
+    return decorator
+
+
+# Convenience namespaces mirroring `ray.util` imports.
+from ray_tpu import util  # noqa: E402,F401

@@ -1,0 +1,192 @@
+"""Cluster-level scheduling queues + the scheduling tick.
+
+Parity: reference ``src/ray/raylet/scheduling/cluster_task_manager.cc`` —
+per-``SchedulingClass`` FIFO queues (:44-123), the periodic
+``ScheduleAndDispatchTasks`` tick (also run on every state change,
+node_manager.cc:392-394), spillback via ``ScheduleOnNode`` (:285-323),
+infeasible queues parked and retried on cluster change (:125-159).
+
+This is the north-star surface (SURVEY.md §3.4): each tick the queues are a
+``demand[C, R]`` matrix and the local view an ``avail[N, R]`` matrix.  With
+``scheduler_backend=native`` each task is placed by the greedy policy; with
+``scheduler_backend=jax`` whole queues are solved in one batched TPU call
+(ray_tpu.scheduler.jax_backend) and the per-task grant/spill decisions are
+validated against exact fixed-point vectors before commit — stale-view
+tolerant, exactly like spillback.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict, deque
+from typing import Callable, Dict, Tuple
+
+from ray_tpu._private.config import get_config
+from ray_tpu._private.task_spec import TaskSpec
+from ray_tpu.scheduler import policy as policy_mod
+
+
+class ClusterTaskManager:
+    def __init__(self, raylet):
+        self._raylet = raylet
+        self._lock = threading.RLock()
+        self._queues: Dict[int, deque] = defaultdict(deque)
+        self._infeasible: Dict[int, deque] = defaultdict(deque)
+        self._view_version = -1
+        self._jax_solver = None
+
+    # ---- entry (HandleRequestWorkerLease -> QueueAndScheduleTask) -------
+    def queue_and_schedule(self, spec: TaskSpec, reply: Callable):
+        with self._lock:
+            self._queues[spec.scheduling_class].append((spec, reply))
+        self._raylet.loop.post(self.schedule_and_dispatch, "cluster.schedule")
+
+    def requeue_for_spill(self, spec: TaskSpec, reply: Callable):
+        """A locally-queued task whose resources vanished (e.g. PG removed)
+        goes back through cluster scheduling."""
+        with self._lock:
+            self._queues[spec.scheduling_class].appendleft((spec, reply))
+        self._raylet.loop.post(self.schedule_and_dispatch, "cluster.schedule")
+
+    def on_resources_freed(self):
+        self._raylet.loop.post(self.schedule_and_dispatch, "cluster.schedule")
+
+    def on_cluster_changed(self):
+        """Retry infeasible queues when nodes/resources change (:125-159)."""
+        with self._lock:
+            for cls, q in self._infeasible.items():
+                self._queues[cls].extend(q)
+                q.clear()
+        self._raylet.loop.post(self.schedule_and_dispatch, "cluster.schedule")
+
+    # ---- the tick -------------------------------------------------------
+    def schedule_and_dispatch(self):
+        cfg = get_config()
+        if cfg.scheduler_backend == "jax" and self._total_queued() > 1:
+            if self._schedule_batched():
+                return
+        self._schedule_greedy()
+
+    def _total_queued(self) -> int:
+        with self._lock:
+            return sum(len(q) for q in self._queues.values())
+
+    def _schedule_greedy(self):
+        """Reference-parity greedy loop: per class, per task, pick the best
+        node, dispatch locally or spill back."""
+        view = self._raylet.cluster_view
+        local_id = self._raylet.node_id
+        while True:
+            progress = False
+            with self._lock:
+                classes = [c for c, q in self._queues.items() if q]
+            for cls in classes:
+                while True:
+                    with self._lock:
+                        q = self._queues[cls]
+                        if not q:
+                            break
+                        spec, reply = q[0]
+                    target = policy_mod.schedule(
+                        view, spec.resources, spec.scheduling_options,
+                        local_node_id=local_id)
+                    if target is None:
+                        with self._lock:
+                            if self._queues[cls] and \
+                                    self._queues[cls][0][0] is spec:
+                                self._queues[cls].popleft()
+                                self._infeasible[cls].append((spec, reply))
+                        progress = True
+                        continue
+                    if target == local_id:
+                        # Reserve local resources at decision time (the
+                        # view's local row IS the authoritative
+                        # NodeResources), then hand to the local dispatch
+                        # path; released when the worker lease returns.
+                        if not view.subtract(local_id, spec.resources):
+                            # Feasible but not currently available: leave
+                            # queued; freed resources re-run the tick.
+                            break
+                        with self._lock:
+                            if not (self._queues[cls] and
+                                    self._queues[cls][0][0] is spec):
+                                view.add_back(local_id, spec.resources)
+                                continue
+                            self._queues[cls].popleft()
+                        self._raylet.local_task_manager.queue_and_schedule(
+                            spec, reply)
+                        progress = True
+                    else:
+                        if not view.subtract(target, spec.resources):
+                            # Stale view: couldn't commit; park and retry.
+                            break
+                        with self._lock:
+                            if not (self._queues[cls] and
+                                    self._queues[cls][0][0] is spec):
+                                view.add_back(target, spec.resources)
+                                continue
+                            self._queues[cls].popleft()
+                        # Spillback (ScheduleOnNode :285): tell the lessee
+                        # to retry at the chosen raylet.  The dirty
+                        # subtract above stops this tick from spilling
+                        # everything to the same node; the broadcast
+                        # corrects it.
+                        reply({"retry_at": target})
+                        progress = True
+            if not progress:
+                return
+
+    def _schedule_batched(self) -> bool:
+        """Solve all queues in one TPU call (scheduler_backend=jax)."""
+        from ray_tpu.scheduler import jax_backend
+        if self._jax_solver is None:
+            self._jax_solver = jax_backend.BatchSolver()
+        view = self._raylet.cluster_view
+        with self._lock:
+            work: list = []
+            for cls, q in self._queues.items():
+                work.extend(q)
+                q.clear()
+        if not work:
+            return True
+        assignments = self._jax_solver.assign(
+            view, [spec for spec, _ in work])
+        local_id = self._raylet.node_id
+        for (spec, reply), target in zip(work, assignments):
+            if target is None:
+                with self._lock:
+                    self._infeasible[spec.scheduling_class].append(
+                        (spec, reply))
+            elif target == local_id:
+                if not view.subtract(local_id, spec.resources):
+                    with self._lock:
+                        self._queues[spec.scheduling_class].append(
+                            (spec, reply))
+                    continue
+                self._raylet.local_task_manager.queue_and_schedule(spec, reply)
+            else:
+                # Validate against the exact vectors before committing the
+                # spill (kernel output validated by IsSchedulable,
+                # SURVEY.md §7.4).
+                node = view.node_resources(target)
+                if node is not None and node.is_feasible(spec.resources):
+                    reply({"retry_at": target})
+                else:
+                    with self._lock:
+                        self._queues[spec.scheduling_class].append(
+                            (spec, reply))
+        return True
+
+    # ---- introspection --------------------------------------------------
+    def num_queued(self) -> int:
+        with self._lock:
+            return (sum(len(q) for q in self._queues.values()) +
+                    sum(len(q) for q in self._infeasible.values()))
+
+    def debug_state(self) -> dict:
+        with self._lock:
+            return {
+                "queued": {c: len(q) for c, q in self._queues.items() if q},
+                "infeasible": {c: len(q) for c, q in self._infeasible.items()
+                               if q},
+            }

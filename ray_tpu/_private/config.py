@@ -1,0 +1,146 @@
+"""Env-overridable framework configuration.
+
+TPU-native equivalent of the reference's macro-generated config
+(``src/ray/common/ray_config_def.h:46-66`` — 141 ``RAY_CONFIG(type, name,
+default)`` entries, each overridable from env ``RAY_{name}``, plus a JSON
+``_system_config`` propagated to all daemons via ``RayConfig::initialize``,
+``src/ray/common/ray_config.cc:29``).
+
+Here every dataclass field is overridable from env ``RAY_TPU_{NAME}`` and from
+the ``_system_config`` dict passed to :func:`ray_tpu.init`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+from typing import Optional
+
+
+@dataclasses.dataclass
+class Config:
+    # ------ scheduler (reference: ray_config_def.h:138,463,533,342) ------
+    #: Utilization below which the hybrid policy packs instead of spreads.
+    scheduler_spread_threshold: float = 0.5
+    #: Prefer non-TPU nodes for tasks that don't require TPU (reference:
+    #: ``scheduler_avoid_gpu_nodes``, ray_config_def.h:533).
+    scheduler_avoid_tpu_nodes: bool = True
+    #: Which backend solves the task->node assignment each tick:
+    #: "native" = greedy per-task python/numpy policy (reference parity),
+    #: "jax"    = batched TPU bin-packing kernel (the north star).
+    scheduler_backend: str = "native"
+    #: Hybrid policy considers the top-k best nodes and picks randomly among
+    #: them (reference: hybrid_scheduling_policy.cc top-k behavior).
+    scheduler_top_k_fraction: float = 0.2
+    #: Max lease requests in flight per scheduling class
+    #: (ray_config_def.h:342).
+    max_pending_lease_requests_per_scheduling_category: int = 10
+    #: GCS-side actor scheduling (ray_config_def.h:463).
+    gcs_actor_scheduling_enabled: bool = False
+
+    # ------ failure detection (ray_config_def.h:51-55) ------
+    raylet_heartbeat_period_milliseconds: int = 100
+    num_heartbeats_timeout: int = 30
+
+    # ------ object store ------
+    #: Objects larger than this are promoted to the node (plasma-equivalent)
+    #: store instead of the in-process memory store (reference: 100KB
+    #: promotion threshold in CoreWorker::Put).
+    max_direct_call_object_size: int = 100 * 1024
+    #: Per-node object store capacity in bytes before spilling kicks in.
+    object_store_memory: int = 2 * 1024 * 1024 * 1024
+    #: Spill when store utilization exceeds this fraction.
+    object_spilling_threshold: float = 0.8
+    #: Min number of objects batched into one spill operation
+    #: (reference: local_object_manager.h min_spilling_size).
+    min_spilling_size: int = 100 * 1024 * 1024
+    #: Use the native C++ shared-memory store when available.
+    use_native_object_store: bool = True
+    #: Chunk size for node-to-node object transfer (object_manager.cc).
+    object_manager_chunk_size: int = 5 * 1024 * 1024
+
+    # ------ core worker / task path ------
+    #: Args at or below this size are inlined into the task spec
+    #: (reference: task_rpc_inlined_bytes_limit / put threshold).
+    task_args_inline_bytes_limit: int = 100 * 1024
+    #: Default max retries for normal tasks (reference: default 3).
+    task_max_retries: int = 3
+    #: Lineage pinning for reconstruction (ray_config_def.h:97,110).
+    lineage_pinning_enabled: bool = True
+    #: Max lineage bytes kept per owner before disabling reconstruction.
+    max_lineage_bytes: int = 1024 * 1024 * 1024
+
+    # ------ worker pool ------
+    #: Soft cap of idle workers kept alive per node (ray_config_def.h:129).
+    num_workers_soft_limit: int = 64
+    #: Seconds an idle worker thread lingers before exit.
+    idle_worker_killing_time_threshold_ms: int = 1000
+    #: Maximum worker threads started per node.
+    maximum_startup_concurrency: int = 64
+
+    # ------ GCS ------
+    gcs_storage_backend: str = "memory"  # "memory" | "file"
+    gcs_rpc_server_reconnect_timeout_s: int = 60
+    #: Period of the GCS resource usage poll/broadcast loop
+    #: (reference: ray_syncer.h broadcast thread).
+    gcs_resource_broadcast_period_milliseconds: int = 100
+
+    # ------ misc ------
+    event_loop_tick_ms: int = 5
+    debug_dump_period_milliseconds: int = 10_000
+    metrics_report_interval_ms: int = 2_000
+    temp_dir: str = "/tmp/ray_tpu"
+    #: Enable OpenTelemetry-style span capture (tracing_helper.py parity).
+    tracing_enabled: bool = False
+
+    @classmethod
+    def from_env(cls, system_config: Optional[dict] = None) -> "Config":
+        cfg = cls()
+        for f in dataclasses.fields(cls):
+            env_key = "RAY_TPU_" + f.name.upper()
+            # Also honor the reference's RAY_<name> convention.
+            raw = os.environ.get(env_key, os.environ.get("RAY_" + f.name))
+            if raw is not None:
+                setattr(cfg, f.name, _parse(raw, f.type, getattr(cfg, f.name)))
+        if system_config:
+            for k, v in system_config.items():
+                if not hasattr(cfg, k):
+                    raise ValueError(f"Unknown system config key: {k}")
+                setattr(cfg, k, v)
+        return cfg
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+
+def _parse(raw: str, ftype, default):
+    t = type(default)
+    if t is bool:
+        return raw.lower() in ("1", "true", "yes")
+    if t is int:
+        return int(raw)
+    if t is float:
+        return float(raw)
+    return raw
+
+
+_global_config: Optional[Config] = None
+_lock = threading.Lock()
+
+
+def get_config() -> Config:
+    """Process-wide config singleton (initialized lazily from env)."""
+    global _global_config
+    with _lock:
+        if _global_config is None:
+            _global_config = Config.from_env()
+        return _global_config
+
+
+def initialize_config(system_config: Optional[dict] = None) -> Config:
+    global _global_config
+    with _lock:
+        _global_config = Config.from_env(system_config)
+        return _global_config

@@ -1,0 +1,382 @@
+"""CoreWorker — the in-process runtime of the driver (and, logically, of
+every executor thread).
+
+Parity: reference ``src/ray/core_worker/core_worker.cc`` — ``Put`` (:878),
+``Get`` (:1081, merging memory store + plasma + remote pull),
+``SubmitTask`` (:1650), ``CreateActor`` (:1709), ``CreatePlacementGroup``
+(:1869), ``SubmitActorTask`` (:1940), ``ExecuteTask`` (:2255 — lives in
+executor.py here), plus the ``ObjectRecoveryManager``
+(object_recovery_manager.cc: lost objects are reconstructed by resubmitting
+the creating task from pinned lineage).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu import exceptions
+from ray_tpu._private import worker_context
+from ray_tpu._private.config import get_config
+from ray_tpu._private.direct_actor_submitter import DirectActorTaskSubmitter
+from ray_tpu._private.direct_task_submitter import DirectTaskSubmitter
+from ray_tpu._private.function_manager import FunctionManager
+from ray_tpu._private.ids import (
+    ActorID, JobID, ObjectID, PlacementGroupID, TaskID, WorkerID)
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.object_store import (
+    DeviceObject, InPlasmaMarker, MemoryStore, entry_value)
+from ray_tpu._private.reference_counter import ReferenceCounter
+from ray_tpu._private.serialization import SerializedObject, serialize
+from ray_tpu._private.task_manager import TaskManager
+from ray_tpu._private.task_spec import TaskArg, TaskSpec
+
+
+class CoreWorker:
+    def __init__(self, cluster, job_id: JobID, is_driver: bool = True):
+        self.cluster = cluster
+        self.job_id = job_id
+        self.worker_id = WorkerID.from_random()
+        self.is_driver = is_driver
+        self.memory_store = MemoryStore()
+        self.reference_counter = ReferenceCounter()
+        self.task_manager = TaskManager(self)
+        self.function_manager = FunctionManager(cluster.gcs.kv)
+        self.task_submitter = DirectTaskSubmitter(self)
+        self.actor_submitter = DirectActorTaskSubmitter(self)
+        self.driver_task_id = TaskID.for_driver(job_id)
+        self._put_counter = 0
+        self._put_lock = threading.Lock()
+        self.metrics: Dict[str, float] = {"tasks_finished": 0,
+                                          "task_exec_seconds": 0.0}
+        # Free stored copies when objects go out of scope.
+        self.reference_counter.subscribe_deleted(self._free_object)
+
+    # ------------------------------------------------------------------
+    @property
+    def local_raylet(self):
+        ctx = worker_context.get_context()
+        if ctx.node is not None:
+            return ctx.node
+        return self.cluster.head_node
+
+    def _next_put_id(self) -> ObjectID:
+        ctx = worker_context.current_task_spec()
+        base_task = ctx.task_id if ctx is not None else self.driver_task_id
+        with self._put_lock:
+            self._put_counter += 1
+            # Put ids use a high index band so they never collide with
+            # return ids of the same task (reference: put index counter).
+            return ObjectID.from_index(base_task, 2**40 + self._put_counter)
+
+    # ---- Put / Get / Wait (core_worker.cc:878,1081) --------------------
+    def put(self, value: Any, _owner=None) -> ObjectRef:
+        object_id = self._next_put_id()
+        self.put_value(object_id, value)
+        return ObjectRef(object_id, owner_id=self.worker_id)
+
+    def put_value(self, object_id: ObjectID, value: Any):
+        cfg = get_config()
+        if _is_device_array(value):
+            # Device-resident path: keep the buffer on TPU, no host copy.
+            data = DeviceObject(value)
+            self.reference_counter.add_owned_object(object_id)
+            raylet = self.local_raylet
+            raylet.object_store.put(object_id, data)
+            self.cluster.object_directory.add_location(object_id,
+                                                       raylet.node_id)
+            return
+        serialized = serialize(value)
+        contained = [r.object_id() for r in serialized.contained_refs]
+        self.reference_counter.add_owned_object(object_id,
+                                                contained_ids=contained)
+        if serialized.total_bytes <= cfg.max_direct_call_object_size:
+            self.memory_store.put(object_id, serialized)
+        else:
+            raylet = self.local_raylet
+            raylet.object_store.put(object_id, serialized)
+            self.cluster.object_directory.add_location(object_id,
+                                                       raylet.node_id)
+
+    def put_return_value(self, object_id: ObjectID, value: Any, node) -> int:
+        """Store a task return (small -> owner memory store 'inline reply';
+        big -> executing node's store + directory)."""
+        cfg = get_config()
+        if _is_device_array(value):
+            data = DeviceObject(value)
+            node.object_store.put(object_id, data)
+            self.cluster.object_directory.add_location(object_id,
+                                                       node.node_id)
+            return data.nbytes
+        serialized = serialize(value)
+        contained = [r.object_id() for r in serialized.contained_refs]
+        if contained:
+            self.reference_counter.add_owned_object(
+                object_id, contained_ids=contained)
+        if serialized.total_bytes <= cfg.max_direct_call_object_size:
+            self.memory_store.put(object_id, serialized)
+        else:
+            node.object_store.put(object_id, serialized)
+            self.cluster.object_directory.add_location(object_id,
+                                                       node.node_id)
+            # Seal a location marker so owner-side gets unblock quickly.
+            self.memory_store.put(object_id, InPlasmaMarker(node.node_id))
+        return serialized.total_bytes
+
+    def get(self, refs: Sequence[ObjectRef],
+            timeout: Optional[float] = None) -> List[Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        out = []
+        for ref in refs:
+            remaining = None if deadline is None else \
+                max(0.0, deadline - time.monotonic())
+            out.append(self._get_one(ref, remaining))
+        return out
+
+    def _get_one(self, ref: ObjectRef, timeout: Optional[float]) -> Any:
+        object_id = ref.object_id()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        recovery_attempted = False
+        while True:
+            value, found = self._try_get_local(object_id)
+            if found:
+                return value
+            # Not local: is it in some node's store?
+            locations = self.cluster.object_directory.get_locations(object_id)
+            if locations:
+                node = self.local_raylet
+                done = threading.Event()
+                result = {}
+
+                def cb(ok):
+                    result["ok"] = ok
+                    done.set()
+
+                node.object_manager.pull_async(object_id, cb)
+                done.wait(timeout=5.0)
+                if result.get("ok"):
+                    continue
+            else:
+                # Maybe it's a pending task return: wait on the memory
+                # store future briefly, then re-examine.
+                try:
+                    entry = self.memory_store.get(object_id, timeout=0.05)
+                    value = self._entry_to_value(object_id, entry)
+                    return value
+                except exceptions.GetTimeoutError:
+                    pass
+                except _Retry:
+                    continue
+            # Object nowhere and not pending: try lineage reconstruction.
+            if not self._is_pending(object_id) and not locations:
+                if not recovery_attempted and self.recover_object(object_id):
+                    recovery_attempted = True
+                    continue
+                if recovery_attempted and not self._is_pending(object_id):
+                    time.sleep(0.01)
+            if deadline is not None and time.monotonic() >= deadline:
+                raise exceptions.GetTimeoutError(
+                    f"Get timed out for {object_id}")
+
+    def _try_get_local(self, object_id: ObjectID) -> Tuple[Any, bool]:
+        entry = self.memory_store.get_entry(object_id)
+        if entry is not None and entry.sealed:
+            try:
+                return self._entry_to_value(object_id, entry), True
+            except _Retry:
+                return None, False
+        raylet = self.local_raylet
+        if raylet is not None:
+            e = raylet.object_store.get(object_id)
+            if e is not None:
+                return entry_value(e), True
+        return None, False
+
+    def _entry_to_value(self, object_id: ObjectID, entry):
+        if entry.error is not None:
+            err = entry.error
+            if isinstance(err, exceptions.TaskError):
+                raise err.as_instanceof_cause()
+            raise err
+        if isinstance(entry.data, InPlasmaMarker):
+            # Marker: the real bytes are in a node store.
+            raylet = self.local_raylet
+            e = raylet.object_store.get(object_id)
+            if e is not None:
+                return entry_value(e)
+            raise _Retry()
+        return entry_value(entry)
+
+    def _is_pending(self, object_id: ObjectID) -> bool:
+        return self.task_manager.is_pending(object_id.task_id())
+
+    def get_for_executor(self, object_id: ObjectID, node) -> Any:
+        """Executor-side arg materialization (GetAndPinArgsForExecutor)."""
+        entry = node.object_store.get(object_id)
+        if entry is not None:
+            return entry_value(entry)
+        entry = self.memory_store.get_entry(object_id)
+        if entry is not None and entry.sealed and \
+                not isinstance(entry.data, InPlasmaMarker):
+            return self._entry_to_value(object_id, entry)
+        # Pull to this node, then read.
+        done = threading.Event()
+        node.object_manager.pull_async(object_id, lambda ok: done.set())
+        done.wait(timeout=30.0)
+        entry = node.object_store.get(object_id)
+        if entry is None:
+            raise exceptions.ObjectLostError(object_id, "arg fetch failed")
+        return entry_value(entry)
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None,
+             fetch_local: bool = True) -> Tuple[List, List]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        refs = list(refs)
+        while True:
+            ready, not_ready = [], []
+            for ref in refs:
+                if self._is_ready(ref.object_id()):
+                    ready.append(ref)
+                else:
+                    not_ready.append(ref)
+            if len(ready) >= num_returns or \
+                    (deadline is not None and time.monotonic() >= deadline):
+                ready = ready[:max(num_returns, len(ready))] \
+                    if len(ready) >= num_returns else ready
+                return ready, not_ready
+            time.sleep(0.002)
+
+    def _is_ready(self, object_id: ObjectID) -> bool:
+        entry = self.memory_store.get_entry(object_id)
+        if entry is not None and entry.sealed:
+            return True
+        if self.cluster.object_directory.get_locations(object_id):
+            return True
+        raylet = self.local_raylet
+        return raylet is not None and raylet.object_store.contains(object_id)
+
+    def get_async(self, ref: ObjectRef, callback):
+        def run():
+            try:
+                callback(self._get_one(ref, None), None)
+            except BaseException as e:  # noqa: BLE001
+                callback(None, e)
+        threading.Thread(target=run, daemon=True).start()
+
+    # ---- task submission (core_worker.cc:1650) -------------------------
+    def build_args(self, flat_args):
+        """Returns (task_args, dep_ids, holders).
+
+        ``holders`` are temporary ObjectRefs for big literal args promoted
+        to owned objects (put-in-plasma path, _raylet.pyx:1487).  The caller
+        MUST keep them alive until ``submit_task`` has registered the
+        submitted-task refs, otherwise the Python GC frees the arg object
+        between promotion and submission.
+        """
+        cfg = get_config()
+        out: List[TaskArg] = []
+        dep_ids: List[ObjectID] = []
+        holders: List[ObjectRef] = []
+        for a in flat_args:
+            if isinstance(a, ObjectRef):
+                out.append(TaskArg(is_inline=False, object_id=a.object_id(),
+                                   owner_id=a.owner_id()))
+                dep_ids.append(a.object_id())
+            else:
+                s = serialize(a)
+                if s.total_bytes > cfg.task_args_inline_bytes_limit:
+                    ref = self.put(a)
+                    holders.append(ref)
+                    out.append(TaskArg(is_inline=False,
+                                       object_id=ref.object_id(),
+                                       owner_id=self.worker_id))
+                    dep_ids.append(ref.object_id())
+                else:
+                    for inner in s.contained_refs:
+                        self.reference_counter.add_borrowed_object(
+                            inner.object_id(), borrower=self.worker_id)
+                    out.append(TaskArg(is_inline=True, value=s))
+        return out, dep_ids, holders
+
+    def submit_task(self, spec: TaskSpec, holders=()) -> List[ObjectRef]:
+        self.task_manager.add_pending_task(spec)
+        del holders  # submitted-task refs now pin the promoted args
+        self.task_submitter.submit(spec)
+        return [ObjectRef(oid, owner_id=self.worker_id)
+                for oid in spec.return_ids]
+
+    def submit_actor_task(self, spec: TaskSpec, holders=()) -> List[ObjectRef]:
+        self.task_manager.add_pending_task(spec)
+        del holders
+        self.actor_submitter.submit(spec)
+        return [ObjectRef(oid, owner_id=self.worker_id)
+                for oid in spec.return_ids]
+
+    def create_actor(self, creation_spec: TaskSpec, name: str = "",
+                     namespace: str = "", detached: bool = False):
+        from ray_tpu.gcs.actor_manager import GcsActor
+        actor = GcsActor(creation_spec.actor_id, creation_spec, name=name,
+                         namespace=namespace,
+                         max_restarts=creation_spec.max_restarts,
+                         detached=detached)
+        self.cluster.gcs.actor_manager.register_actor(actor)
+        return actor
+
+    # ---- recovery (object_recovery_manager.cc) -------------------------
+    def recover_object(self, object_id: ObjectID) -> bool:
+        """Resubmit the creating task from pinned lineage."""
+        if not get_config().lineage_pinning_enabled:
+            return False
+        spec = self.task_manager.lineage_spec_for_object(object_id)
+        if spec is None:
+            return False
+        if self.task_manager.is_pending(spec.task_id):
+            return True  # already being recomputed
+        if spec.is_actor_task() or spec.is_actor_creation():
+            return False  # actor state is not reconstructable
+        self.task_manager.add_pending_task(spec)
+        self.task_submitter.submit(spec)
+        return True
+
+    def on_node_death(self, node_id, lost_objects: List[ObjectID]):
+        """Proactively reconstruct referenced lost objects."""
+        for oid in lost_objects:
+            if self.reference_counter.has_reference(oid):
+                self.memory_store.delete(oid)
+                self.recover_object(oid)
+
+    # ---- free path ------------------------------------------------------
+    def _free_object(self, object_id: ObjectID):
+        self.memory_store.delete(object_id)
+        directory = self.cluster.object_directory
+        for node_id in directory.get_locations(object_id):
+            raylet = self.cluster.gcs.raylet(node_id)
+            if raylet is not None:
+                raylet.object_store.delete(object_id)
+        directory.remove_object(object_id)
+        self.task_manager.evict_lineage(object_id.task_id())
+
+    def free_objects(self, refs: Sequence[ObjectRef]):
+        for ref in refs:
+            self._free_object(ref.object_id())
+
+    # ---- metrics hook ---------------------------------------------------
+    def record_task_metric(self, spec: TaskSpec, elapsed: float):
+        self.metrics["tasks_finished"] += 1
+        self.metrics["task_exec_seconds"] += elapsed
+
+
+def _is_device_array(value) -> bool:
+    """True for live jax device arrays — without importing jax eagerly
+    (jax import costs seconds; pure-CPU control paths never pay it)."""
+    import sys
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return False
+    return isinstance(value, jax.Array) and not value.is_deleted()
+
+
+class _Retry(Exception):
+    pass

@@ -1,0 +1,113 @@
+"""Actor-task transport: ordered per-actor queues + restart handling.
+
+Parity: reference
+``src/ray/core_worker/transport/direct_actor_task_submitter.h`` — per-actor
+sequenced submit queue (``sequential_actor_submit_queue.cc``; out-of-order
+variant when ``max_concurrency>1``), queue paused while the actor is
+RESTARTING, tasks failed with ``ActorError`` once the actor is DEAD
+(``GcsActorManager`` restart orchestration).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict
+
+from ray_tpu import exceptions
+from ray_tpu._private.ids import ActorID
+from ray_tpu._private.task_spec import TaskSpec
+from ray_tpu.gcs.actor_manager import ActorState
+
+
+class _ActorQueue:
+    __slots__ = ("pending", "inflight", "state", "worker")
+
+    def __init__(self):
+        self.pending: deque = deque()
+        self.inflight = 0
+        self.state = ActorState.PENDING_CREATION
+        self.worker = None
+
+
+class DirectActorTaskSubmitter:
+    def __init__(self, core_worker):
+        self._core = core_worker
+        self._lock = threading.RLock()
+        self._queues: Dict[ActorID, _ActorQueue] = {}
+
+    def _queue_for(self, actor_id: ActorID) -> _ActorQueue:
+        q = self._queues.get(actor_id)
+        if q is None:
+            q = _ActorQueue()
+            self._queues[actor_id] = q
+            # Track actor state via GCS pubsub (actor channel).
+            from ray_tpu.gcs import pubsub as pubsub_mod
+            self._core.cluster.gcs.publisher.subscribe(
+                pubsub_mod.ACTOR_CHANNEL, actor_id.binary(),
+                lambda key, info, aid=actor_id: self._on_actor_update(aid, info))
+            # Seed current state.
+            actor = self._core.cluster.gcs.actor_manager.get_actor(actor_id)
+            if actor is not None:
+                q.state = actor.state
+                q.worker = actor.worker
+        return q
+
+    def submit(self, spec: TaskSpec):
+        actor_id = spec.actor_id
+        with self._lock:
+            q = self._queue_for(actor_id)
+            q.pending.append(spec)
+        self._pump(actor_id)
+
+    def _pump(self, actor_id: ActorID):
+        while True:
+            with self._lock:
+                q = self._queues.get(actor_id)
+                if q is None or not q.pending:
+                    return
+                if q.state == ActorState.DEAD:
+                    spec = q.pending.popleft()
+                    actor = self._core.cluster.gcs.actor_manager.get_actor(
+                        actor_id)
+                    reason = actor.death_cause if actor else "actor dead"
+                    err = exceptions.ActorDiedError(actor_id, reason)
+                    fail = True
+                elif q.state == ActorState.ALIVE and q.worker is not None:
+                    spec = q.pending.popleft()
+                    q.inflight += 1
+                    worker = q.worker
+                    fail = False
+                else:
+                    return  # PENDING/RESTARTING: hold the queue.
+            if fail:
+                self._core.task_manager.fail_task(spec, err)
+                continue
+
+            def on_done(error, spec=spec, worker=worker):
+                with self._lock:
+                    q2 = self._queues.get(actor_id)
+                    if q2 is not None:
+                        q2.inflight -= 1
+                if error is None:
+                    self._core.task_manager.complete_task(spec)
+                elif isinstance(error, exceptions.TaskError):
+                    self._core.task_manager.fail_task(spec, error)
+                else:
+                    # Worker/system failure: the GCS will restart or kill
+                    # the actor; retry per max_task_retries.
+                    self._core.task_manager.fail_or_retry(
+                        spec, error, resubmit=self.submit)
+                self._pump(actor_id)
+
+            worker.submit_actor_task(spec, on_done)
+
+    def _on_actor_update(self, actor_id: ActorID, info: dict):
+        actor = self._core.cluster.gcs.actor_manager.get_actor(actor_id)
+        with self._lock:
+            q = self._queues.get(actor_id)
+            if q is None:
+                return
+            q.state = info.get("state", q.state)
+            q.worker = actor.worker if actor is not None else None
+        self._pump(actor_id)

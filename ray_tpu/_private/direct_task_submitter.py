@@ -1,0 +1,177 @@
+"""Normal-task transport: leasing, pipelining, spillback handling.
+
+Parity: reference ``src/ray/core_worker/transport/direct_task_transport.cc``
+— per-``SchedulingKey`` queues (direct_task_transport.h:53-57), worker lease
+reuse (``OnWorkerIdle`` .cc:157), new lease requests capped per scheduling
+class (``RequestNewWorkerIfNeeded`` .cc:308), spillback re-lease at
+``retry_at_raylet_address`` (.cc:459), direct ``PushTask`` to the leased
+worker (.cc:508) — the raylet is off the per-task data path after leasing.
+
+Lease-node choice uses the locality policy (``lease_policy.h:54-60``): the
+raylet holding the most argument bytes, else the local raylet.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict, deque
+from typing import Dict, List, Optional, Tuple
+
+from ray_tpu import exceptions
+from ray_tpu._private.config import get_config
+from ray_tpu._private.task_spec import TaskSpec
+
+
+class _SchedulingKeyState:
+    __slots__ = ("queue", "idle_workers", "pending_leases")
+
+    def __init__(self):
+        self.queue: deque = deque()
+        self.idle_workers: List[Tuple[object, object]] = []  # (worker, raylet)
+        self.pending_leases = 0
+
+
+class DirectTaskSubmitter:
+    def __init__(self, core_worker):
+        self._core = core_worker
+        self._lock = threading.RLock()
+        self._keys: Dict[int, _SchedulingKeyState] = defaultdict(
+            _SchedulingKeyState)
+        self._max_pending = get_config(
+        ).max_pending_lease_requests_per_scheduling_category
+
+    # ---- entry ----------------------------------------------------------
+    def submit(self, spec: TaskSpec):
+        key = spec.scheduling_class
+        with self._lock:
+            state = self._keys[key]
+            state.queue.append(spec)
+        self._pump(key)
+
+    def _pump(self, key: int):
+        """Dispatch queued tasks onto idle leased workers; request new
+        leases for the remainder (bounded pipelining)."""
+        while True:
+            with self._lock:
+                state = self._keys[key]
+                if not state.queue:
+                    return
+                if state.idle_workers:
+                    worker, raylet = state.idle_workers.pop()
+                    spec = state.queue.popleft()
+                    self._push(spec, worker, raylet, key)
+                    continue
+                if state.pending_leases >= self._max_pending:
+                    return
+                state.pending_leases += 1
+                spec = state.queue[0]
+            self._request_lease(spec, key)
+            return
+
+    # ---- leasing --------------------------------------------------------
+    def _pick_lease_raylet(self, spec: TaskSpec):
+        """Locality-aware lease policy (lease_policy.h:54-60)."""
+        best, best_bytes = None, -1
+        cluster = self._core.cluster
+        for oid in spec.arg_object_ids():
+            locs = cluster.object_directory.get_locations(oid)
+            for node_id in locs:
+                raylet = cluster.gcs.raylet(node_id)
+                if raylet is None:
+                    continue
+                entry = raylet.object_store.get(oid)
+                size = entry.size if entry else 0
+                if size > best_bytes:
+                    best, best_bytes = raylet, size
+        if spec.scheduling_options.node_affinity_node_id is not None:
+            affinity = cluster.gcs.raylet(
+                spec.scheduling_options.node_affinity_node_id)
+            if affinity is not None:
+                return affinity
+        return best or self._core.local_raylet
+
+    def _request_lease(self, spec: TaskSpec, key: int, raylet=None,
+                       hops: int = 0):
+        raylet = raylet or self._pick_lease_raylet(spec)
+        if raylet is None:
+            self._on_lease_failed(spec, key,
+                                  exceptions.RayTpuError("no raylet"))
+            return
+
+        def on_reply(result):
+            if "worker" in result:
+                with self._lock:
+                    state = self._keys[key]
+                    state.pending_leases -= 1
+                    if state.queue and state.queue[0].task_id == spec.task_id:
+                        state.queue.popleft()
+                        dispatch = spec
+                    elif state.queue:
+                        dispatch = state.queue.popleft()
+                    else:
+                        dispatch = None
+                if dispatch is None:
+                    # Queue drained while the lease was in flight; return it.
+                    result["raylet"].return_worker(result["worker"])
+                else:
+                    self._push(dispatch, result["worker"], result["raylet"],
+                               key)
+                self._pump(key)
+            elif "retry_at" in result:
+                # Spillback (cluster_task_manager.cc:285-323): re-lease at
+                # the suggested raylet.
+                target = self._core.cluster.gcs.raylet(result["retry_at"])
+                if target is None or hops > 10:
+                    with self._lock:
+                        self._keys[key].pending_leases -= 1
+                    self._pump(key)
+                else:
+                    self._request_lease(spec, key, raylet=target,
+                                        hops=hops + 1)
+            else:
+                self._on_lease_failed(
+                    spec, key, exceptions.RayTpuError(
+                        result.get("reason", "lease rejected")))
+
+        raylet.request_worker_lease(spec, on_reply)
+
+    def _on_lease_failed(self, spec: TaskSpec, key: int, err):
+        with self._lock:
+            state = self._keys[key]
+            state.pending_leases = max(0, state.pending_leases - 1)
+            try:
+                state.queue.remove(spec)
+            except ValueError:
+                pass
+        self._core.task_manager.fail_or_retry(
+            spec, err, resubmit=self.submit)
+
+    # ---- dispatch -------------------------------------------------------
+    def _push(self, spec: TaskSpec, worker, raylet, key: int):
+        def on_done(error):
+            if error is None:
+                self._core.task_manager.complete_task(spec)
+                self._on_worker_idle(worker, raylet, key)
+            else:
+                # User errors don't poison the worker; system errors do.
+                if isinstance(error, exceptions.TaskError):
+                    self._on_worker_idle(worker, raylet, key)
+                else:
+                    raylet.return_worker(worker, disconnect=True)
+                retried = self._core.task_manager.fail_or_retry(
+                    spec, error, resubmit=self.submit)
+                _ = retried
+
+        worker.push_task(spec, on_done)
+
+    def _on_worker_idle(self, worker, raylet, key: int):
+        """Reuse the leased worker for the next queued task of this class
+        (OnWorkerIdle, direct_task_transport.cc:157)."""
+        with self._lock:
+            state = self._keys[key]
+            if state.queue:
+                spec = state.queue.popleft()
+                self._push(spec, worker, raylet, key)
+                return
+            # No more work: return the lease.
+        raylet.return_worker(worker)

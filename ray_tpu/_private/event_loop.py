@@ -1,0 +1,103 @@
+"""Instrumented event loops — the asio substrate equivalent.
+
+Parity: reference ``src/ray/common/asio/`` (boost::asio io_context per daemon
+with periodic timers and post()ed handlers, instrumented with per-handler
+stats).  Here an event loop is a thread + monotonic timer heap; stats are
+kept per handler name for the debug dump (scheduler_stats.cc parity).
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+import traceback
+from typing import Callable, Dict, Optional
+
+
+class EventLoop:
+    def __init__(self, name: str = "loop"):
+        self.name = name
+        self._cond = threading.Condition()
+        self._queue = []            # immediate handlers
+        self._timers = []           # (deadline, seq, period, name, fn)
+        self._seq = 0
+        self._stopped = False
+        self.handler_stats: Dict[str, dict] = {}
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"ray_tpu::{name}")
+        self._thread.start()
+
+    def post(self, fn: Callable, name: str = "anon"):
+        with self._cond:
+            if self._stopped:
+                return
+            self._queue.append((name, fn))
+            self._cond.notify()
+
+    def schedule_every(self, period_s: float, fn: Callable, name: str):
+        """Periodic timer; rescheduled after each run (asio PeriodicalRunner)."""
+        with self._cond:
+            if self._stopped:
+                return
+            self._seq += 1
+            heapq.heappush(self._timers,
+                           (time.monotonic() + period_s, self._seq,
+                            period_s, name, fn))
+            self._cond.notify()
+
+    def schedule_after(self, delay_s: float, fn: Callable, name: str = "timer"):
+        with self._cond:
+            if self._stopped:
+                return
+            self._seq += 1
+            heapq.heappush(self._timers,
+                           (time.monotonic() + delay_s, self._seq,
+                            None, name, fn))
+            self._cond.notify()
+
+    def stop(self):
+        with self._cond:
+            self._stopped = True
+            self._cond.notify()
+        if threading.current_thread() is not self._thread:
+            self._thread.join(timeout=2)
+
+    def _record(self, name: str, elapsed: float):
+        st = self.handler_stats.setdefault(
+            name, {"count": 0, "total_s": 0.0, "max_s": 0.0})
+        st["count"] += 1
+        st["total_s"] += elapsed
+        st["max_s"] = max(st["max_s"], elapsed)
+
+    def _run(self):
+        while True:
+            fn = None
+            name = None
+            with self._cond:
+                while not self._stopped:
+                    now = time.monotonic()
+                    if self._queue:
+                        name, fn = self._queue.pop(0)
+                        break
+                    if self._timers and self._timers[0][0] <= now:
+                        deadline, seq, period, name, fn = heapq.heappop(
+                            self._timers)
+                        if period is not None:
+                            self._seq += 1
+                            heapq.heappush(
+                                self._timers,
+                                (now + period, self._seq, period, name, fn))
+                        break
+                    timeout = None
+                    if self._timers:
+                        timeout = max(0.0, self._timers[0][0] - now)
+                    self._cond.wait(timeout=timeout)
+                if self._stopped:
+                    return
+            t0 = time.monotonic()
+            try:
+                fn()
+            except Exception:
+                traceback.print_exc()
+            self._record(name, time.monotonic() - t0)

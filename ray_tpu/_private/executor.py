@@ -1,0 +1,124 @@
+"""Task execution on a worker thread.
+
+Parity: reference executor path ``CoreWorker::ExecuteTask``
+(core_worker.cc:2255) -> Cython ``task_execution_handler``
+(_raylet.pyx:778) -> ``execute_task`` (:481): deserialize/pin args, load the
+function from the GCS function store, run it, store returns (small ->
+owner's in-process store "inline reply"; large -> node plasma-equivalent +
+location registered with the directory).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from ray_tpu import exceptions
+from ray_tpu._private import worker_context
+from ray_tpu._private.config import get_config
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.object_store import DeviceObject, entry_value
+from ray_tpu._private.serialization import deserialize, serialize
+from ray_tpu._private.task_spec import TaskSpec, TaskType
+
+logger = logging.getLogger(__name__)
+
+
+def resolve_args(spec: TaskSpec, node, core_worker):
+    """Materialize task arguments (GetAndPinArgsForExecutor parity)."""
+    out = []
+    for arg in spec.args:
+        if arg.is_inline:
+            out.append(deserialize(arg.value))
+        else:
+            value = core_worker.get_for_executor(arg.object_id, node)
+            out.append(value)
+    return out
+
+
+def store_returns(spec: TaskSpec, values, node, core_worker):
+    """Store return values; returns list of (object_id, size)."""
+    cfg = get_config()
+    num = spec.num_returns
+    if num == 1:
+        values = [values]
+    elif num == 0:
+        return []
+    else:
+        values = list(values)
+        if len(values) != num:
+            raise ValueError(
+                f"Task {spec.function_name} returned {len(values)} values, "
+                f"expected num_returns={num}")
+    results = []
+    for i, value in enumerate(values):
+        oid = ObjectID.from_index(spec.task_id, i + 1)
+        results.append((oid, core_worker.put_return_value(oid, value, node)))
+    return results
+
+
+def execute_task(spec: TaskSpec, node, core_worker, actor_instance=None):
+    """Run one task on the current thread; returns (ok, error).
+
+    On success return values are already stored.  On failure the caller
+    (TaskManager) decides between retry and storing error objects.
+    """
+    ctx = worker_context.ExecutionContext(
+        task_spec=spec, node=node,
+        worker=worker_context.get_context().worker,
+        actor_instance=actor_instance)
+    prev = worker_context.get_context()
+    worker_context.set_context(ctx)
+    t0 = time.monotonic()
+    try:
+        args, kwargs = _split_args(resolve_args(spec, node, core_worker))
+        if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+            fn = core_worker.function_manager.load(spec.function_id)
+            instance = fn(*args, **kwargs)
+            return True, instance
+        elif spec.task_type == TaskType.ACTOR_TASK:
+            method = getattr(actor_instance, spec.actor_method_name)
+            result = method(*args, **kwargs)
+        else:
+            fn = core_worker.function_manager.load(spec.function_id)
+            result = fn(*args, **kwargs)
+        store_returns(spec, result, node, core_worker)
+        return True, None
+    except Exception as e:  # noqa: BLE001 — user exceptions cross the boundary
+        return False, exceptions.TaskError(
+            e, task_desc=f"{spec.function_name}[{spec.task_id.hex()[:8]}]")
+    finally:
+        worker_context.set_context(prev)
+        core_worker.record_task_metric(spec, time.monotonic() - t0)
+
+
+class _KwMark:
+    """Marker separating positional args from flattened kwargs."""
+
+    def __reduce__(self):
+        return (_KwMark, ())
+
+
+def pack_args(args, kwargs):
+    """Flatten (args, kwargs) into one positional list for the spec.
+
+    Each kwarg value stays a *top-level* arg so ObjectRefs passed by
+    keyword are resolved to values on the executor side, matching the
+    reference's signature flattening (python/ray/_private/signature.py).
+    """
+    packed = list(args)
+    if kwargs:
+        packed.append(_KwMark())
+        packed.append(tuple(kwargs.keys()))
+        packed.extend(kwargs.values())
+    return packed
+
+
+def _split_args(flat):
+    for i, v in enumerate(flat):
+        if isinstance(v, _KwMark):
+            keys = flat[i + 1]
+            values = flat[i + 2:]
+            return list(flat[:i]), dict(zip(keys, values))
+    return list(flat), {}

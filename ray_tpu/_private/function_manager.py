@@ -1,0 +1,55 @@
+"""Function/actor-class export via the GCS KV.
+
+Parity: reference ``python/ray/_private/function_manager.py`` — user
+functions are cloudpickled once per definition, exported to the GCS KV keyed
+by a content hash, and loaded+cached on the executor side.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Callable, Dict
+
+from ray_tpu._private.ids import FunctionID
+from ray_tpu._private.serialization import dumps_function, loads_function
+
+_KV_PREFIX = b"fn:"
+
+
+class FunctionManager:
+    def __init__(self, kv):
+        self._kv = kv
+        self._lock = threading.Lock()
+        self._export_cache: Dict[int, FunctionID] = {}
+        self._load_cache: Dict[FunctionID, Callable] = {}
+
+    def export(self, fn: Callable) -> FunctionID:
+        key = id(fn)
+        with self._lock:
+            cached = self._export_cache.get(key)
+            if cached is not None:
+                return cached
+        blob = dumps_function(fn)
+        digest = hashlib.sha256(blob).digest()[:FunctionID.SIZE]
+        function_id = FunctionID(digest)
+        self._kv.put(_KV_PREFIX + function_id.binary(), blob, overwrite=False)
+        with self._lock:
+            self._export_cache[key] = function_id
+            # Seed the load cache with the original callable so local
+            # execution avoids a deserialize round-trip.
+            self._load_cache.setdefault(function_id, fn)
+        return function_id
+
+    def load(self, function_id: FunctionID) -> Callable:
+        with self._lock:
+            fn = self._load_cache.get(function_id)
+        if fn is not None:
+            return fn
+        blob = self._kv.get(_KV_PREFIX + function_id.binary())
+        if blob is None:
+            raise KeyError(f"Function {function_id} not found in GCS KV")
+        fn = loads_function(blob)
+        with self._lock:
+            self._load_cache[function_id] = fn
+        return fn

@@ -1,0 +1,124 @@
+"""Per-node dispatch: args -> resources -> worker binding.
+
+Parity: reference ``src/ray/raylet/local_task_manager.h:36-57`` (the 6-step
+lifecycle: queued -> waiting for args (DependencyManager) -> args pinned ->
+local resources allocated at instance granularity -> WorkerPool::PopWorker
+-> reply to the lease request with the bound worker + resource mapping) and
+``src/ray/raylet/dependency_manager.h`` (bridges the pull manager: a queued
+task's missing args are pulled to the node before dispatch).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, Dict, List
+
+from ray_tpu import exceptions
+from ray_tpu._private.task_spec import TaskSpec
+from ray_tpu.scheduler.resources import ResourceRequest
+
+
+class _Waiting:
+    __slots__ = ("spec", "reply", "missing")
+
+    def __init__(self, spec, reply, missing):
+        self.spec = spec
+        self.reply = reply
+        self.missing = missing
+
+
+class DependencyManager:
+    """Tracks tasks waiting for argument objects to become node-local."""
+
+    def __init__(self, raylet):
+        self._raylet = raylet
+        self._lock = threading.Lock()
+        self._waiting: Dict = {}  # task_id -> _Waiting
+
+    def wait_for_args(self, spec: TaskSpec, ready_cb: Callable[[], None]):
+        missing: List = []
+        for oid in spec.arg_object_ids():
+            if not self._raylet.object_manager.is_local_or_inline(oid):
+                missing.append(oid)
+        if not missing:
+            ready_cb()
+            return
+        state = _Waiting(spec, ready_cb, set(missing))
+        with self._lock:
+            self._waiting[spec.task_id] = state
+        for oid in missing:
+            self._raylet.object_manager.pull_async(
+                oid, lambda ok, oid=oid: self._on_arg(spec.task_id, oid, ok))
+
+    def _on_arg(self, task_id, oid, ok):
+        with self._lock:
+            state = self._waiting.get(task_id)
+            if state is None:
+                return
+            state.missing.discard(oid)
+            done = not state.missing
+            if done:
+                del self._waiting[task_id]
+        if done:
+            state.reply()
+
+    def num_waiting(self) -> int:
+        with self._lock:
+            return len(self._waiting)
+
+
+class LocalTaskManager:
+    def __init__(self, raylet):
+        self._raylet = raylet
+        self._lock = threading.RLock()
+        self._dispatch_queue: deque = deque()
+        # Resources held by leased workers: worker_id -> ResourceRequest.
+        self._allocated: Dict = {}
+        self.dependency_manager = DependencyManager(raylet)
+
+    # step 1-2: queue + wait for args
+    def queue_and_schedule(self, spec: TaskSpec, reply: Callable):
+        self.dependency_manager.wait_for_args(
+            spec, lambda: self._on_args_ready(spec, reply))
+
+    def _on_args_ready(self, spec: TaskSpec, reply: Callable):
+        with self._lock:
+            self._dispatch_queue.append((spec, reply))
+        self._raylet.loop.post(self.dispatch, "local.dispatch")
+
+    # steps 3-6: pin args, pop worker, bind.  Resources were already
+    # reserved by ClusterTaskManager at scheduling-decision time (the
+    # cluster view's local row is the authoritative NodeResources map),
+    # so dispatch only needs a worker slot.
+    def dispatch(self):
+        while True:
+            with self._lock:
+                if not self._dispatch_queue:
+                    return
+                spec, reply = self._dispatch_queue[0]
+                worker = self._raylet.worker_pool.pop_worker()
+                if worker is None:
+                    return  # no worker slot; retried when one frees up
+                self._dispatch_queue.popleft()
+                self._allocated[worker.worker_id] = spec.resources
+            for oid in spec.arg_object_ids():
+                self._raylet.object_store.pin(oid)
+            reply({"worker": worker, "raylet": self._raylet,
+                   "resources": spec.resources})
+
+    def release_worker_resources(self, worker) -> None:
+        with self._lock:
+            req = self._allocated.pop(worker.worker_id, None)
+        if req is not None:
+            self._raylet.cluster_view.add_back(self._raylet.node_id, req)
+            self._raylet.loop.post(self.dispatch, "local.dispatch")
+            self._raylet.cluster_task_manager.on_resources_freed()
+
+    def allocated_for(self, worker_id) -> ResourceRequest:
+        with self._lock:
+            return self._allocated.get(worker_id, ResourceRequest())
+
+    def num_queued(self) -> int:
+        with self._lock:
+            return len(self._dispatch_queue)

@@ -1,0 +1,177 @@
+"""Object plane: location directory + node-to-node transfer.
+
+Parity: reference ``src/ray/object_manager/`` — the
+``OwnershipBasedObjectDirectory`` (owners are the source of truth for object
+locations, ownership_based_object_directory.cc), ``PullManager``
+(admission-controlled pulls with retry, pull_manager.cc) and ``PushManager``
+(chunked pushes, push_manager.cc).  Transfers here copy the serialized bytes
+chunk-by-chunk between node stores (object_manager_chunk_size), preserving
+the chunked-flow structure the gRPC path would have.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Set
+
+from ray_tpu import exceptions
+from ray_tpu._private.config import get_config
+from ray_tpu._private.ids import NodeID, ObjectID
+from ray_tpu._private.serialization import SerializedObject
+
+
+class ObjectDirectory:
+    """Object location directory (ownership-based in the reference; the
+    owner table lives with the driver core worker here and this directory
+    is its queryable index)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._locations: Dict[ObjectID, Set[NodeID]] = {}
+        self._subscribers: Dict[ObjectID, List[Callable]] = {}
+
+    def add_location(self, object_id: ObjectID, node_id: NodeID):
+        with self._lock:
+            self._locations.setdefault(object_id, set()).add(node_id)
+            subs = self._subscribers.pop(object_id, [])
+        for cb in subs:
+            cb(node_id)
+
+    def remove_location(self, object_id: ObjectID, node_id: NodeID):
+        with self._lock:
+            locs = self._locations.get(object_id)
+            if locs:
+                locs.discard(node_id)
+                if not locs:
+                    del self._locations[object_id]
+
+    def remove_object(self, object_id: ObjectID):
+        with self._lock:
+            self._locations.pop(object_id, None)
+
+    def get_locations(self, object_id: ObjectID) -> Set[NodeID]:
+        with self._lock:
+            return set(self._locations.get(object_id, ()))
+
+    def subscribe_location(self, object_id: ObjectID, cb: Callable):
+        """Callback fired when the first location appears."""
+        with self._lock:
+            locs = self._locations.get(object_id)
+            if locs:
+                node = next(iter(locs))
+            else:
+                self._subscribers.setdefault(object_id, []).append(cb)
+                return
+        cb(node)
+
+    def on_node_death(self, node_id: NodeID) -> List[ObjectID]:
+        """Remove all locations on a dead node; returns objects that lost
+        their last copy (candidates for lineage reconstruction)."""
+        lost = []
+        with self._lock:
+            for oid, locs in list(self._locations.items()):
+                if node_id in locs:
+                    locs.discard(node_id)
+                    if not locs:
+                        del self._locations[oid]
+                        lost.append(oid)
+        return lost
+
+
+class NodeObjectManager:
+    """Per-node transfer manager (PullManager/PushManager parity)."""
+
+    def __init__(self, raylet, directory: ObjectDirectory):
+        self._raylet = raylet
+        self._directory = directory
+        self._lock = threading.Lock()
+        self._inflight_pulls: Dict[ObjectID, List[Callable]] = {}
+        self.stats = {"pulled_objects": 0, "pulled_bytes": 0,
+                      "chunks_transferred": 0}
+
+    # ---- queries --------------------------------------------------------
+    def is_local_or_inline(self, object_id: ObjectID) -> bool:
+        if self._raylet.object_store.contains(object_id):
+            return True
+        # Small objects live in the owner's in-process memory store and are
+        # readable from any node in-process ("inlined in PushTask").  An
+        # InPlasmaMarker does NOT count: the real bytes are on some node
+        # and must be pulled.
+        core = self._raylet.core_worker
+        if core is None:
+            return False
+        from ray_tpu._private.object_store import InPlasmaMarker
+        entry = core.memory_store.get_entry(object_id)
+        return entry is not None and entry.sealed and \
+            not isinstance(entry.data, InPlasmaMarker)
+
+    # ---- pull path ------------------------------------------------------
+    def pull_async(self, object_id: ObjectID, cb: Callable[[bool], None]):
+        if self.is_local_or_inline(object_id):
+            cb(True)
+            return
+        with self._lock:
+            waiters = self._inflight_pulls.get(object_id)
+            if waiters is not None:
+                waiters.append(cb)
+                return
+            self._inflight_pulls[object_id] = [cb]
+
+        def finish(ok: bool):
+            with self._lock:
+                waiters = self._inflight_pulls.pop(object_id, None)
+            if waiters is None:
+                return  # another path already finished this pull
+            for w in waiters:
+                w(ok)
+
+        def attempt(node_id):
+            if self.is_local_or_inline(object_id):
+                finish(True)
+                return
+            finish(self._fetch_from(object_id, node_id))
+
+        locations = self._directory.get_locations(object_id)
+        if locations:
+            self._raylet.loop.post(
+                lambda: attempt(next(iter(locations))), "pull")
+            return
+        # No location yet: the object may still be computing.  Watch both
+        # signals — a directory location (big objects land in a node store)
+        # and the owner's memory store (small returns are "inlined" there,
+        # never registered with the directory) — first one wins.  Mirrors
+        # the pull manager's retry loop + memory-store GetAsync.
+        self._directory.subscribe_location(
+            object_id,
+            lambda node_id: self._raylet.loop.post(
+                lambda: attempt(node_id), "pull"))
+        core = self._raylet.core_worker
+        if core is not None:
+            core.memory_store.get_async(
+                object_id, lambda entry: finish(True))
+
+    def _fetch_from(self, object_id: ObjectID, node_id: NodeID) -> bool:
+        """Chunked copy of the serialized object from a remote node store
+        into the local store (ObjectBufferPool chunk assembly parity)."""
+        source = self._raylet.cluster.gcs.raylet(node_id)
+        if source is None:
+            # Source died; try another location or give up.
+            for other in self._directory.get_locations(object_id):
+                if other != node_id:
+                    return self._fetch_from(object_id, other)
+            return False
+        serialized = source.object_store.get_serialized(object_id)
+        if serialized is None:
+            return False
+        blob = serialized.to_bytes()
+        chunk = get_config().object_manager_chunk_size
+        assembled = bytearray(len(blob))
+        for off in range(0, len(blob), chunk):
+            assembled[off:off + chunk] = blob[off:off + chunk]
+            self.stats["chunks_transferred"] += 1
+        restored = SerializedObject.from_bytes(bytes(assembled))
+        self._raylet.object_store.put(object_id, restored, pin=False)
+        self._directory.add_location(object_id, self._raylet.node_id)
+        self.stats["pulled_objects"] += 1
+        self.stats["pulled_bytes"] += len(blob)
+        return True

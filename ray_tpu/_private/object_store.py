@@ -1,0 +1,345 @@
+"""Per-process and per-node object stores.
+
+Parity targets:
+  * ``CoreWorkerMemoryStore`` (reference
+    ``src/ray/core_worker/store_provider/memory_store/``) — in-process store
+    for small objects and pending futures; blocking ``Get`` with timeout.
+  * Plasma (reference ``src/ray/object_manager/plasma/`` — shared-memory store
+    with capacity accounting, pinning, LRU eviction and spill-to-disk via
+    ``raylet/local_object_manager.cc``).  Here :class:`NodeObjectStore` is the
+    plasma equivalent: host-memory slab per node, optional native C++
+    shared-memory backend (``ray_tpu/native``), spill/restore to the session
+    dir, and a **device-object extension** the reference never had — jax
+    device buffers can live in the store without a host copy and are only
+    materialized to host when crossing nodes.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ray_tpu import exceptions
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.serialization import SerializedObject, deserialize
+
+
+class DeviceObject:
+    """A store entry whose payload is a jax device array (or pytree).
+
+    Zero-copy handoff: actors on the same node exchange the device buffer
+    directly; a host copy happens only on spill or cross-node transfer.
+    This is the TPU-native extension of plasma (SURVEY.md §7 "hard parts").
+    """
+
+    __slots__ = ("value", "nbytes")
+
+    def __init__(self, value):
+        import jax
+        self.value = value
+        self.nbytes = sum(
+            x.size * x.dtype.itemsize for x in jax.tree.leaves(value)
+            if hasattr(x, "dtype"))
+
+    def to_serialized(self) -> SerializedObject:
+        from ray_tpu._private.serialization import serialize
+        return serialize(self.value)
+
+
+class _Entry:
+    __slots__ = ("data", "error", "size", "pin_count", "last_access",
+                 "spilled_path", "sealed", "is_device")
+
+    def __init__(self, data=None, error=None, size=0):
+        self.data = data              # SerializedObject | DeviceObject | None
+        self.error = error            # Exception to raise at get()
+        self.size = size
+        self.pin_count = 0
+        self.last_access = time.monotonic()
+        self.spilled_path: Optional[str] = None
+        self.sealed = data is not None or error is not None
+        self.is_device = isinstance(data, DeviceObject)
+
+
+class MemoryStore:
+    """In-process store: small objects, error markers, pending futures.
+
+    ``get`` blocks on a condition variable until the object is sealed
+    (reference: memory store ``GetAsync``/``Get`` with timeout).
+    """
+
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._entries: Dict[ObjectID, _Entry] = {}
+        self._get_callbacks: Dict[ObjectID, list] = {}
+
+    def put(self, object_id: ObjectID, data, error=None) -> int:
+        size = getattr(data, "total_bytes", None) or getattr(data, "nbytes", 0)
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is not None and entry.sealed:
+                return entry.size  # idempotent re-put
+            entry = _Entry(data=data, error=error, size=size)
+            self._entries[object_id] = entry
+            callbacks = self._get_callbacks.pop(object_id, [])
+            self._lock.notify_all()
+        for cb in callbacks:
+            cb(entry)
+        return size
+
+    def put_error(self, object_id: ObjectID, error: BaseException):
+        self.put(object_id, None, error=error)
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            e = self._entries.get(object_id)
+            return e is not None and e.sealed
+
+    def get_entry(self, object_id: ObjectID) -> Optional[_Entry]:
+        with self._lock:
+            return self._entries.get(object_id)
+
+    def get(self, object_id: ObjectID, timeout: Optional[float] = None) -> _Entry:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                e = self._entries.get(object_id)
+                if e is not None and e.sealed:
+                    e.last_access = time.monotonic()
+                    return e
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise exceptions.GetTimeoutError(
+                        f"Get timed out for {object_id}")
+                self._lock.wait(timeout=remaining if remaining is None
+                                else min(remaining, 0.5))
+
+    def get_async(self, object_id: ObjectID, cb: Callable[[_Entry], None]):
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is not None and e.sealed:
+                pass
+            else:
+                self._get_callbacks.setdefault(object_id, []).append(cb)
+                return
+        cb(e)
+
+    def delete(self, object_id: ObjectID):
+        with self._lock:
+            self._entries.pop(object_id, None)
+            self._get_callbacks.pop(object_id, None)
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+class NodeObjectStore:
+    """Plasma-equivalent per-node store with capacity, pinning and spilling.
+
+    Reference behaviors kept: create/seal lifecycle, primary-copy pinning
+    (``local_object_manager.h:37``), spill-over-threshold with batched
+    writes, restore-on-demand, delete-when-out-of-scope, fallback allocation
+    never fails hard (OOM raises only if spilling cannot reclaim).
+    """
+
+    def __init__(self, node_id, capacity_bytes: int, spill_dir: str,
+                 spill_threshold: float = 0.8, native_backend=None):
+        self.node_id = node_id
+        self.capacity = capacity_bytes
+        self.spill_threshold = spill_threshold
+        self.spill_dir = spill_dir
+        os.makedirs(spill_dir, exist_ok=True)
+        self._lock = threading.Condition()
+        self._entries: Dict[ObjectID, _Entry] = {}
+        self._used = 0
+        self._native = native_backend  # ray_tpu.native shm store, optional
+        self.stats = {"spilled_bytes": 0, "restored_bytes": 0,
+                      "spilled_objects": 0, "restored_objects": 0,
+                      "evicted_objects": 0}
+
+    # ---- create/seal (plasma lifecycle) --------------------------------
+    def put(self, object_id: ObjectID, data, pin: bool = True) -> int:
+        size = getattr(data, "total_bytes", None) or getattr(data, "nbytes", 0)
+        with self._lock:
+            if object_id in self._entries and self._entries[object_id].sealed:
+                return self._entries[object_id].size
+            self._ensure_capacity(size)
+            e = _Entry(data=data, size=size)
+            e.pin_count = 1 if pin else 0
+            if self._native is not None and isinstance(data, SerializedObject) \
+                    and not e.is_device:
+                try:
+                    self._native.put(object_id.binary(), data.to_bytes())
+                    e.data = _NativeHandle(self._native, object_id.binary(), size)
+                except Exception:
+                    pass  # fall back to holding the python-side buffers
+            self._entries[object_id] = e
+            self._used += size
+            self._lock.notify_all()
+            return size
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            e = self._entries.get(object_id)
+            return e is not None and e.sealed
+
+    def get(self, object_id: ObjectID) -> Optional[_Entry]:
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is None:
+                return None
+            e.last_access = time.monotonic()
+            if e.data is None and e.spilled_path is not None:
+                self._restore(object_id, e)
+            return e
+
+    def get_serialized(self, object_id: ObjectID) -> Optional[SerializedObject]:
+        e = self.get(object_id)
+        if e is None:
+            return None
+        data = e.data
+        if isinstance(data, _NativeHandle):
+            return SerializedObject.from_bytes(data.read())
+        if isinstance(data, DeviceObject):
+            return data.to_serialized()
+        return data
+
+    def pin(self, object_id: ObjectID):
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is not None:
+                e.pin_count += 1
+
+    def unpin(self, object_id: ObjectID):
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is not None and e.pin_count > 0:
+                e.pin_count -= 1
+
+    def delete(self, object_id: ObjectID):
+        with self._lock:
+            e = self._entries.pop(object_id, None)
+            if e is None:
+                return
+            self._used -= e.size if e.data is not None else 0
+            if isinstance(e.data, _NativeHandle):
+                e.data.delete()
+            if e.spilled_path:
+                try:
+                    os.unlink(e.spilled_path)
+                except OSError:
+                    pass
+
+    # ---- capacity / spilling -------------------------------------------
+    def _ensure_capacity(self, incoming: int):
+        # Must hold lock.  Spill least-recently-used unpinned-or-pinned
+        # entries until the incoming object fits under the threshold.
+        limit = int(self.capacity * self.spill_threshold)
+        if self._used + incoming <= limit:
+            return
+        candidates = sorted(
+            ((e.last_access, oid) for oid, e in self._entries.items()
+             if e.data is not None and not e.is_device),
+            key=lambda t: t[0])
+        for _, oid in candidates:
+            if self._used + incoming <= limit:
+                break
+            self._spill(oid, self._entries[oid])
+        if self._used + incoming > self.capacity:
+            raise exceptions.ObjectStoreFullError(
+                f"Object of {incoming} bytes exceeds store capacity "
+                f"({self._used}/{self.capacity} used; spilling exhausted)")
+
+    def _spill(self, object_id: ObjectID, e: _Entry):
+        data = e.data
+        if isinstance(data, _NativeHandle):
+            blob = data.read()
+            data.delete()
+        elif isinstance(data, DeviceObject):
+            blob = data.to_serialized().to_bytes()
+        else:
+            blob = data.to_bytes()
+        path = os.path.join(self.spill_dir, object_id.hex())
+        with open(path, "wb") as f:
+            f.write(blob)
+        e.spilled_path = path
+        e.data = None
+        self._used -= e.size
+        self.stats["spilled_bytes"] += len(blob)
+        self.stats["spilled_objects"] += 1
+
+    def _restore(self, object_id: ObjectID, e: _Entry):
+        with open(e.spilled_path, "rb") as f:
+            blob = f.read()
+        e.data = SerializedObject.from_bytes(blob)
+        self._used += e.size
+        self.stats["restored_bytes"] += len(blob)
+        self.stats["restored_objects"] += 1
+
+    def spill_now(self) -> int:
+        """Force-spill all unpinned entries (test/chaos hook)."""
+        n = 0
+        with self._lock:
+            for oid, e in list(self._entries.items()):
+                if e.data is not None and not e.is_device:
+                    self._spill(oid, e)
+                    n += 1
+        return n
+
+    def used_bytes(self) -> int:
+        with self._lock:
+            return self._used
+
+    def num_objects(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+class InPlasmaMarker:
+    """Memory-store marker: the value's bytes live in a node store.
+
+    Sealed into the owner's memory store when a large return value lands in
+    a node store, so owner-side waits unblock promptly (the reference's
+    "in plasma" error-code reply on the Get path).
+    """
+
+    __slots__ = ("node_id", "total_bytes")
+
+    def __init__(self, node_id):
+        self.node_id = node_id
+        self.total_bytes = 0
+
+
+class _NativeHandle:
+    """Handle to an object held by the native C++ shm store."""
+
+    __slots__ = ("store", "key", "nbytes")
+
+    def __init__(self, store, key: bytes, nbytes: int):
+        self.store = store
+        self.key = key
+        self.nbytes = nbytes
+
+    def read(self) -> bytes:
+        return self.store.get(self.key)
+
+    def delete(self):
+        try:
+            self.store.delete(self.key)
+        except Exception:
+            pass
+
+
+def entry_value(entry: _Entry):
+    """Deserialize an entry to its Python value (raising stored errors)."""
+    if entry.error is not None:
+        raise entry.error
+    data = entry.data
+    if isinstance(data, DeviceObject):
+        return data.value
+    if isinstance(data, _NativeHandle):
+        return deserialize(SerializedObject.from_bytes(data.read()))
+    return deserialize(data)

@@ -1,0 +1,228 @@
+"""The per-node daemon: scheduler + worker pool + object store.
+
+Parity: reference ``src/ray/raylet/node_manager.cc`` (NodeManager implements
+the NodeManagerService: RequestWorkerLease/ReturnWorker (:1629), PG bundle
+2PC, periodic ``ScheduleAndDispatchTasks`` tick (:392-394), debug dump) and
+``src/ray/raylet/main.cc`` (raylet process = plasma store in-process +
+NodeManager).  Here a Raylet is an in-process object with its own event loop
+and worker threads; the lease/return/2PC surface is identical so a gRPC
+transport can be slotted in front of it for multi-host deployments.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from ray_tpu._private.config import get_config
+from ray_tpu._private.cluster_task_manager import ClusterTaskManager
+from ray_tpu._private.event_loop import EventLoop
+from ray_tpu._private.ids import NodeID, PlacementGroupID
+from ray_tpu._private.local_task_manager import LocalTaskManager
+from ray_tpu._private.object_manager import NodeObjectManager
+from ray_tpu._private.object_store import NodeObjectStore
+from ray_tpu._private.task_spec import TaskSpec
+from ray_tpu._private.worker_pool import WorkerPool
+from ray_tpu.scheduler.bundle_packing import bundle_resource_names
+from ray_tpu.scheduler.resources import (
+    ClusterResourceView, NodeResources, ResourceRequest, _quantize)
+
+
+class Raylet:
+    def __init__(self, cluster, resources: Dict[str, float],
+                 node_name: str = "", labels: Optional[Dict] = None,
+                 object_store_memory: Optional[int] = None):
+        cfg = get_config()
+        self.cluster = cluster
+        self.node_id = NodeID.from_random()
+        self.node_name = node_name or f"node-{self.node_id.hex()[:8]}"
+        self.local_resources = NodeResources(resources, labels=labels)
+        self.cluster_view = ClusterResourceView()   # local (dirty) view
+        self.loop = EventLoop(f"raylet-{self.node_id.hex()[:6]}")
+        self.object_store = NodeObjectStore(
+            self.node_id,
+            object_store_memory or cfg.object_store_memory,
+            spill_dir=f"{cfg.temp_dir}/spill/{self.node_id.hex()[:8]}",
+            spill_threshold=cfg.object_spilling_threshold,
+            native_backend=_maybe_native_store(cfg))
+        self.worker_pool = WorkerPool(self)
+        self.local_task_manager = LocalTaskManager(self)
+        self.cluster_task_manager = ClusterTaskManager(self)
+        self.object_manager = NodeObjectManager(self, cluster.object_directory)
+        self.core_worker = None      # wired by the cluster/driver
+        self._dead = False
+        # Bundles: (pg_id, idx) -> ResourceRequest, prepared or committed.
+        self._prepared_bundles: Dict = {}
+        self._committed_bundles: Dict = {}
+        # Periodic scheduling tick (node_manager.cc:392-394).
+        self.loop.schedule_every(cfg.event_loop_tick_ms / 1000.0,
+                                 self.cluster_task_manager.schedule_and_dispatch,
+                                 "raylet.schedule_tick")
+        # Heartbeats to GCS.
+        self.loop.schedule_every(
+            cfg.raylet_heartbeat_period_milliseconds / 1000.0,
+            self._heartbeat, "raylet.heartbeat")
+        # Seed own view.
+        self.cluster_view.add_node(self.node_id, self.local_resources)
+
+    # ---- GCS-facing -----------------------------------------------------
+    def node_info(self) -> dict:
+        return {
+            "node_id": self.node_id.hex(),
+            "node_name": self.node_name,
+            "alive": True,
+            "resources": self.local_resources.to_float_dict("total"),
+            "labels": dict(self.local_resources.labels),
+        }
+
+    def get_resource_report(self) -> dict:
+        return {
+            "available": self.local_resources.to_float_dict("available"),
+            "total": self.local_resources.to_float_dict("total"),
+            "load": {"queued": self.cluster_task_manager.num_queued(),
+                     "dispatch": self.local_task_manager.num_queued()},
+        }
+
+    def update_resource_usage(self, batch: dict):
+        """Apply the GCS broadcast to the local (dirty) view
+        (grpc_based_resource_broadcaster parity)."""
+        if self._dead:
+            return
+        known = set(self.cluster_view.node_ids())
+        for node_id, usage in batch.items():
+            if node_id == self.node_id:
+                continue
+            if node_id not in known:
+                nr = NodeResources(usage["total"])
+                nr.available = {k: _quantize(v)
+                                for k, v in usage["available"].items()}
+                self.cluster_view.add_node(node_id, nr)
+                self.cluster_task_manager.on_cluster_changed()
+            else:
+                self.cluster_view.update_available(node_id,
+                                                   usage["available"])
+        for node_id in known - set(batch.keys()) - {self.node_id}:
+            self.cluster_view.remove_node(node_id)
+        self.cluster_task_manager.on_cluster_changed()
+
+    def _heartbeat(self):
+        if not self._dead:
+            self.cluster.gcs.heartbeat_manager.heartbeat(self.node_id)
+
+    # ---- lease protocol (NodeManagerService) ----------------------------
+    def request_worker_lease(self, spec: TaskSpec, reply: Callable):
+        """HandleRequestWorkerLease (node_manager.cc:1629)."""
+        if self._dead:
+            reply({"rejected": True, "reason": "node dead"})
+            return
+        self.cluster_task_manager.queue_and_schedule(spec, reply)
+
+    def return_worker(self, worker, disconnect: bool = False):
+        """HandleReturnWorker: release lease + resources."""
+        self.local_task_manager.release_worker_resources(worker)
+        if disconnect:
+            worker.stop()
+        else:
+            self.worker_pool.push_worker(worker)
+        # A freed worker slot may unblock the dispatch queue.
+        self.loop.post(self.local_task_manager.dispatch, "local.dispatch")
+
+    def on_actor_worker_exit(self, actor_id, worker_id):
+        self.local_task_manager.release_worker_resources(
+            _WorkerIdHolder(worker_id))
+        self.cluster.gcs.actor_manager.on_actor_worker_died(
+            actor_id, "worker exited")
+
+    # ---- placement group 2PC (node_manager.proto:319-330) ---------------
+    def prepare_bundle_resources(self, pg_id: PlacementGroupID, idx: int,
+                                 req: ResourceRequest) -> bool:
+        if self._dead:
+            return False
+        if (pg_id, idx) in self._prepared_bundles or \
+                (pg_id, idx) in self._committed_bundles:
+            return True
+        if not self.local_resources.allocate(req):
+            return False
+        self._prepared_bundles[(pg_id, idx)] = req
+        return True
+
+    def commit_bundle_resources(self, pg_id: PlacementGroupID, idx: int,
+                                req: ResourceRequest):
+        self._prepared_bundles.pop((pg_id, idx), None)
+        self._committed_bundles[(pg_id, idx)] = req
+        # Add the formatted PG resources to this node (bundle_spec.h).
+        formatted = bundle_resource_names(pg_id, idx, req)
+        for name, amount in formatted.items():
+            q = _quantize(amount)
+            self.local_resources.total[name] = \
+                self.local_resources.total.get(name, 0) + q
+            self.local_resources.available[name] = \
+                self.local_resources.available.get(name, 0) + q
+        self.cluster_view.update_node(self.node_id, self.local_resources)
+        self.cluster_task_manager.on_cluster_changed()
+
+    def cancel_resource_reserve(self, pg_id: PlacementGroupID, idx: int):
+        req = self._prepared_bundles.pop((pg_id, idx), None)
+        if req is not None:
+            self.local_resources.release(req)
+            return
+        req = self._committed_bundles.pop((pg_id, idx), None)
+        if req is None:
+            return
+        formatted = bundle_resource_names(pg_id, idx, req)
+        for name, amount in formatted.items():
+            q = _quantize(amount)
+            self.local_resources.total[name] = max(
+                0, self.local_resources.total.get(name, 0) - q)
+            self.local_resources.available[name] = max(
+                0, self.local_resources.available.get(name, 0) - q)
+            if self.local_resources.total.get(name) == 0:
+                self.local_resources.total.pop(name, None)
+                self.local_resources.available.pop(name, None)
+        self.local_resources.release(req)
+        self.cluster_view.update_node(self.node_id, self.local_resources)
+
+    # ---- lifecycle ------------------------------------------------------
+    def kill(self):
+        """Simulated hard node death (chaos testing: NodeKillerActor
+        parity) — stops heartbeating and drops all state."""
+        self._dead = True
+        self.worker_pool.shutdown()
+        self.loop.stop()
+
+    def shutdown(self):
+        self._dead = True
+        self.cluster.gcs.unregister_raylet(self.node_id)
+        self.worker_pool.shutdown()
+        self.loop.stop()
+
+    def debug_string(self) -> str:
+        return (f"Raylet {self.node_name} ({self.node_id.hex()[:8]}): "
+                f"res={self.local_resources.to_float_dict('available')} "
+                f"queues={self.cluster_task_manager.debug_state()} "
+                f"workers={self.worker_pool.num_total()} "
+                f"objects={self.object_store.num_objects()}")
+
+
+class _WorkerIdHolder:
+    __slots__ = ("worker_id",)
+
+    def __init__(self, worker_id):
+        self.worker_id = worker_id
+
+
+_native_store_failed = False
+
+
+def _maybe_native_store(cfg):
+    """Load the native C++ shm store if built (ray_tpu/native)."""
+    global _native_store_failed
+    if not cfg.use_native_object_store or _native_store_failed:
+        return None
+    try:
+        from ray_tpu.native import shm_store
+        return shm_store.open_store()
+    except Exception:
+        _native_store_failed = True
+        return None

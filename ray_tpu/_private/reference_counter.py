@@ -1,0 +1,177 @@
+"""Distributed reference counting for objects.
+
+Parity target: reference ``src/ray/core_worker/reference_count.h:61`` —
+per-owner counts of (a) local Python refs, (b) refs held by submitted pending
+tasks, (c) borrowers, (d) nested objects contained in still-live outer
+objects, plus lineage pinning so a freed-but-reconstructable object's creating
+task spec is retained.
+
+The reference implementation is 1,480 LoC of distributed edge cases because
+borrower sets are reconciled over RPC.  In this runtime the owner's table is
+authoritative in-process and borrower registration is a direct call, so the
+protocol collapses to a single table — but the *semantics* (an object is
+freeable only when local + submitted-task + borrower + contained counts are
+all zero) are identical and tested identically.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Set
+
+from ray_tpu._private.ids import ObjectID, TaskID
+
+
+class Reference:
+    __slots__ = ("local_refs", "submitted_task_refs", "borrowers",
+                 "contained_in", "contains", "owned", "lineage_task_id",
+                 "on_delete", "pinned_node", "spilled_url", "out_of_scope")
+
+    def __init__(self, owned: bool = True):
+        self.local_refs = 0
+        self.submitted_task_refs = 0
+        self.borrowers: Set = set()
+        # Outer object ids whose values contain this object id.
+        self.contained_in: Set[ObjectID] = set()
+        self.contains: Set[ObjectID] = set()
+        self.owned = owned
+        # Task that created this object — retained while reconstruction is
+        # possible (lineage pinning, ray_config_def.h:97).
+        self.lineage_task_id: Optional[TaskID] = None
+        self.on_delete: List[Callable[[ObjectID], None]] = []
+        self.pinned_node = None
+        self.spilled_url: Optional[str] = None
+        self.out_of_scope = False
+
+    def total(self) -> int:
+        return (self.local_refs + self.submitted_task_refs +
+                len(self.borrowers) + len(self.contained_in))
+
+
+class ReferenceCounter:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._refs: Dict[ObjectID, Reference] = {}
+        self._delete_subscribers: List[Callable[[ObjectID], None]] = []
+
+    # ---- registration ---------------------------------------------------
+    def add_owned_object(self, object_id: ObjectID,
+                        lineage_task_id: Optional[TaskID] = None,
+                        contained_ids: Optional[List[ObjectID]] = None):
+        with self._lock:
+            ref = self._refs.setdefault(object_id, Reference(owned=True))
+            ref.owned = True
+            ref.lineage_task_id = lineage_task_id
+            for inner in contained_ids or []:
+                ref.contains.add(inner)
+                inner_ref = self._refs.setdefault(inner, Reference(owned=False))
+                inner_ref.contained_in.add(object_id)
+
+    def add_borrowed_object(self, object_id: ObjectID, borrower) -> None:
+        with self._lock:
+            ref = self._refs.setdefault(object_id, Reference(owned=False))
+            ref.borrowers.add(borrower)
+
+    def remove_borrower(self, object_id: ObjectID, borrower) -> None:
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref is None:
+                return
+            ref.borrowers.discard(borrower)
+            self._maybe_delete(object_id)
+
+    # ---- local refs (ObjectRef ctor/dtor) -------------------------------
+    def add_local_ref(self, object_id: ObjectID):
+        with self._lock:
+            self._refs.setdefault(object_id, Reference()).local_refs += 1
+
+    def remove_local_ref(self, object_id: ObjectID):
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref is None:
+                return
+            ref.local_refs -= 1
+            self._maybe_delete(object_id)
+
+    # ---- task-arg refs --------------------------------------------------
+    def add_submitted_task_refs(self, object_ids: List[ObjectID]):
+        with self._lock:
+            for oid in object_ids:
+                self._refs.setdefault(oid, Reference()).submitted_task_refs += 1
+
+    def remove_submitted_task_refs(self, object_ids: List[ObjectID]):
+        with self._lock:
+            for oid in object_ids:
+                ref = self._refs.get(oid)
+                if ref is None:
+                    continue
+                ref.submitted_task_refs -= 1
+                self._maybe_delete(oid)
+
+    # ---- queries --------------------------------------------------------
+    def has_reference(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            ref = self._refs.get(object_id)
+            return ref is not None and not ref.out_of_scope
+
+    def ref_count(self, object_id: ObjectID) -> int:
+        with self._lock:
+            ref = self._refs.get(object_id)
+            return 0 if ref is None or ref.out_of_scope else ref.total()
+
+    def lineage_task(self, object_id: ObjectID) -> Optional[TaskID]:
+        with self._lock:
+            ref = self._refs.get(object_id)
+            return ref.lineage_task_id if ref else None
+
+    def num_tracked(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._refs.values() if not r.out_of_scope)
+
+    def set_pinned_node(self, object_id: ObjectID, node_id):
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref is not None:
+                ref.pinned_node = node_id
+
+    def set_spilled_url(self, object_id: ObjectID, url: str):
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref is not None:
+                ref.spilled_url = url
+
+    # ---- deletion -------------------------------------------------------
+    def subscribe_deleted(self, cb: Callable[[ObjectID], None]):
+        """Register a callback fired when an object goes out of scope
+        (the object store uses this to evict the value)."""
+        with self._lock:
+            self._delete_subscribers.append(cb)
+
+    def add_on_delete(self, object_id: ObjectID, cb: Callable[[ObjectID], None]):
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref is None or ref.out_of_scope:
+                cb(object_id)
+            else:
+                ref.on_delete.append(cb)
+
+    def _maybe_delete(self, object_id: ObjectID):
+        # Must hold self._lock.
+        ref = self._refs.get(object_id)
+        if ref is None or ref.out_of_scope or ref.total() > 0:
+            return
+        ref.out_of_scope = True
+        # Releasing an outer object releases the contained-in edges of its
+        # inner objects — possibly cascading (reference: nested refs).
+        for inner in ref.contains:
+            inner_ref = self._refs.get(inner)
+            if inner_ref is not None:
+                inner_ref.contained_in.discard(object_id)
+                self._maybe_delete(inner)
+        callbacks = ref.on_delete + self._delete_subscribers
+        del self._refs[object_id]
+        for cb in callbacks:
+            try:
+                cb(object_id)
+            except Exception:
+                pass

@@ -1,0 +1,153 @@
+"""Object serialization for the store and task-arg path.
+
+TPU-native equivalent of the reference serializer
+(``python/ray/serialization.py:413`` — cloudpickle for code/closures,
+zero-copy numpy via pickle-protocol-5 out-of-band buffers, nested
+``ObjectRef`` capture for distributed refcounting).
+
+Design points kept from the reference:
+  * values are immutable once stored — we serialize on ``put`` so later
+    mutation of the Python object cannot leak into the store;
+  * numpy / jax host buffers go out-of-band (no copy into the pickle
+    stream), and deserialization reconstructs views over the stored
+    buffers — the zero-copy read path;
+  * ``ObjectRef``\\s contained in a value are collected during
+    serialization so the owner can register borrows
+    (reference: ``serialization.py`` ``_make_serialization_context`` +
+    reference_count borrowing protocol).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import threading
+from typing import Any, List, Tuple
+
+import cloudpickle
+
+
+class SerializedObject:
+    """An immutable serialized value: inband pickle bytes + raw buffers."""
+
+    __slots__ = ("inband", "buffers", "contained_refs", "metadata")
+
+    def __init__(self, inband: bytes, buffers: List[memoryview],
+                 contained_refs: list, metadata: bytes = b""):
+        self.inband = inband
+        self.buffers = buffers
+        self.contained_refs = contained_refs
+        self.metadata = metadata
+
+    @property
+    def total_bytes(self) -> int:
+        return len(self.inband) + sum(b.nbytes for b in self.buffers)
+
+    def to_bytes(self) -> bytes:
+        """Flatten to a single contiguous blob (for spilling / transfer)."""
+        out = io.BytesIO()
+        header = pickle.dumps(
+            (len(self.inband), [b.nbytes for b in self.buffers]), protocol=5)
+        out.write(len(header).to_bytes(8, "little"))
+        out.write(header)
+        out.write(self.inband)
+        for b in self.buffers:
+            out.write(b)
+        return out.getvalue()
+
+    @classmethod
+    def from_bytes(cls, blob: bytes) -> "SerializedObject":
+        hlen = int.from_bytes(blob[:8], "little")
+        inband_len, buf_lens = pickle.loads(blob[8:8 + hlen])
+        off = 8 + hlen
+        inband = blob[off:off + inband_len]
+        off += inband_len
+        buffers = []
+        mv = memoryview(blob)
+        for n in buf_lens:
+            buffers.append(mv[off:off + n])
+            off += n
+        return cls(inband, buffers, [])
+
+
+_thread_local = threading.local()
+
+
+def _is_object_ref(obj) -> bool:
+    # Late import to avoid a cycle; ObjectRef lives in object_ref.py.
+    from ray_tpu._private.object_ref import ObjectRef
+    return isinstance(obj, ObjectRef)
+
+
+class _Pickler(cloudpickle.CloudPickler):
+    """Cloudpickle with out-of-band buffer capture and ref collection."""
+
+    def __init__(self, file, buffers_out, refs_out):
+        super().__init__(file, protocol=5,
+                         buffer_callback=lambda b: buffers_out.append(b) or False)
+        self._refs_out = refs_out
+
+    def persistent_id(self, obj):
+        return None
+
+    def reducer_override(self, obj):
+        if _is_object_ref(obj):
+            self._refs_out.append(obj)
+            return (_deserialize_ref_placeholder,
+                    (obj.binary(), obj.owner_id_binary()))
+        return super().reducer_override(obj)
+
+
+def _deserialize_ref_placeholder(binary: bytes, owner_binary):
+    from ray_tpu._private.object_ref import ObjectRef
+    from ray_tpu._private.ids import ObjectID, WorkerID
+    owner = WorkerID(owner_binary) if owner_binary else None
+    ref = ObjectRef(ObjectID(binary), owner_id=owner, skip_adding_local_ref=False)
+    return ref
+
+
+def serialize(value: Any) -> SerializedObject:
+    """Serialize ``value`` with zero-copy buffer capture.
+
+    Numpy arrays (and anything exporting pickle-5 buffers) contribute
+    out-of-band ``memoryview`` buffers; jax device arrays are brought to host
+    as numpy first (device residency is handled one level up by the
+    device-object extension in the object store).
+    """
+    value = _device_to_host(value)
+    buffers: List[pickle.PickleBuffer] = []
+    refs: list = []
+    f = io.BytesIO()
+    _Pickler(f, buffers, refs).dump(value)
+    views = [b.raw() for b in buffers]
+    return SerializedObject(f.getvalue(), views, refs)
+
+
+def deserialize(s: SerializedObject) -> Any:
+    return pickle.loads(s.inband, buffers=[bytes(b) if isinstance(b, memoryview)
+                                           and not b.contiguous else b
+                                           for b in s.buffers])
+
+
+def _device_to_host(value):
+    """Convert jax arrays to numpy on serialization boundaries.
+
+    jax arrays are XLA-managed device buffers; passing them through the host
+    object store requires a device->host copy.  Actor-to-actor device handoff
+    avoids this path entirely (see object_store.DeviceObject).
+    """
+    import sys
+    jax = sys.modules.get("jax")
+    if jax is not None and isinstance(value, jax.Array):
+        import numpy as np
+        return np.asarray(value)
+    return value
+
+
+def dumps_function(fn) -> bytes:
+    """Pickle user code/closures (reference: function_manager export path)."""
+    return cloudpickle.dumps(fn)
+
+
+def loads_function(blob: bytes):
+    return cloudpickle.loads(blob)

@@ -1,0 +1,141 @@
+"""Owner-side task state: pending set, retries, lineage.
+
+Parity: reference ``src/ray/core_worker/task_manager.{h,cc}`` — tracks every
+submitted task until its returns are sealed; retries failed tasks up to
+``max_retries``; pins task specs for lineage reconstruction
+(``lineage_pinning_enabled``); resubmits the creating task when a lost
+object must be reconstructed (``object_recovery_manager.cc``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+from ray_tpu import exceptions
+from ray_tpu._private.config import get_config
+from ray_tpu._private.ids import ObjectID, TaskID
+from ray_tpu._private.task_spec import TaskSpec
+
+
+class _PendingTask:
+    __slots__ = ("spec", "retries_left", "status")
+
+    def __init__(self, spec: TaskSpec, retries_left: int):
+        self.spec = spec
+        self.retries_left = retries_left
+        self.status = "PENDING"
+
+
+class TaskManager:
+    def __init__(self, core_worker):
+        self._core = core_worker
+        self._lock = threading.RLock()
+        self._pending: Dict[TaskID, _PendingTask] = {}
+        # Lineage: task specs pinned while their return objects may need
+        # reconstruction (reference: TaskManager lineage map).
+        self._lineage: Dict[TaskID, TaskSpec] = {}
+        self._completion_cv = threading.Condition(self._lock)
+
+    # ---- submission lifecycle ------------------------------------------
+    def add_pending_task(self, spec: TaskSpec) -> None:
+        cfg = get_config()
+        with self._lock:
+            self._pending[spec.task_id] = _PendingTask(spec, spec.max_retries)
+            if cfg.lineage_pinning_enabled:
+                self._lineage[spec.task_id] = spec
+        # Register owned return objects with lineage pointers.
+        rc = self._core.reference_counter
+        for oid in spec.return_ids:
+            rc.add_owned_object(oid, lineage_task_id=spec.task_id)
+        rc.add_submitted_task_refs(spec.arg_object_ids())
+
+    def is_pending(self, task_id: TaskID) -> bool:
+        with self._lock:
+            return task_id in self._pending
+
+    def num_pending(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def get_spec(self, task_id: TaskID) -> Optional[TaskSpec]:
+        with self._lock:
+            t = self._pending.get(task_id)
+            if t is not None:
+                return t.spec
+            return self._lineage.get(task_id)
+
+    # ---- completion/failure (called from transport) ---------------------
+    def complete_task(self, spec: TaskSpec):
+        with self._lock:
+            self._pending.pop(spec.task_id, None)
+            self._completion_cv.notify_all()
+        self._core.reference_counter.remove_submitted_task_refs(
+            spec.arg_object_ids())
+
+    def fail_or_retry(self, spec: TaskSpec, error: BaseException,
+                      resubmit: Callable[[TaskSpec], None]) -> bool:
+        """Returns True if the task will be retried."""
+        retryable = isinstance(error, (exceptions.WorkerCrashedError,
+                                       exceptions.NodeDiedError)) or \
+            (spec.retry_exceptions and isinstance(error, exceptions.TaskError))
+        with self._lock:
+            t = self._pending.get(spec.task_id)
+            if t is None:
+                return False
+            if retryable and t.retries_left > 0:
+                t.retries_left -= 1
+                do_retry = True
+            elif not retryable and not isinstance(error, exceptions.TaskError) \
+                    and t.retries_left > 0:
+                # System failures (lease/dispatch) always consume a retry.
+                t.retries_left -= 1
+                do_retry = True
+            else:
+                do_retry = False
+        if do_retry:
+            resubmit(spec)
+            return True
+        self.fail_task(spec, error)
+        return False
+
+    def fail_task(self, spec: TaskSpec, error: BaseException):
+        """Store the error into all return objects so gets raise."""
+        with self._lock:
+            self._pending.pop(spec.task_id, None)
+            self._completion_cv.notify_all()
+        for oid in spec.return_ids:
+            self._core.memory_store.put_error(oid, _user_error(error))
+        self._core.reference_counter.remove_submitted_task_refs(
+            spec.arg_object_ids())
+
+    # ---- lineage / reconstruction ---------------------------------------
+    def lineage_spec_for_object(self, object_id: ObjectID) -> Optional[TaskSpec]:
+        with self._lock:
+            return self._lineage.get(object_id.task_id())
+
+    def evict_lineage(self, task_id: TaskID):
+        with self._lock:
+            self._lineage.pop(task_id, None)
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until no tasks are pending (driver exit parity)."""
+        import time
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while self._pending:
+                remaining = None if deadline is None else \
+                    deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._completion_cv.wait(timeout=remaining if remaining is None
+                                         else min(remaining, 0.5))
+            return True
+
+
+def _user_error(error: BaseException) -> BaseException:
+    if isinstance(error, exceptions.TaskError):
+        return error
+    if isinstance(error, exceptions.RayTpuError):
+        return error
+    return exceptions.RayTpuError(str(error))

@@ -1,0 +1,290 @@
+"""Driver bootstrap: the global worker + init/shutdown.
+
+Parity: reference ``python/ray/worker.py`` — ``init`` (:683) starts/connects
+the cluster (head path: Redis -> GCS -> raylet -> monitor -> dashboard,
+node.py:1064; here: GcsServer + head Raylet + driver CoreWorker),
+``shutdown``, the global-worker singleton, and the public
+``get/put/wait/kill/cancel/get_actor`` entry points re-exported from
+``ray_tpu/__init__.py``.
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+from typing import Any, List, Optional, Sequence, Tuple
+
+from ray_tpu import exceptions
+from ray_tpu._private import worker_context
+from ray_tpu._private.config import get_config, initialize_config
+from ray_tpu._private.core_worker import CoreWorker
+from ray_tpu._private.ids import JobID
+from ray_tpu._private.object_ref import ObjectRef
+
+
+class Worker:
+    """The per-process global worker (driver side)."""
+
+    def __init__(self):
+        self.connected = False
+        self.cluster = None
+        self.core_worker: Optional[CoreWorker] = None
+        self.job_id: Optional[JobID] = None
+        self.namespace: str = ""
+        self.mode: Optional[str] = None
+
+
+_global_worker: Optional[Worker] = None
+_init_lock = threading.RLock()
+
+
+def global_worker() -> Worker:
+    global _global_worker
+    with _init_lock:
+        if _global_worker is None:
+            _global_worker = Worker()
+        return _global_worker
+
+
+def global_worker_or_none() -> Optional[Worker]:
+    return _global_worker
+
+
+def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
+         num_tpus: Optional[float] = None, num_gpus: Optional[float] = None,
+         resources: Optional[dict] = None, object_store_memory: Optional[int] = None,
+         namespace: str = "", job_config: Optional[dict] = None,
+         ignore_reinit_error: bool = False, _system_config: Optional[dict] = None,
+         _cluster=None, **kwargs):
+    """Start (or connect to) a cluster and attach this driver.
+
+    ``address=None`` starts a new in-process cluster with one head node
+    (reference head path, worker.py:683 + node.py:1064).  ``_cluster``
+    attaches to an existing :class:`ray_tpu._private.cluster.Cluster`
+    (cluster_utils test path).
+    """
+    w = global_worker()
+    with _init_lock:
+        if w.connected:
+            if ignore_reinit_error:
+                return RuntimeContextInfo(w)
+            raise RuntimeError("ray_tpu.init() called twice; pass "
+                              "ignore_reinit_error=True to ignore.")
+        initialize_config(_system_config)
+        from ray_tpu._private.cluster import Cluster
+        if _cluster is not None:
+            cluster = _cluster
+        else:
+            if num_tpus is None:
+                num_tpus = _detect_tpu_chips()
+            head_args = dict(num_cpus=num_cpus, num_tpus=num_tpus or 0,
+                             num_gpus=num_gpus or 0,
+                             object_store_memory=object_store_memory,
+                             resources=resources, node_name="head")
+            cluster = Cluster(initialize_head=True, head_node_args=head_args)
+        w.cluster = cluster
+        w.job_id = JobID.next()
+        w.namespace = namespace or f"anon_ns_{w.job_id.hex()}"
+        w.core_worker = CoreWorker(cluster, w.job_id, is_driver=True)
+        cluster.attach_core_worker(w.core_worker)
+        cluster.gcs.job_manager.add_job(w.job_id, job_config)
+        w.connected = True
+        w.mode = "local" if _cluster is None else "cluster"
+        atexit.register(_atexit_shutdown)
+        return RuntimeContextInfo(w)
+
+
+def shutdown():
+    w = global_worker_or_none()
+    if w is None or not w.connected:
+        return
+    with _init_lock:
+        if w.job_id is not None:
+            try:
+                w.cluster.gcs.job_manager.mark_job_finished(w.job_id)
+            except Exception:
+                pass
+        try:
+            w.cluster.shutdown()
+        except Exception:
+            pass
+        w.connected = False
+        w.cluster = None
+        w.core_worker = None
+        worker_context.clear_context()
+        # Reset scheduling-class interning between clusters to keep ids
+        # stable in long test sessions.
+
+
+def _atexit_shutdown():
+    try:
+        shutdown()
+    except Exception:
+        pass
+
+
+def is_initialized() -> bool:
+    w = global_worker_or_none()
+    return bool(w and w.connected)
+
+
+def _require_connected() -> Worker:
+    w = global_worker()
+    if not w.connected:
+        init()
+    return w
+
+
+def _detect_tpu_chips() -> float:
+    """TPU chips on this host.
+
+    Never *initializes* a jax backend here — first backend init on a real
+    TPU can take tens of seconds and must not sit on the ``init()`` path.
+    Counted only from env (``RAY_TPU_CHIPS``) or from an
+    already-initialized jax backend.
+    """
+    import os
+    import sys
+    if "RAY_TPU_CHIPS" in os.environ:
+        return float(os.environ["RAY_TPU_CHIPS"])
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            from jax._src import xla_bridge
+            if getattr(xla_bridge, "_backends", None):
+                return float(len([d for d in jax.devices()
+                                  if d.platform != "cpu"]))
+        except Exception:
+            return 0.0
+    return 0.0
+
+
+class RuntimeContextInfo:
+    """Return value of init(): address info (client context parity)."""
+
+    def __init__(self, worker: Worker):
+        self.address_info = {
+            "node_id": worker.cluster.head_node.node_id.hex()
+            if worker.cluster.head_node else None,
+            "namespace": worker.namespace,
+        }
+
+    def __getitem__(self, k):
+        return self.address_info[k]
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Public API bodies (re-exported by ray_tpu/__init__.py).
+# ---------------------------------------------------------------------------
+
+def get(refs, timeout: Optional[float] = None):
+    w = _require_connected()
+    if isinstance(refs, ObjectRef):
+        return w.core_worker.get([refs], timeout)[0]
+    if not isinstance(refs, (list, tuple)):
+        raise TypeError(f"get() expects an ObjectRef or list, got {type(refs)}")
+    return w.core_worker.get(list(refs), timeout)
+
+
+def put(value) -> ObjectRef:
+    w = _require_connected()
+    if isinstance(value, ObjectRef):
+        raise TypeError("Calling put() on an ObjectRef is not allowed.")
+    return w.core_worker.put(value)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None,
+         fetch_local: bool = True) -> Tuple[List, List]:
+    w = _require_connected()
+    refs = list(refs)
+    if any(not isinstance(r, ObjectRef) for r in refs):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    if len(set(refs)) != len(refs):
+        raise ValueError("wait() expects unique ObjectRefs")
+    if num_returns > len(refs):
+        raise ValueError("num_returns > number of refs")
+    return w.core_worker.wait(refs, num_returns, timeout, fetch_local)
+
+
+def kill(actor, *, no_restart: bool = True):
+    from ray_tpu.actor import ActorHandle
+    w = _require_connected()
+    if not isinstance(actor, ActorHandle):
+        raise TypeError("kill() expects an ActorHandle")
+    w.cluster.gcs.actor_manager.destroy_actor(actor._actor_id,
+                                              no_restart=no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    """Best-effort task cancellation (core_worker.cc Cancel parity).
+
+    Queued tasks are dequeued and failed with TaskCancelledError; a task
+    already running on a worker thread cannot be preempted (threads, not
+    processes) — it is marked so its result is discarded.
+    """
+    w = _require_connected()
+    task_id = ref.task_id()
+    tm = w.core_worker.task_manager
+    spec = tm.get_spec(task_id)
+    if spec is None or not tm.is_pending(task_id):
+        return
+    tm.fail_task(spec, exceptions.TaskCancelledError(task_id))
+
+
+def get_actor(name: str, namespace: Optional[str] = None):
+    from ray_tpu.actor import ActorHandle
+    w = _require_connected()
+    ns = namespace if namespace is not None else w.namespace
+    actor = w.cluster.gcs.actor_manager.get_named_actor(name, ns)
+    if actor is None:
+        raise ValueError(f"Failed to look up actor {name!r} in namespace "
+                         f"{ns!r}")
+    return ActorHandle._from_gcs_actor(actor)
+
+
+def get_gpu_ids():
+    return []
+
+
+def get_tpu_ids():
+    ctx = worker_context.current_task_spec()
+    if ctx is None:
+        return []
+    n = int(ctx.resources.get("TPU"))
+    return list(range(n))
+
+
+def nodes() -> List[dict]:
+    w = _require_connected()
+    out = []
+    for node_id, info in w.cluster.gcs.node_manager.get_all_node_info().items():
+        entry = dict(info)
+        entry["NodeID"] = node_id.hex()
+        entry["Alive"] = info.get("state") == "ALIVE"
+        entry["Resources"] = info.get("info", info).get("resources", {}) \
+            if "info" in info else info.get("resources", {})
+        out.append(entry)
+    return out
+
+
+def cluster_resources() -> dict:
+    w = _require_connected()
+    return w.cluster.gcs.resource_manager.view.total_cluster_resources()
+
+
+def available_resources() -> dict:
+    w = _require_connected()
+    return w.cluster.gcs.resource_manager.view.available_cluster_resources()
+
+
+def timeline() -> list:
+    w = _require_connected()
+    from ray_tpu.util import tracing
+    return tracing.chrome_tracing_dump()

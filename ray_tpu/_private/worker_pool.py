@@ -1,0 +1,233 @@
+"""Per-node worker pool.
+
+Parity: reference ``src/ray/raylet/worker_pool.{h,cc}`` — pool of
+pre-startable workers, ``PopWorker`` (worker_pool.h:338) /
+``PushWorker`` return, ``PrestartWorkers`` (:350), idle soft-cap with
+eviction (ray_config_def.h:129), dedicated workers for actors.
+
+TPU-first deviation: workers are *threads in the node's process*, not
+subprocesses.  One process per host owns the TPU chips (XLA requires single
+ownership), so Python-level parallelism comes from threads — jax compiled
+computations release the GIL, and framework logic is IO-bound.  The pool
+keeps the reference's lease lifecycle so the scheduler and transport layers
+are identical to a multi-process deployment.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import traceback
+from typing import Callable, Dict, List, Optional
+
+from ray_tpu import exceptions
+from ray_tpu._private import worker_context
+from ray_tpu._private.config import get_config
+from ray_tpu._private.ids import WorkerID
+
+
+class WorkerState:
+    IDLE = "IDLE"
+    LEASED = "LEASED"
+    ACTOR = "ACTOR"
+    DEAD = "DEAD"
+
+
+class Worker:
+    """One executor thread; may become dedicated to an actor."""
+
+    def __init__(self, pool: "WorkerPool", node):
+        self.worker_id = WorkerID.from_random()
+        self.node = node
+        self.node_id = node.node_id
+        self._pool = pool
+        self.state = WorkerState.IDLE
+        self._queue: "queue.Queue" = queue.Queue()
+        self.actor_id = None
+        self.actor_instance = None
+        self._actor_threads: List[threading.Thread] = []
+        self._max_concurrency = 1
+        self._killed = threading.Event()
+        self._thread = threading.Thread(
+            target=self._main_loop, daemon=True,
+            name=f"ray_tpu::worker::{self.worker_id.hex()[:8]}")
+        self._thread.start()
+
+    # ---- normal task path ----------------------------------------------
+    def push_task(self, spec, on_done: Callable):
+        """Execute a normal (or actor-creation) task on this worker
+        (CoreWorkerService.PushTask parity)."""
+        self._queue.put(("task", spec, on_done))
+
+    def assign_actor(self, creation_spec, on_done: Callable):
+        """Run the actor creation task; on success this worker is dedicated
+        to the actor until death."""
+        self._queue.put(("create_actor", creation_spec, on_done))
+
+    def submit_actor_task(self, spec, on_done: Callable):
+        """Ordered actor method execution (sequential_actor_submit_queue
+        parity; max_concurrency>1 uses the out-of-order queue)."""
+        self._queue.put(("actor_task", spec, on_done))
+
+    def kill_actor(self):
+        self._killed.set()
+        self._queue.put(("exit", None, None))
+
+    def stop(self):
+        self._killed.set()
+        self._queue.put(("exit", None, None))
+
+    # ---- main loop ------------------------------------------------------
+    def _main_loop(self):
+        worker_context.set_context(
+            worker_context.ExecutionContext(worker=self, node=self.node))
+        from ray_tpu._private import executor as executor_mod
+        while not self._killed.is_set():
+            try:
+                kind, spec, on_done = self._queue.get(timeout=1.0)
+            except queue.Empty:
+                continue
+            if kind == "exit":
+                break
+            try:
+                if kind == "create_actor":
+                    self._handle_create_actor(spec, on_done, executor_mod)
+                elif kind == "actor_task":
+                    self._run_actor_task(spec, on_done, executor_mod)
+                else:
+                    ok, err = executor_mod.execute_task(
+                        spec, self.node, self.node.core_worker)
+                    on_done(None if ok else err)
+            except Exception as e:  # framework error, not user error
+                traceback.print_exc()
+                if on_done is not None:
+                    on_done(exceptions.RayTpuError(str(e)))
+        self._on_exit()
+
+    def _handle_create_actor(self, spec, on_done, executor_mod):
+        ok, result = executor_mod.execute_task(
+            spec, self.node, self.node.core_worker)
+        if not ok:
+            on_done(result)
+            return
+        self.state = WorkerState.ACTOR
+        self.actor_id = spec.actor_id
+        self.actor_instance = result
+        self._max_concurrency = max(1, spec.max_concurrency)
+        if self._max_concurrency > 1:
+            for i in range(self._max_concurrency - 1):
+                t = threading.Thread(target=self._actor_concurrent_loop,
+                                     daemon=True,
+                                     name=f"{self._thread.name}::cg{i}")
+                t.start()
+                self._actor_threads.append(t)
+        on_done(None)
+
+    def _run_actor_task(self, spec, on_done, executor_mod):
+        ok, err = executor_mod.execute_task(
+            spec, self.node, self.node.core_worker,
+            actor_instance=self.actor_instance)
+        on_done(None if ok else err)
+
+    def _actor_concurrent_loop(self):
+        worker_context.set_context(
+            worker_context.ExecutionContext(worker=self, node=self.node))
+        from ray_tpu._private import executor as executor_mod
+        while not self._killed.is_set():
+            try:
+                kind, spec, on_done = self._queue.get(timeout=1.0)
+            except queue.Empty:
+                continue
+            if kind == "exit":
+                self._queue.put(("exit", None, None))  # propagate to siblings
+                break
+            self._run_actor_task(spec, on_done, executor_mod)
+
+    def _on_exit(self):
+        was_actor = self.state == WorkerState.ACTOR
+        self.state = WorkerState.DEAD
+        self._pool.on_worker_exit(self)
+        if was_actor and self.actor_id is not None:
+            self.node.on_actor_worker_exit(self.actor_id, self.worker_id)
+
+
+class WorkerPool:
+    def __init__(self, node):
+        self._node = node
+        self._lock = threading.Lock()
+        self._idle: List[Worker] = []
+        self._leased: Dict[WorkerID, Worker] = {}
+        self._actors: Dict[WorkerID, Worker] = {}
+        self._all: Dict[WorkerID, Worker] = {}
+        cfg = get_config()
+        self._max_workers = cfg.maximum_startup_concurrency
+        self._soft_limit = cfg.num_workers_soft_limit
+
+    def prestart_workers(self, n: int):
+        with self._lock:
+            for _ in range(n):
+                if len(self._all) >= self._max_workers:
+                    break
+                w = Worker(self, self._node)
+                self._all[w.worker_id] = w
+                self._idle.append(w)
+
+    def pop_worker(self) -> Optional[Worker]:
+        """Lease an idle worker, starting one if under the cap
+        (WorkerPool::PopWorker, worker_pool.h:338)."""
+        with self._lock:
+            while self._idle:
+                w = self._idle.pop()
+                if w.state == WorkerState.IDLE:
+                    w.state = WorkerState.LEASED
+                    self._leased[w.worker_id] = w
+                    return w
+            if len(self._all) < self._max_workers:
+                w = Worker(self, self._node)
+                self._all[w.worker_id] = w
+                w.state = WorkerState.LEASED
+                self._leased[w.worker_id] = w
+                return w
+            return None
+
+    def push_worker(self, worker: Worker):
+        """Return a leased worker to the idle pool."""
+        with self._lock:
+            self._leased.pop(worker.worker_id, None)
+            if worker.state == WorkerState.DEAD:
+                return
+            if worker.state == WorkerState.ACTOR:
+                self._actors[worker.worker_id] = worker
+                return
+            worker.state = WorkerState.IDLE
+            if len(self._idle) >= self._soft_limit:
+                worker.stop()
+            else:
+                self._idle.append(worker)
+
+    def promote_to_actor(self, worker: Worker):
+        with self._lock:
+            self._leased.pop(worker.worker_id, None)
+            self._actors[worker.worker_id] = worker
+
+    def on_worker_exit(self, worker: Worker):
+        with self._lock:
+            self._all.pop(worker.worker_id, None)
+            self._leased.pop(worker.worker_id, None)
+            self._actors.pop(worker.worker_id, None)
+            if worker in self._idle:
+                self._idle.remove(worker)
+
+    def num_idle(self) -> int:
+        with self._lock:
+            return len(self._idle)
+
+    def num_total(self) -> int:
+        with self._lock:
+            return len(self._all)
+
+    def shutdown(self):
+        with self._lock:
+            workers = list(self._all.values())
+        for w in workers:
+            w.stop()

@@ -1,0 +1,168 @@
+"""Actor API: ActorClass / ActorHandle / ActorMethod.
+
+Parity: reference ``python/ray/actor.py`` — ``@remote`` on a class yields an
+``ActorClass``; ``.remote(...)`` registers+schedules the actor via the GCS
+(actor path §3.3 of SURVEY.md); ``ActorHandle.method.remote()`` submits
+ordered actor tasks directly to the actor's worker; handles are serializable
+and named actors are looked up via the GCS.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private import worker_context
+from ray_tpu._private.executor import pack_args
+from ray_tpu._private.ids import ActorID
+from ray_tpu._private.task_spec import TaskType, make_spec
+from ray_tpu.remote_function import _resource_dict, resolve_pg_strategy
+
+_DEFAULT_ACTOR_OPTIONS = dict(
+    num_cpus=1, num_tpus=0, num_gpus=0, memory=0, resources=None,
+    max_restarts=0, max_task_retries=0, max_concurrency=1,
+    name=None, namespace=None, lifetime=None, scheduling_strategy=None,
+    runtime_env=None,
+)
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str,
+                 num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def remote(self, *args, **kwargs):
+        return self._handle._submit_method(
+            self._method_name, args, kwargs, num_returns=self._num_returns)
+
+    def options(self, num_returns: int = 1, **_):
+        return ActorMethod(self._handle, self._method_name,
+                           num_returns=num_returns)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor method {self._method_name!r} cannot be called directly; "
+            f"use .remote().")
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID, class_name: str = ""):
+        self._actor_id = actor_id
+        self._class_name = class_name
+
+    @classmethod
+    def _from_gcs_actor(cls, gcs_actor):
+        return cls(gcs_actor.actor_id,
+                   class_name=gcs_actor.info().get("class_name", ""))
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def _submit_method(self, method_name: str, args, kwargs,
+                       num_returns: int = 1):
+        w = worker_mod.global_worker()
+        core = w.core_worker
+        gcs_actor = w.cluster.gcs.actor_manager.get_actor(self._actor_id)
+        creation = gcs_actor.creation_spec if gcs_actor else None
+        flat = pack_args(args, kwargs)
+        task_args, _, holders = core.build_args(flat)
+        parent = worker_context.current_task_spec()
+        spec = make_spec(
+            job_id=w.job_id,
+            owner_id=core.worker_id,
+            function_id=creation.function_id if creation else None,
+            function_name=f"{self._class_name}.{method_name}",
+            args=task_args,
+            num_returns=num_returns,
+            resources={},   # actor methods use the actor's held resources
+            scheduling_strategy=None,
+            parent_task_id=parent.task_id if parent else core.driver_task_id,
+            task_type=TaskType.ACTOR_TASK,
+            actor_id=self._actor_id,
+            actor_method_name=method_name,
+            max_retries=(creation.max_task_retries if creation else 0),
+        )
+        refs = core.submit_actor_task(spec, holders=holders)
+        if num_returns == 0:
+            return None
+        return refs[0] if num_returns == 1 else refs
+
+    def __reduce__(self):
+        return (_rebuild_handle, (self._actor_id, self._class_name))
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:8]})"
+
+
+def _rebuild_handle(actor_id, class_name):
+    return ActorHandle(actor_id, class_name)
+
+
+class ActorClass:
+    def __init__(self, cls, options: Optional[Dict[str, Any]] = None):
+        self._cls = cls
+        self._class_name = cls.__name__
+        self._options = dict(_DEFAULT_ACTOR_OPTIONS)
+        self._options.update(options or {})
+        functools.update_wrapper(self, cls, updated=[])
+
+    def __call__(self, *a, **k):
+        raise TypeError(f"Actors must be created with "
+                        f"{self._class_name}.remote()")
+
+    def options(self, **overrides) -> "ActorClass":
+        merged = dict(self._options)
+        merged.update(overrides)
+        return ActorClass(self._cls, merged)
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        o = self._options
+        w = worker_mod.global_worker()
+        if not w.connected:
+            worker_mod.init()
+        core = w.core_worker
+        function_id = core.function_manager.export(self._cls)
+        resources = _resource_dict(o)
+        resources, strategy, pg_id, bundle_idx = resolve_pg_strategy(
+            o, resources)
+        flat = pack_args(args, kwargs)
+        task_args, _, holders = core.build_args(flat)
+        actor_id = ActorID.from_random()
+        parent = worker_context.current_task_spec()
+        spec = make_spec(
+            job_id=w.job_id,
+            owner_id=core.worker_id,
+            function_id=function_id,
+            function_name=f"{self._class_name}.__init__",
+            args=task_args,
+            num_returns=0,
+            resources=resources,
+            scheduling_strategy=strategy,
+            parent_task_id=parent.task_id if parent else core.driver_task_id,
+            task_type=TaskType.ACTOR_CREATION_TASK,
+            actor_id=actor_id,
+            actor_creation=True,
+            max_restarts=o.get("max_restarts", 0),
+            max_task_retries=o.get("max_task_retries", 0),
+            max_concurrency=o.get("max_concurrency", 1),
+            placement_group_id=pg_id,
+            placement_group_bundle_index=bundle_idx,
+            runtime_env=o.get("runtime_env"),
+        )
+        namespace = o.get("namespace")
+        core.create_actor(
+            spec,
+            name=o.get("name") or "",
+            namespace=namespace if namespace is not None else w.namespace,
+            detached=(o.get("lifetime") == "detached"),
+        )
+        return ActorHandle(actor_id, class_name=self._class_name)
+
+
+def make_actor_class(cls, options) -> ActorClass:
+    return ActorClass(cls, options)

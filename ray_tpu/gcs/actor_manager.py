@@ -1,0 +1,223 @@
+"""GCS actor management + scheduling.
+
+Parity: reference ``src/ray/gcs/gcs_server/gcs_actor_manager.{h,cc}`` (actor
+registry, state machine PENDING->ALIVE->RESTARTING->DEAD, restart per
+``max_restarts``, named-actor lookup, pubsub of state changes) and the two
+pluggable actor schedulers (``gcs_actor_scheduler.cc:459-493`` raylet-based
+forward vs ``gcs_actor_distribution.h:66`` GCS-decides, switched by
+``RAY_gcs_actor_scheduling_enabled``, ray_config_def.h:463).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from ray_tpu import exceptions
+from ray_tpu._private.config import get_config
+from ray_tpu._private.ids import ActorID, NodeID
+from ray_tpu.gcs import pubsub as pubsub_mod
+from ray_tpu.scheduler.policy import SchedulingOptions, schedule
+
+
+class ActorState:
+    DEPENDENCIES_UNREADY = "DEPENDENCIES_UNREADY"
+    PENDING_CREATION = "PENDING_CREATION"
+    ALIVE = "ALIVE"
+    RESTARTING = "RESTARTING"
+    DEAD = "DEAD"
+
+
+class GcsActor:
+    def __init__(self, actor_id: ActorID, creation_spec, name: str = "",
+                 namespace: str = "", max_restarts: int = 0,
+                 detached: bool = False):
+        self.actor_id = actor_id
+        self.creation_spec = creation_spec
+        self.name = name
+        self.namespace = namespace
+        self.max_restarts = max_restarts
+        self.num_restarts = 0
+        self.detached = detached
+        self.state = ActorState.DEPENDENCIES_UNREADY
+        self.node_id: Optional[NodeID] = None
+        self.worker = None
+        self.death_cause: str = ""
+
+    def info(self) -> dict:
+        return {
+            "actor_id": self.actor_id.hex(),
+            "state": self.state,
+            "name": self.name,
+            "namespace": self.namespace,
+            "node_id": self.node_id.hex() if self.node_id else None,
+            "max_restarts": self.max_restarts,
+            "num_restarts": self.num_restarts,
+            "death_cause": self.death_cause,
+            "class_name": getattr(self.creation_spec, "function_name", ""),
+        }
+
+
+class GcsActorManager:
+    def __init__(self, gcs):
+        self._gcs = gcs
+        self._lock = threading.RLock()
+        self._actors: Dict[ActorID, GcsActor] = {}
+        # (namespace, name) -> actor_id for named actors.
+        self._named: Dict[Tuple[str, str], ActorID] = {}
+        self._pending: list = []
+
+    # ---- registration / scheduling (gcs_actor_scheduler.cc:44) ----------
+    def register_actor(self, actor: GcsActor, ready_cb=None):
+        with self._lock:
+            if actor.name:
+                key = (actor.namespace, actor.name)
+                if key in self._named:
+                    raise ValueError(
+                        f"Actor name {actor.name!r} already taken in "
+                        f"namespace {actor.namespace!r}")
+                self._named[key] = actor.actor_id
+            self._actors[actor.actor_id] = actor
+            self._gcs.storage.actor_table.put(actor.actor_id, actor.info())
+        self._schedule(actor, ready_cb)
+        return actor
+
+    def _schedule(self, actor: GcsActor, ready_cb=None):
+        actor.state = ActorState.PENDING_CREATION
+        self._publish(actor)
+        spec = actor.creation_spec
+        cfg = get_config()
+        raylets = self._gcs.raylets()
+        if not raylets:
+            raise exceptions.RayTpuError("No nodes available to create actor")
+        if cfg.gcs_actor_scheduling_enabled:
+            # GcsBasedActorScheduler: GCS picks the node with its own
+            # cluster view (gcs_actor_distribution.h:66).
+            target = schedule(self._gcs.resource_manager.view, spec.resources,
+                              spec.scheduling_options, local_node_id=None)
+            if target is None or target not in raylets:
+                target = random.choice(list(raylets.keys()))
+        else:
+            # RayletBasedActorScheduler: forward to a raylet, which makes
+            # the real placement decision and may spill back
+            # (gcs_actor_scheduler.cc:459-493).
+            if spec.scheduling_options.node_affinity_node_id is not None:
+                target = spec.scheduling_options.node_affinity_node_id
+            else:
+                target = random.choice(list(raylets.keys()))
+        raylet = raylets.get(target)
+        if raylet is None:
+            raylet = random.choice(list(raylets.values()))
+
+        def on_lease(result):
+            if "worker" in result:
+                self._on_actor_created(actor, result["worker"], ready_cb)
+            elif "retry_at" in result:
+                retry = self._gcs.raylet(result["retry_at"])
+                if retry is None:
+                    self._gcs.loop.schedule_after(
+                        0.05, lambda: self._schedule(actor, ready_cb),
+                        "actor.reschedule")
+                else:
+                    retry.request_worker_lease(spec, on_lease)
+            else:
+                # Infeasible now; park and retry on cluster change.
+                self._gcs.loop.schedule_after(
+                    0.1, lambda: self._schedule(actor, ready_cb),
+                    "actor.retry")
+
+        raylet.request_worker_lease(spec, on_lease)
+
+    def _on_actor_created(self, actor: GcsActor, worker, ready_cb):
+        with self._lock:
+            actor.worker = worker
+            actor.node_id = worker.node_id
+        # Push the creation task to the leased worker; the worker becomes
+        # dedicated to this actor (CoreWorkerService.PushTask parity).
+        def on_done(error):
+            with self._lock:
+                if error is not None:
+                    actor.state = ActorState.DEAD
+                    actor.death_cause = f"creation failed: {error}"
+                else:
+                    actor.state = ActorState.ALIVE
+                self._gcs.storage.actor_table.put(actor.actor_id, actor.info())
+            self._publish(actor)
+            if ready_cb:
+                ready_cb(actor, error)
+
+        worker.assign_actor(actor.creation_spec, on_done)
+
+    # ---- death / restart (max_restarts orchestration) -------------------
+    def on_actor_worker_died(self, actor_id: ActorID, reason: str):
+        with self._lock:
+            actor = self._actors.get(actor_id)
+            if actor is None or actor.state == ActorState.DEAD:
+                return
+            restarting = (actor.max_restarts == -1 or
+                          actor.num_restarts < actor.max_restarts)
+            if restarting:
+                actor.num_restarts += 1
+                actor.state = ActorState.RESTARTING
+                actor.worker = None
+            else:
+                actor.state = ActorState.DEAD
+                actor.death_cause = reason
+                actor.worker = None
+                if actor.name:
+                    self._named.pop((actor.namespace, actor.name), None)
+            self._gcs.storage.actor_table.put(actor_id, actor.info())
+        self._publish(actor)
+        if restarting:
+            self._gcs.loop.post(lambda: self._schedule(actor),
+                                "actor.restart")
+
+    def on_node_death(self, node_id: NodeID):
+        with self._lock:
+            victims = [a.actor_id for a in self._actors.values()
+                       if a.node_id == node_id and
+                       a.state in (ActorState.ALIVE, ActorState.PENDING_CREATION,
+                                   ActorState.RESTARTING)]
+        for actor_id in victims:
+            self.on_actor_worker_died(actor_id, f"node {node_id} died")
+
+    def destroy_actor(self, actor_id: ActorID, no_restart: bool = True):
+        with self._lock:
+            actor = self._actors.get(actor_id)
+            if actor is None:
+                return
+            if no_restart:
+                actor.max_restarts = actor.num_restarts
+            worker = actor.worker
+        if worker is not None:
+            worker.kill_actor()
+        else:
+            self.on_actor_worker_died(actor_id, "killed via destroy_actor")
+
+    # ---- lookup ---------------------------------------------------------
+    def get_actor(self, actor_id: ActorID) -> Optional[GcsActor]:
+        with self._lock:
+            return self._actors.get(actor_id)
+
+    def get_named_actor(self, name: str, namespace: str = "") -> Optional[GcsActor]:
+        with self._lock:
+            actor_id = self._named.get((namespace, name))
+            return self._actors.get(actor_id) if actor_id else None
+
+    def list_named_actors(self, all_namespaces: bool = False,
+                          namespace: str = ""):
+        with self._lock:
+            if all_namespaces:
+                return [{"namespace": ns, "name": n}
+                        for (ns, n) in self._named]
+            return [n for (ns, n) in self._named if ns == namespace]
+
+    def all_actor_info(self):
+        with self._lock:
+            return {aid: a.info() for aid, a in self._actors.items()}
+
+    def _publish(self, actor: GcsActor):
+        self._gcs.publisher.publish(pubsub_mod.ACTOR_CHANNEL,
+                                    actor.actor_id.binary(), actor.info())

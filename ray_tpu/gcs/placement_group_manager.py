@@ -1,0 +1,218 @@
+"""GCS placement group management: bundle packing + 2-phase commit.
+
+Parity: reference ``src/ray/gcs/gcs_server/gcs_placement_group_manager.cc``
+(pending queue + retry, ``SchedulePendingPlacementGroups`` :325),
+``gcs_placement_group_scheduler.cc`` (2PC: PrepareResources :258,
+CommitResources :289, rollback CancelResourceReserve,
+node_manager.proto:319-330) and ``gcs_resource_scheduler.{h,cc}``
+(PACK/SPREAD/STRICT_PACK/STRICT_SPREAD solve with LeastResourceScorer,
+gcs_resource_scheduler.h:29-40,74,108).
+
+The bundle->node solve is delegated to
+:func:`ray_tpu.scheduler.bundle_packing.pack_bundles`, which has a numpy
+reference implementation and the batched TPU kernel behind the same
+signature (the north-star reuse: one kernel serves raylet tick, PG packing,
+autoscaler bin-pack — SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu import exceptions
+from ray_tpu._private.ids import NodeID, PlacementGroupID
+from ray_tpu.scheduler.bundle_packing import pack_bundles
+from ray_tpu.scheduler.resources import ResourceRequest
+
+
+class PlacementStrategy:
+    PACK = "PACK"
+    SPREAD = "SPREAD"
+    STRICT_PACK = "STRICT_PACK"
+    STRICT_SPREAD = "STRICT_SPREAD"
+
+
+class PlacementGroupState:
+    PENDING = "PENDING"
+    PREPARED = "PREPARED"
+    CREATED = "CREATED"
+    RESCHEDULING = "RESCHEDULING"
+    REMOVED = "REMOVED"
+
+
+class GcsPlacementGroup:
+    def __init__(self, pg_id: PlacementGroupID,
+                 bundles: List[ResourceRequest], strategy: str,
+                 name: str = "", lifetime: str = ""):
+        self.pg_id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+        self.name = name
+        self.lifetime = lifetime
+        self.state = PlacementGroupState.PENDING
+        # bundle index -> NodeID once placed.
+        self.bundle_nodes: Dict[int, NodeID] = {}
+        self.create_time = time.time()
+
+    def info(self) -> dict:
+        return {
+            "placement_group_id": self.pg_id.hex(),
+            "name": self.name,
+            "strategy": self.strategy,
+            "state": self.state,
+            "bundles": [b.to_dict() for b in self.bundles],
+            "bundle_nodes": {i: n.hex() for i, n in self.bundle_nodes.items()},
+        }
+
+
+class GcsPlacementGroupManager:
+    def __init__(self, gcs):
+        self._gcs = gcs
+        self._lock = threading.RLock()
+        self._groups: Dict[PlacementGroupID, GcsPlacementGroup] = {}
+        self._named: Dict[str, PlacementGroupID] = {}
+        self._pending: List[PlacementGroupID] = []
+        self._ready_callbacks: Dict[PlacementGroupID, list] = {}
+        # Retry cadence for pending PGs (SchedulePendingPlacementGroups).
+        gcs.loop.schedule_every(0.05, self._schedule_pending, "pg.tick")
+
+    # ---- API ------------------------------------------------------------
+    def create_placement_group(self, pg: GcsPlacementGroup, ready_cb=None):
+        with self._lock:
+            if pg.name:
+                if pg.name in self._named:
+                    raise ValueError(f"Placement group name {pg.name!r} taken")
+                self._named[pg.name] = pg.pg_id
+            self._groups[pg.pg_id] = pg
+            self._pending.append(pg.pg_id)
+            if ready_cb:
+                self._ready_callbacks.setdefault(pg.pg_id, []).append(ready_cb)
+            self._gcs.storage.placement_group_table.put(pg.pg_id, pg.info())
+        self._gcs.loop.post(self._schedule_pending, "pg.schedule")
+        return pg
+
+    def remove_placement_group(self, pg_id: PlacementGroupID):
+        with self._lock:
+            pg = self._groups.get(pg_id)
+            if pg is None:
+                return
+            pg.state = PlacementGroupState.REMOVED
+            if pg.name:
+                self._named.pop(pg.name, None)
+            if pg_id in self._pending:
+                self._pending.remove(pg_id)
+            placed = dict(pg.bundle_nodes)
+            pg.bundle_nodes = {}
+            self._gcs.storage.placement_group_table.put(pg_id, pg.info())
+        for idx, node_id in placed.items():
+            raylet = self._gcs.raylet(node_id)
+            if raylet is not None:
+                raylet.cancel_resource_reserve(pg_id, idx)
+
+    def get(self, pg_id: PlacementGroupID) -> Optional[GcsPlacementGroup]:
+        with self._lock:
+            return self._groups.get(pg_id)
+
+    def get_named(self, name: str) -> Optional[GcsPlacementGroup]:
+        with self._lock:
+            pg_id = self._named.get(name)
+            return self._groups.get(pg_id) if pg_id else None
+
+    def table(self) -> dict:
+        with self._lock:
+            return {pg_id.hex(): pg.info() for pg_id, pg in self._groups.items()}
+
+    def wait_ready(self, pg_id: PlacementGroupID, timeout: Optional[float]) -> bool:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                pg = self._groups.get(pg_id)
+                if pg is not None and pg.state == PlacementGroupState.CREATED:
+                    return True
+                if pg is None or pg.state == PlacementGroupState.REMOVED:
+                    return False
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            time.sleep(0.005)
+
+    # ---- scheduling (ScheduleUnplacedBundles) ---------------------------
+    def _schedule_pending(self):
+        with self._lock:
+            pending = list(self._pending)
+        for pg_id in pending:
+            with self._lock:
+                pg = self._groups.get(pg_id)
+                if pg is None or pg.state not in (PlacementGroupState.PENDING,
+                                                  PlacementGroupState.RESCHEDULING):
+                    if pg_id in self._pending:
+                        self._pending.remove(pg_id)
+                    continue
+            if self._try_place(pg):
+                with self._lock:
+                    if pg_id in self._pending:
+                        self._pending.remove(pg_id)
+
+    def _try_place(self, pg: GcsPlacementGroup) -> bool:
+        view = self._gcs.resource_manager.view
+        unplaced = [i for i in range(len(pg.bundles))
+                    if i not in pg.bundle_nodes]
+        if not unplaced:
+            return True
+        exclude = set(pg.bundle_nodes.values()) \
+            if pg.strategy == PlacementStrategy.STRICT_SPREAD else set()
+        assignment = pack_bundles(
+            view, [pg.bundles[i] for i in unplaced], pg.strategy,
+            exclude_nodes=exclude)
+        if assignment is None:
+            return False
+        placement = {unplaced[j]: node for j, node in enumerate(assignment)}
+        # --- phase 1: prepare on all involved raylets ---
+        prepared: List[tuple] = []
+        ok = True
+        for idx, node_id in placement.items():
+            raylet = self._gcs.raylet(node_id)
+            if raylet is None or not raylet.prepare_bundle_resources(
+                    pg.pg_id, idx, pg.bundles[idx]):
+                ok = False
+                break
+            prepared.append((idx, node_id))
+        if not ok:
+            for idx, node_id in prepared:
+                raylet = self._gcs.raylet(node_id)
+                if raylet is not None:
+                    raylet.cancel_resource_reserve(pg.pg_id, idx)
+            return False
+        # --- phase 2: commit ---
+        for idx, node_id in prepared:
+            self._gcs.raylet(node_id).commit_bundle_resources(
+                pg.pg_id, idx, pg.bundles[idx])
+        with self._lock:
+            pg.bundle_nodes.update(placement)
+            pg.state = PlacementGroupState.CREATED
+            self._gcs.storage.placement_group_table.put(pg.pg_id, pg.info())
+            callbacks = self._ready_callbacks.pop(pg.pg_id, [])
+        for cb in callbacks:
+            try:
+                cb(pg)
+            except Exception:
+                pass
+        return True
+
+    # ---- failure handling ----------------------------------------------
+    def on_node_death(self, node_id: NodeID):
+        with self._lock:
+            affected = []
+            for pg in self._groups.values():
+                lost = [i for i, n in pg.bundle_nodes.items() if n == node_id]
+                if lost and pg.state != PlacementGroupState.REMOVED:
+                    for i in lost:
+                        del pg.bundle_nodes[i]
+                    pg.state = PlacementGroupState.RESCHEDULING
+                    affected.append(pg.pg_id)
+            for pg_id in affected:
+                if pg_id not in self._pending:
+                    self._pending.append(pg_id)
+        if affected:
+            self._gcs.loop.post(self._schedule_pending, "pg.reschedule")

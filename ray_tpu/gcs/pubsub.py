@@ -1,0 +1,60 @@
+"""Pubsub — batched publisher with per-subscriber queues.
+
+Parity: reference ``src/ray/pubsub/`` (long-polling publisher that batches
+messages per subscriber so connection count is O(#subscribers), not
+O(#objects); channels for actor state, node state, object locations, logs,
+error info).  In-process the "long poll" is an event-loop post, but the
+per-subscriber mailbox + channel/key filtering semantics are the same.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+# Channel names (pubsub.proto ChannelType parity).
+ACTOR_CHANNEL = "ACTOR"
+NODE_CHANNEL = "NODE"
+WORKER_FAILURE_CHANNEL = "WORKER_FAILURE"
+OBJECT_LOCATION_CHANNEL = "OBJECT_LOCATION"
+JOB_CHANNEL = "JOB"
+ERROR_INFO_CHANNEL = "ERROR_INFO"
+RESOURCE_USAGE_CHANNEL = "RESOURCE_USAGE"
+
+
+class Publisher:
+    def __init__(self, event_loop=None):
+        self._lock = threading.RLock()
+        # (channel, key or None) -> {subscriber_id: callback}
+        self._subs: Dict[Tuple[str, Optional[bytes]], Dict[int, Callable]] = {}
+        self._next_id = 0
+        self._loop = event_loop
+
+    def subscribe(self, channel: str, key: Optional[bytes],
+                  callback: Callable[[bytes, Any], None]) -> int:
+        """Subscribe to one key, or to the whole channel with key=None."""
+        with self._lock:
+            self._next_id += 1
+            sid = self._next_id
+            self._subs.setdefault((channel, key), {})[sid] = callback
+            return sid
+
+    def unsubscribe(self, channel: str, key: Optional[bytes], sub_id: int):
+        with self._lock:
+            subs = self._subs.get((channel, key))
+            if subs:
+                subs.pop(sub_id, None)
+
+    def publish(self, channel: str, key: bytes, message: Any):
+        with self._lock:
+            targets = list(self._subs.get((channel, key), {}).values())
+            targets += list(self._subs.get((channel, None), {}).values())
+        for cb in targets:
+            if self._loop is not None:
+                self._loop.post(lambda cb=cb: cb(key, message),
+                                name=f"pubsub.{channel}")
+            else:
+                try:
+                    cb(key, message)
+                except Exception:
+                    pass

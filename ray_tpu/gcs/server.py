@@ -1,0 +1,317 @@
+"""GCS server — the cluster control plane.
+
+Parity: reference ``src/ray/gcs/gcs_server/gcs_server.h:182-237`` member
+wiring: GcsNodeManager, GcsHeartbeatManager, GcsActorManager(+scheduler),
+GcsPlacementGroupManager(+scheduler), GcsJobManager, GcsResourceManager,
+GcsWorkerManager, GcsInternalKVManager, InternalPubSubHandler, RaySyncer,
+GcsTableStorage, GcsFunctionManager.
+
+In-process deployment: one GcsServer object per cluster, raylet "RPCs" are
+direct method calls dispatched on the GCS event loop where ordering matters.
+The storage layer is pluggable (memory/file) so GCS restart reloads state
+(gcs_init_data.cc parity).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ray_tpu._private.config import get_config
+from ray_tpu._private.event_loop import EventLoop
+from ray_tpu._private.ids import ActorID, JobID, NodeID, WorkerID
+from ray_tpu.gcs import pubsub as pubsub_mod
+from ray_tpu.gcs.pubsub import Publisher
+from ray_tpu.gcs.storage import (
+    FileStoreClient, GcsTableStorage, InMemoryStoreClient)
+from ray_tpu.scheduler.resources import ClusterResourceView, NodeResources
+
+
+class GcsNodeManager:
+    """Node registry + death publishing (gcs_node_manager.cc parity)."""
+
+    def __init__(self, storage: GcsTableStorage, publisher: Publisher):
+        self._storage = storage
+        self._publisher = publisher
+        self._lock = threading.RLock()
+        self.alive_nodes: Dict[NodeID, dict] = {}
+        self.dead_nodes: Dict[NodeID, dict] = {}
+
+    def register_node(self, node_id: NodeID, info: dict):
+        with self._lock:
+            info = dict(info, state="ALIVE", start_time=time.time())
+            self.alive_nodes[node_id] = info
+            self._storage.node_table.put(node_id, info)
+        self._publisher.publish(pubsub_mod.NODE_CHANNEL, node_id.binary(),
+                                {"state": "ALIVE", "info": info})
+
+    def drain_node(self, node_id: NodeID):
+        with self._lock:
+            info = self.alive_nodes.get(node_id)
+            if info is not None:
+                info["draining"] = True
+
+    def on_node_death(self, node_id: NodeID, reason: str = "heartbeat timeout"):
+        with self._lock:
+            info = self.alive_nodes.pop(node_id, None)
+            if info is None:
+                return
+            info = dict(info, state="DEAD", death_reason=reason,
+                        end_time=time.time())
+            self.dead_nodes[node_id] = info
+            self._storage.node_table.put(node_id, info)
+        self._publisher.publish(pubsub_mod.NODE_CHANNEL, node_id.binary(),
+                                {"state": "DEAD", "info": info})
+
+    def get_all_node_info(self) -> Dict[NodeID, dict]:
+        with self._lock:
+            out = {}
+            for nid, info in self.alive_nodes.items():
+                out[nid] = dict(info)
+            for nid, info in self.dead_nodes.items():
+                out[nid] = dict(info)
+            return out
+
+    def is_alive(self, node_id: NodeID) -> bool:
+        with self._lock:
+            return node_id in self.alive_nodes
+
+
+class GcsHeartbeatManager:
+    """Declares nodes dead after missed heartbeats
+    (gcs_heartbeat_manager.h:31-60; raylet_heartbeat_period x
+    num_heartbeats_timeout, ray_config_def.h:51-55)."""
+
+    def __init__(self, loop: EventLoop, on_node_death: Callable[[NodeID], None]):
+        cfg = get_config()
+        self._period_s = cfg.raylet_heartbeat_period_milliseconds / 1000.0
+        self._timeout = cfg.num_heartbeats_timeout
+        self._lock = threading.Lock()
+        self._missed: Dict[NodeID, int] = {}
+        self._on_death = on_node_death
+        self._paused = False
+        loop.schedule_every(self._period_s, self._tick, "gcs.heartbeat_check")
+
+    def register(self, node_id: NodeID):
+        with self._lock:
+            self._missed[node_id] = 0
+
+    def unregister(self, node_id: NodeID):
+        with self._lock:
+            self._missed.pop(node_id, None)
+
+    def heartbeat(self, node_id: NodeID):
+        with self._lock:
+            if node_id in self._missed:
+                self._missed[node_id] = 0
+
+    def pause(self, paused: bool = True):
+        self._paused = paused
+
+    def _tick(self):
+        if self._paused:
+            return
+        dead = []
+        with self._lock:
+            for node_id in list(self._missed):
+                self._missed[node_id] += 1
+                if self._missed[node_id] >= self._timeout:
+                    dead.append(node_id)
+                    del self._missed[node_id]
+        for node_id in dead:
+            self._on_death(node_id)
+
+
+class GcsResourceManager:
+    """Cluster-wide resource view + usage broadcast (RaySyncer +
+    gcs_resource_manager.cc parity: poll raylets, merge, rebroadcast)."""
+
+    def __init__(self, loop: EventLoop, publisher: Publisher):
+        self.view = ClusterResourceView()
+        self._publisher = publisher
+        self._loop = loop
+        self._raylets: Dict[NodeID, object] = {}
+        cfg = get_config()
+        loop.schedule_every(
+            cfg.gcs_resource_broadcast_period_milliseconds / 1000.0,
+            self._poll_and_broadcast, "gcs.resource_sync")
+
+    def register_raylet(self, node_id: NodeID, raylet, resources: NodeResources):
+        self._raylets[node_id] = raylet
+        self.view.add_node(node_id, resources)
+
+    def unregister_raylet(self, node_id: NodeID):
+        self._raylets.pop(node_id, None)
+        self.view.remove_node(node_id)
+
+    def _poll_and_broadcast(self):
+        # Poll each raylet's local resource usage (RequestResourceReport),
+        # merge into the GCS view, then broadcast the merged batch to all
+        # raylets (UpdateResourceUsage) so their local views converge.
+        batch = {}
+        for node_id, raylet in list(self._raylets.items()):
+            try:
+                usage = raylet.get_resource_report()
+            except Exception:
+                continue
+            batch[node_id] = usage
+            self.view.update_available(node_id, usage["available"])
+        for raylet in list(self._raylets.values()):
+            try:
+                raylet.update_resource_usage(batch)
+            except Exception:
+                pass
+
+
+class GcsJobManager:
+    def __init__(self, storage: GcsTableStorage, publisher: Publisher):
+        self._storage = storage
+        self._publisher = publisher
+        self._lock = threading.Lock()
+        self.jobs: Dict[JobID, dict] = {}
+
+    def add_job(self, job_id: JobID, config: Optional[dict] = None) -> dict:
+        with self._lock:
+            info = {"job_id": job_id.hex(), "state": "RUNNING",
+                    "start_time": time.time(), "config": config or {}}
+            self.jobs[job_id] = info
+            self._storage.job_table.put(job_id, info)
+        self._publisher.publish(pubsub_mod.JOB_CHANNEL, job_id.binary(), info)
+        return info
+
+    def mark_job_finished(self, job_id: JobID):
+        with self._lock:
+            info = self.jobs.get(job_id)
+            if info is None:
+                return
+            info["state"] = "FINISHED"
+            info["end_time"] = time.time()
+            self._storage.job_table.put(job_id, info)
+        self._publisher.publish(pubsub_mod.JOB_CHANNEL, job_id.binary(), info)
+
+
+class GcsInternalKV:
+    """Internal KV with namespacing (gcs KV manager; used for function
+    exports, serve/controller state, cluster metadata)."""
+
+    def __init__(self, storage: GcsTableStorage):
+        self._table = storage.kv_table
+
+    @staticmethod
+    def _ns_key(key: bytes, namespace: Optional[bytes]) -> bytes:
+        return (namespace or b"") + b"@" + key
+
+    def put(self, key: bytes, value: bytes, overwrite: bool = True,
+            namespace: Optional[bytes] = None) -> bool:
+        k = self._ns_key(key, namespace)
+        if not overwrite and self._table.get(k) is not None:
+            return False
+        self._table.put(k, value)
+        return True
+
+    def get(self, key: bytes, namespace: Optional[bytes] = None):
+        return self._table.get(self._ns_key(key, namespace))
+
+    def delete(self, key: bytes, namespace: Optional[bytes] = None) -> bool:
+        return self._table.delete(self._ns_key(key, namespace))
+
+    def exists(self, key: bytes, namespace: Optional[bytes] = None) -> bool:
+        return self.get(key, namespace) is not None
+
+    def keys(self, prefix: bytes = b"", namespace: Optional[bytes] = None):
+        ns = (namespace or b"") + b"@"
+        full = ns + prefix
+        return [k[len(ns):] for k, _ in self._table.get_all()
+                if k.startswith(full)]
+
+
+class GcsWorkerManager:
+    def __init__(self, publisher: Publisher):
+        self._publisher = publisher
+        self._lock = threading.Lock()
+        self.workers: Dict[WorkerID, dict] = {}
+
+    def register_worker(self, worker_id: WorkerID, info: dict):
+        with self._lock:
+            self.workers[worker_id] = info
+
+    def report_worker_failure(self, worker_id: WorkerID, reason: str):
+        with self._lock:
+            info = self.workers.get(worker_id, {})
+            info["state"] = "DEAD"
+            info["reason"] = reason
+        self._publisher.publish(pubsub_mod.WORKER_FAILURE_CHANNEL,
+                                worker_id.binary(), info)
+
+
+class GcsServer:
+    """The assembled control plane (gcs_server.h:182-237 wiring)."""
+
+    def __init__(self, storage_path: Optional[str] = None):
+        cfg = get_config()
+        if storage_path or cfg.gcs_storage_backend == "file":
+            store = FileStoreClient(storage_path or
+                                    f"{cfg.temp_dir}/gcs_store.bin")
+        else:
+            store = InMemoryStoreClient()
+        self.storage = GcsTableStorage(store)
+        self.loop = EventLoop("gcs")
+        self.publisher = Publisher()
+        self.kv = GcsInternalKV(self.storage)
+        self.node_manager = GcsNodeManager(self.storage, self.publisher)
+        self.heartbeat_manager = GcsHeartbeatManager(
+            self.loop, lambda nid: self._on_node_death(nid))
+        self.resource_manager = GcsResourceManager(self.loop, self.publisher)
+        self.job_manager = GcsJobManager(self.storage, self.publisher)
+        self.worker_manager = GcsWorkerManager(self.publisher)
+        from ray_tpu.gcs.actor_manager import GcsActorManager
+        self.actor_manager = GcsActorManager(self)
+        from ray_tpu.gcs.placement_group_manager import GcsPlacementGroupManager
+        self.placement_group_manager = GcsPlacementGroupManager(self)
+        self._node_death_listeners: List[Callable[[NodeID], None]] = []
+        self._raylets: Dict[NodeID, object] = {}
+
+    # ---- raylet registration (NodeInfoGcsService parity) ----------------
+    def register_raylet(self, raylet):
+        node_id = raylet.node_id
+        self._raylets[node_id] = raylet
+        self.node_manager.register_node(node_id, raylet.node_info())
+        self.heartbeat_manager.register(node_id)
+        self.resource_manager.register_raylet(node_id, raylet,
+                                              raylet.local_resources)
+
+    def unregister_raylet(self, node_id: NodeID, intentional: bool = True):
+        self.heartbeat_manager.unregister(node_id)
+        self.resource_manager.unregister_raylet(node_id)
+        self._raylets.pop(node_id, None)
+        if intentional:
+            self.node_manager.on_node_death(node_id, "intentional shutdown")
+            self._notify_node_death(node_id)
+
+    def raylet(self, node_id: NodeID):
+        return self._raylets.get(node_id)
+
+    def raylets(self):
+        return dict(self._raylets)
+
+    def _on_node_death(self, node_id: NodeID):
+        self.node_manager.on_node_death(node_id)
+        self.resource_manager.unregister_raylet(node_id)
+        self._raylets.pop(node_id, None)
+        self._notify_node_death(node_id)
+
+    def _notify_node_death(self, node_id: NodeID):
+        self.actor_manager.on_node_death(node_id)
+        self.placement_group_manager.on_node_death(node_id)
+        for cb in list(self._node_death_listeners):
+            try:
+                cb(node_id)
+            except Exception:
+                pass
+
+    def subscribe_node_death(self, cb: Callable[[NodeID], None]):
+        self._node_death_listeners.append(cb)
+
+    def shutdown(self):
+        self.loop.stop()

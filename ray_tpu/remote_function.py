@@ -1,0 +1,118 @@
+"""@remote functions.
+
+Parity: reference ``python/ray/remote_function.py`` — ``RemoteFunction``
+wraps the user function; ``_remote`` (:246) pickles/exports the function
+once, builds the task spec (inlining small args, promoting big ones), and
+submits via the core worker (:421); ``.options(...)`` (:129) returns a
+shallow override wrapper.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private import worker_context
+from ray_tpu._private.executor import pack_args
+from ray_tpu._private.task_spec import TaskType, make_spec
+
+_DEFAULT_OPTIONS = dict(
+    num_cpus=1, num_tpus=0, num_gpus=0, memory=0, resources=None,
+    num_returns=1, max_retries=None, retry_exceptions=False,
+    scheduling_strategy=None, runtime_env=None, name=None,
+)
+
+
+def _resource_dict(o: Dict[str, Any]) -> Dict[str, float]:
+    res = dict(o.get("resources") or {})
+    if o.get("num_cpus"):
+        res["CPU"] = o["num_cpus"]
+    if o.get("num_tpus"):
+        res["TPU"] = o["num_tpus"]
+    if o.get("num_gpus"):
+        res["GPU"] = o["num_gpus"]
+    if o.get("memory"):
+        res["memory"] = o["memory"]
+    return res
+
+
+def resolve_pg_strategy(options: Dict[str, Any], resources: Dict[str, float]):
+    """Rewrite resources for placement-group scheduling
+    (bundle_spec.h formatted resources)."""
+    from ray_tpu.util.scheduling_strategies import (
+        PlacementGroupSchedulingStrategy)
+    strategy = options.get("scheduling_strategy")
+    if not isinstance(strategy, PlacementGroupSchedulingStrategy):
+        return resources, strategy, None, -1
+    from ray_tpu.scheduler.bundle_packing import rewrite_resources_for_bundle
+    pg = strategy.placement_group
+    idx = strategy.placement_group_bundle_index
+    rewritten = rewrite_resources_for_bundle(resources, pg.id, idx)
+    return rewritten, "DEFAULT", pg.id, idx
+
+
+class RemoteFunction:
+    def __init__(self, fn, options: Optional[Dict[str, Any]] = None):
+        self._function = fn
+        self._name = f"{fn.__module__}.{fn.__qualname__}"
+        self._options = dict(_DEFAULT_OPTIONS)
+        self._options.update(options or {})
+        self._function_id = None
+        functools.update_wrapper(self, fn)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function {self._name} cannot be called directly; use "
+            f"{getattr(self._function, '__name__', 'f')}.remote().")
+
+    def options(self, **overrides) -> "RemoteFunction":
+        merged = dict(self._options)
+        merged.update(overrides)
+        rf = RemoteFunction(self._function, merged)
+        rf._function_id = self._function_id
+        return rf
+
+    def remote(self, *args, **kwargs):
+        return self._remote(args, kwargs, self._options)
+
+    def _remote(self, args, kwargs, options):
+        w = worker_mod.global_worker()
+        if not w.connected:
+            worker_mod.init()
+        core = w.core_worker
+        if self._function_id is None:
+            self._function_id = core.function_manager.export(self._function)
+        resources = _resource_dict(options)
+        resources, strategy, pg_id, bundle_idx = \
+            resolve_pg_strategy(options, resources)
+        flat = pack_args(args, kwargs)
+        task_args, _, holders = core.build_args(flat)
+        parent = worker_context.current_task_spec()
+        cfg_retries = options.get("max_retries")
+        from ray_tpu._private.config import get_config
+        spec = make_spec(
+            job_id=w.job_id,
+            owner_id=core.worker_id,
+            function_id=self._function_id,
+            function_name=options.get("name") or self._name,
+            args=task_args,
+            num_returns=options.get("num_returns", 1),
+            resources=resources,
+            scheduling_strategy=strategy,
+            parent_task_id=parent.task_id if parent else core.driver_task_id,
+            depth=(parent.depth + 1) if parent else 0,
+            task_type=TaskType.NORMAL_TASK,
+            max_retries=(cfg_retries if cfg_retries is not None
+                         else get_config().task_max_retries),
+            retry_exceptions=bool(options.get("retry_exceptions")),
+            placement_group_id=pg_id,
+            placement_group_bundle_index=bundle_idx,
+            runtime_env=options.get("runtime_env"),
+        )
+        refs = core.submit_task(spec, holders=holders)
+        if spec.num_returns == 0:
+            return None
+        if spec.num_returns == 1:
+            return refs[0]
+        return refs

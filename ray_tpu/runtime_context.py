@@ -1,0 +1,93 @@
+"""Runtime context introspection.
+
+Parity: reference ``python/ray/runtime_context.py`` — job/node/task/actor
+ids, assigned resources, from driver or inside a task/actor.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private import worker_context
+
+
+class RuntimeContext:
+    @property
+    def job_id(self):
+        w = worker_mod.global_worker()
+        return w.job_id
+
+    def get_job_id(self) -> str:
+        return self.job_id.hex()
+
+    @property
+    def node_id(self):
+        ctx = worker_context.get_context()
+        if ctx.node is not None:
+            return ctx.node.node_id
+        w = worker_mod.global_worker()
+        return w.cluster.head_node.node_id if w.cluster else None
+
+    def get_node_id(self) -> str:
+        nid = self.node_id
+        return nid.hex() if nid else ""
+
+    @property
+    def task_id(self):
+        spec = worker_context.current_task_spec()
+        return spec.task_id if spec else None
+
+    def get_task_id(self) -> Optional[str]:
+        t = self.task_id
+        return t.hex() if t else None
+
+    @property
+    def actor_id(self):
+        spec = worker_context.current_task_spec()
+        return spec.actor_id if spec and spec.actor_id else None
+
+    def get_actor_id(self) -> Optional[str]:
+        a = self.actor_id
+        return a.hex() if a else None
+
+    @property
+    def current_actor(self):
+        """Handle to the current actor (inside an actor method)."""
+        aid = self.actor_id
+        if aid is None:
+            raise RuntimeError("Not inside an actor method")
+        from ray_tpu.actor import ActorHandle
+        return ActorHandle(aid)
+
+    @property
+    def namespace(self) -> str:
+        return worker_mod.global_worker().namespace
+
+    @property
+    def was_current_actor_reconstructed(self) -> bool:
+        aid = self.actor_id
+        if aid is None:
+            return False
+        w = worker_mod.global_worker()
+        actor = w.cluster.gcs.actor_manager.get_actor(aid)
+        return bool(actor and actor.num_restarts > 0)
+
+    def get_assigned_resources(self) -> dict:
+        spec = worker_context.current_task_spec()
+        if spec is None:
+            return {}
+        return spec.resources.to_dict()
+
+    def get_placement_group_id(self) -> Optional[str]:
+        spec = worker_context.current_task_spec()
+        if spec is None or spec.placement_group_id is None:
+            return None
+        return spec.placement_group_id.hex()
+
+
+_context = RuntimeContext()
+
+
+def get_runtime_context() -> RuntimeContext:
+    return _context

@@ -1,0 +1,142 @@
+"""Bundle -> node packing for placement groups.
+
+Parity: reference ``src/ray/gcs/gcs_server/gcs_resource_scheduler.{h,cc}``
+(``GcsResourceScheduler::Schedule`` with PACK/SPREAD/STRICT_PACK/
+STRICT_SPREAD, gcs_resource_scheduler.h:29-40,108; best-fit via
+``LeastResourceScorer`` :74 — after-allocation leftover minimized).
+
+This is the shared solve surface: the numpy implementation below is the
+oracle, and ``ray_tpu.scheduler.jax_backend`` exposes the same contract for
+batched solves on TPU (SURVEY.md §3.4: one kernel signature serves the
+raylet tick, GCS PG packing, and the autoscaler bin-pack).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set
+
+import numpy as np
+
+from ray_tpu.scheduler.resources import (
+    ClusterResourceView, NodeResources, ResourceRequest)
+
+
+def _least_resource_score(avail: Dict[str, int], demand: Dict[str, int]) -> float:
+    """LeastResourceScorer (gcs_resource_scheduler.h:74): prefer the node
+    that, after allocation, has the least leftover of the demanded
+    resources (best fit).  Returns -inf if infeasible."""
+    score = 0.0
+    for name, amount in demand.items():
+        have = avail.get(name, 0)
+        if have < amount:
+            return float("-inf")
+        score += 1.0 - (have - amount) / max(have, 1)
+    return score / max(len(demand), 1)
+
+
+def pack_bundles(view: ClusterResourceView,
+                 bundles: Sequence[ResourceRequest],
+                 strategy: str,
+                 exclude_nodes: Optional[Set] = None) -> Optional[List]:
+    """Solve bundle->node placement; returns node id per bundle or None.
+
+    All-or-nothing: placement is simulated on a copy of the availability
+    maps so a partial fit never leaks into the live view (the actual
+    reservation happens via the 2PC prepare/commit against raylets).
+    """
+    node_ids = view.node_ids()
+    exclude_nodes = exclude_nodes or set()
+    node_ids = [n for n in node_ids if n not in exclude_nodes]
+    if not node_ids:
+        return None
+    sim: Dict = {}
+    for nid in node_ids:
+        res = view.node_resources(nid)
+        if res is None:
+            continue
+        sim[nid] = dict(res.available)
+
+    if strategy == "STRICT_PACK":
+        total: Dict[str, int] = {}
+        for b in bundles:
+            for k, v in b.quantized().items():
+                total[k] = total.get(k, 0) + v
+        best, best_score = None, float("-inf")
+        for nid in node_ids:
+            s = _least_resource_score(sim[nid], total)
+            if s > best_score:
+                best, best_score = nid, s
+        if best is None or best_score == float("-inf"):
+            return None
+        return [best] * len(bundles)
+
+    # Sort large bundles first (first-fit-decreasing flavor), keep the
+    # original index to un-permute the answer.
+    order = sorted(range(len(bundles)),
+                   key=lambda i: -sum(bundles[i].quantized().values()))
+    placement: List = [None] * len(bundles)
+    used_nodes: Set = set()
+
+    for i in order:
+        demand = bundles[i].quantized()
+        best, best_score = None, float("-inf")
+        for nid in node_ids:
+            if strategy == "STRICT_SPREAD" and nid in used_nodes:
+                continue
+            s = _least_resource_score(sim[nid], demand)
+            if s == float("-inf"):
+                continue
+            # PACK prefers already-used nodes; SPREAD prefers fresh nodes.
+            if strategy == "PACK" and nid in used_nodes:
+                s += 10.0
+            elif strategy == "SPREAD" and nid in used_nodes:
+                s -= 10.0
+            if s > best_score:
+                best, best_score = nid, s
+        if best is None:
+            return None
+        placement[i] = best
+        used_nodes.add(best)
+        for k, v in demand.items():
+            sim[best][k] = sim[best].get(k, 0) - v
+    return placement
+
+
+def bundle_resource_names(pg_id, bundle_index: int,
+                          resources: ResourceRequest) -> Dict[str, float]:
+    """Formatted placement-group resources added to a node on commit.
+
+    Reference scheme (``bundle_spec.h``): for each resource R in the bundle,
+    the node gains ``R_group_{pg_id}`` (wildcard) and
+    ``R_group_{index}_{pg_id}`` (indexed); tasks using the PG consume those
+    instead of the base resources.
+    """
+    out: Dict[str, float] = {}
+    hexid = pg_id.hex()
+    for name, amount in resources.to_dict().items():
+        out[f"{name}_group_{hexid}"] = amount
+        out[f"{name}_group_{bundle_index}_{hexid}"] = amount
+    # The indexed "bundle" marker resource (bundle_spec.h): lets zero-cpu
+    # tasks target a bundle and lets pg.ready() probe placement.
+    out[f"bundle_group_{hexid}"] = 1000
+    out[f"bundle_group_{bundle_index}_{hexid}"] = 1000
+    return out
+
+
+def rewrite_resources_for_bundle(resources: Dict[str, float], pg_id,
+                                 bundle_index: int) -> Dict[str, float]:
+    """Rewrite a task's resource demand to the PG-formatted resources."""
+    hexid = pg_id.hex()
+    out: Dict[str, float] = {}
+    for name, amount in resources.items():
+        if bundle_index >= 0:
+            out[f"{name}_group_{bundle_index}_{hexid}"] = amount
+        else:
+            out[f"{name}_group_{hexid}"] = amount
+    # Always demand a sliver of the bundle marker so even zero-resource
+    # tasks wait for (and land on) the bundle's node.
+    if bundle_index >= 0:
+        out.setdefault(f"bundle_group_{bundle_index}_{hexid}", 0.001)
+    else:
+        out.setdefault(f"bundle_group_{hexid}", 0.001)
+    return out

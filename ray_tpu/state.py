@@ -1,0 +1,51 @@
+"""Cluster introspection (GlobalState parity).
+
+Parity: reference ``python/ray/state.py`` (``GlobalState`` — nodes, actors,
+placement groups, jobs, cluster/available resources, timeline dump) backed
+by the GCS tables instead of a GlobalStateAccessor.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ray_tpu._private import worker as worker_mod
+
+
+class GlobalState:
+    def _gcs(self):
+        w = worker_mod.global_worker()
+        if not w.connected:
+            raise RuntimeError("ray_tpu not initialized")
+        return w.cluster.gcs
+
+    def node_table(self) -> List[dict]:
+        from ray_tpu._private.worker import nodes
+        return nodes()
+
+    def actor_table(self, actor_id=None) -> dict:
+        gcs = self._gcs()
+        info = gcs.actor_manager.all_actor_info()
+        if actor_id is not None:
+            return info.get(actor_id, {})
+        return {aid.hex(): v for aid, v in info.items()}
+
+    def placement_group_table(self) -> dict:
+        return self._gcs().placement_group_manager.table()
+
+    def job_table(self) -> List[dict]:
+        gcs = self._gcs()
+        return [dict(v) for v in gcs.job_manager.jobs.values()]
+
+    def cluster_resources(self) -> dict:
+        return self._gcs().resource_manager.view.total_cluster_resources()
+
+    def available_resources(self) -> dict:
+        return self._gcs().resource_manager.view.available_cluster_resources()
+
+    def chrome_tracing_dump(self) -> List[dict]:
+        from ray_tpu.util import tracing
+        return tracing.chrome_tracing_dump()
+
+
+state = GlobalState()

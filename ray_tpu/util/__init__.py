@@ -1,0 +1,29 @@
+"""ray_tpu.util — utilities on top of the public API.
+
+Parity: reference ``python/ray/util/__init__.py`` (ActorPool, queue,
+placement groups, scheduling strategies, collective, metrics, iter).
+"""
+
+from ray_tpu.util.placement_group import (  # noqa: F401
+    PlacementGroup, get_current_placement_group, get_placement_group,
+    placement_group, placement_group_table, remove_placement_group)
+from ray_tpu.util.scheduling_strategies import (  # noqa: F401
+    NodeAffinitySchedulingStrategy, PlacementGroupSchedulingStrategy)
+
+__all__ = [
+    "placement_group", "remove_placement_group", "get_placement_group",
+    "placement_group_table", "get_current_placement_group", "PlacementGroup",
+    "PlacementGroupSchedulingStrategy", "NodeAffinitySchedulingStrategy",
+    "ActorPool",
+]
+
+
+def __getattr__(name):
+    # Lazy submodule access for heavier utilities.
+    if name == "ActorPool":
+        from ray_tpu.util.actor_pool import ActorPool
+        return ActorPool
+    if name == "collective":
+        from ray_tpu.util import collective
+        return collective
+    raise AttributeError(f"module 'ray_tpu.util' has no attribute {name!r}")

@@ -1,0 +1,74 @@
+"""Task-level tracing / timeline profiling.
+
+Parity: reference OpenTelemetry tracing (``tracing_helper.py`` — spans
+around submit/execute with context propagation) and the C++ ``ProfileEvent``
+timeline (``src/ray/core_worker/profiling.h:64``) dumped as chrome://tracing
+JSON via ``ray.timeline()`` (``python/ray/state.py:843``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+_lock = threading.Lock()
+_events: List[dict] = []
+_enabled = False
+
+
+def enable(flag: bool = True):
+    global _enabled
+    _enabled = flag
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+class span:
+    """RAII profile span (ProfileEvent parity)."""
+
+    def __init__(self, name: str, category: str = "task", **meta):
+        self.name = name
+        self.category = category
+        self.meta = meta
+        self.t0 = 0.0
+
+    def __enter__(self):
+        self.t0 = time.time()
+        return self
+
+    def __exit__(self, *exc):
+        if not _enabled:
+            return
+        with _lock:
+            _events.append({
+                "name": self.name,
+                "cat": self.category,
+                "ph": "X",
+                "ts": self.t0 * 1e6,
+                "dur": (time.time() - self.t0) * 1e6,
+                "pid": 0,
+                "tid": threading.get_ident() % 2**31,
+                "args": self.meta,
+            })
+
+
+def record_instant(name: str, **meta):
+    if not _enabled:
+        return
+    with _lock:
+        _events.append({"name": name, "ph": "i", "ts": time.time() * 1e6,
+                        "pid": 0, "tid": threading.get_ident() % 2**31,
+                        "s": "g", "args": meta})
+
+
+def chrome_tracing_dump() -> List[dict]:
+    with _lock:
+        return list(_events)
+
+
+def clear():
+    with _lock:
+        _events.clear()

@@ -1,0 +1,51 @@
+"""Shared fixtures (reference: python/ray/tests/conftest.py —
+ray_start_regular / ray_start_cluster).
+
+JAX-dependent tests run on a virtual 8-device CPU mesh: the env vars must
+be set before jax is first imported, hence at conftest import time.
+Multi-chip sharding is validated this way (and by the driver's
+dryrun_multichip); the real TPU chip is used by bench.py only.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    import ray_tpu
+    ctx = ray_tpu.init(num_cpus=4)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_2_cpus():
+    import ray_tpu
+    ctx = ray_tpu.init(num_cpus=2)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    """A Cluster the test can add/remove nodes on (cluster_utils parity)."""
+    import ray_tpu
+    from ray_tpu._private.cluster import Cluster
+    created = []
+
+    def factory(**head_args):
+        cluster = Cluster(initialize_head=True, head_node_args=head_args)
+        created.append(cluster)
+        ray_tpu.init(_cluster=cluster)
+        return cluster
+
+    yield factory
+    ray_tpu.shutdown()

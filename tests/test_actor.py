@@ -1,0 +1,131 @@
+"""Actor tests (reference: python/ray/tests/test_actor.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.v = start
+
+    def inc(self, by=1):
+        self.v += by
+        return self.v
+
+    def read(self):
+        return self.v
+
+
+def test_actor_basic(ray_start_regular):
+    c = Counter.remote()
+    assert ray_tpu.get(c.inc.remote()) == 1
+    assert ray_tpu.get(c.inc.remote(by=5)) == 6
+
+
+def test_actor_ordering(ray_start_regular):
+    c = Counter.remote()
+    refs = [c.inc.remote() for _ in range(50)]
+    assert ray_tpu.get(refs) == list(range(1, 51))
+
+
+def test_actor_constructor_args(ray_start_regular):
+    c = Counter.remote(start=100)
+    assert ray_tpu.get(c.read.remote()) == 100
+
+
+def test_actor_error(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        def bad(self):
+            raise RuntimeError("actor err")
+
+        def ok(self):
+            return 1
+
+    a = A.remote()
+    with pytest.raises(RuntimeError, match="actor err"):
+        ray_tpu.get(a.bad.remote())
+    # Actor survives a user exception.
+    assert ray_tpu.get(a.ok.remote()) == 1
+
+
+def test_named_actor(ray_start_regular):
+    a = Counter.options(name="counter1").remote()
+    ray_tpu.get(a.inc.remote())
+    b = ray_tpu.get_actor("counter1")
+    assert ray_tpu.get(b.read.remote()) == 1
+
+
+def test_named_actor_duplicate(ray_start_regular):
+    Counter.options(name="dup").remote()
+    time.sleep(0.05)
+    with pytest.raises(ValueError):
+        Counter.options(name="dup").remote()
+
+
+def test_get_actor_missing(ray_start_regular):
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("never-created")
+
+
+def test_kill_actor(ray_start_regular):
+    c = Counter.remote()
+    ray_tpu.get(c.inc.remote())
+    ray_tpu.kill(c)
+    time.sleep(0.2)
+    with pytest.raises(ray_tpu.exceptions.ActorError):
+        ray_tpu.get(c.inc.remote())
+
+
+def test_actor_handle_pass(ray_start_regular):
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    def bump(handle):
+        return ray_tpu.get(handle.inc.remote())
+
+    assert ray_tpu.get(bump.remote(c)) == 1
+    assert ray_tpu.get(c.read.remote()) == 1
+
+
+def test_actor_max_concurrency(ray_start_regular):
+    @ray_tpu.remote(max_concurrency=4)
+    class Waiter:
+        def __init__(self):
+            self.n = 0
+
+        def block(self):
+            time.sleep(0.3)
+            return time.monotonic()
+
+    w = Waiter.remote()
+    t0 = time.monotonic()
+    ray_tpu.get([w.block.remote() for _ in range(4)])
+    # 4 concurrent 0.3s sleeps should take well under 4*0.3.
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_actor_in_actor(ray_start_regular):
+    @ray_tpu.remote
+    class Parent:
+        def __init__(self):
+            self.child = Counter.remote()
+
+        def bump_child(self):
+            return ray_tpu.get(self.child.inc.remote())
+
+    p = Parent.remote()
+    assert ray_tpu.get(p.bump_child.remote()) == 1
+    assert ray_tpu.get(p.bump_child.remote()) == 2
+
+
+def test_actor_holds_resources(ray_start_regular):
+    # 4 CPUs on the head node; each actor holds 2.
+    a1 = Counter.options(num_cpus=2).remote()
+    ray_tpu.get(a1.read.remote())
+    avail = ray_tpu.available_resources()
+    assert avail["CPU"] <= 2.0

@@ -1,0 +1,207 @@
+"""Core task API tests (reference: python/ray/tests/test_basic.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def f(a, b):
+        return a + b
+
+    assert ray_tpu.get(f.remote(1, 2)) == 3
+
+
+def test_kwargs_and_defaults(ray_start_regular):
+    @ray_tpu.remote
+    def f(a, b=10, *, c=100):
+        return a + b + c
+
+    assert ray_tpu.get(f.remote(1)) == 111
+    assert ray_tpu.get(f.remote(1, b=2, c=3)) == 6
+
+
+def test_object_ref_args(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x * 2
+
+    r1 = f.remote(10)
+    r2 = f.remote(r1)   # ref as arg resolves to its value
+    assert ray_tpu.get(r2) == 40
+
+
+def test_kwarg_object_ref(ray_start_regular):
+    @ray_tpu.remote
+    def f(x=0):
+        return x + 1
+
+    ref = ray_tpu.put(41)
+    assert ray_tpu.get(f.remote(x=ref)) == 42
+
+
+def test_put_get_roundtrip(ray_start_regular):
+    for value in [1, "s", [1, 2, {"k": (3, 4)}], None, b"bytes"]:
+        assert ray_tpu.get(ray_tpu.put(value)) == value
+
+
+def test_put_get_numpy_zero_copy(ray_start_regular):
+    x = np.random.rand(1024, 256).astype(np.float32)
+    y = ray_tpu.get(ray_tpu.put(x))
+    np.testing.assert_array_equal(x, y)
+
+
+def test_large_object_through_node_store(ray_start_regular):
+    x = np.zeros(10 * 1024 * 1024, dtype=np.uint8)  # 10MB > inline limit
+    ref = ray_tpu.put(x)
+    y = ray_tpu.get(ref)
+    assert y.nbytes == x.nbytes
+
+
+def test_large_arg_promotion(ray_start_regular):
+    big = np.ones(2 * 1024 * 1024, dtype=np.float64)
+
+    @ray_tpu.remote
+    def s(a):
+        return float(a.sum())
+
+    assert ray_tpu.get(s.remote(big)) == big.sum()
+
+
+def test_num_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def f():
+        return 1, 2, 3
+
+    a, b, c = f.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_num_returns_zero(ray_start_regular):
+    out = {}
+
+    @ray_tpu.remote(num_returns=0)
+    def f():
+        out["ran"] = True
+
+    assert f.remote() is None
+
+
+def test_options_override(ray_start_regular):
+    @ray_tpu.remote(num_cpus=1)
+    def f():
+        return ray_tpu.get_runtime_context().get_assigned_resources()
+
+    res = ray_tpu.get(f.options(num_cpus=2).remote())
+    assert res["CPU"] == 2
+
+
+def test_error_propagation(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("bang")
+
+    with pytest.raises(ValueError, match="bang"):
+        ray_tpu.get(boom.remote())
+
+
+def test_error_through_dependency(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise KeyError("k")
+
+    @ray_tpu.remote
+    def use(x):
+        return x
+
+    with pytest.raises(Exception):
+        ray_tpu.get(use.remote(boom.remote()))
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def inner(i):
+        return i * i
+
+    @ray_tpu.remote
+    def outer(n):
+        return sum(ray_tpu.get([inner.remote(i) for i in range(n)]))
+
+    assert ray_tpu.get(outer.remote(5)) == 30
+
+
+def test_wait(ray_start_regular):
+    import time
+
+    @ray_tpu.remote
+    def fast():
+        return 1
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return 2
+
+    refs = [fast.remote(), slow.remote()]
+    ready, not_ready = ray_tpu.wait(refs, num_returns=1, timeout=3)
+    assert len(ready) == 1 and len(not_ready) == 1
+
+
+def test_wait_validation(ray_start_regular):
+    r = ray_tpu.put(1)
+    with pytest.raises(ValueError):
+        ray_tpu.wait([r, r])
+    with pytest.raises(TypeError):
+        ray_tpu.wait([1, 2])
+
+
+def test_get_timeout(ray_start_regular):
+    import time
+
+    @ray_tpu.remote
+    def hang():
+        time.sleep(30)
+
+    with pytest.raises(ray_tpu.exceptions.GetTimeoutError):
+        ray_tpu.get(hang.remote(), timeout=0.2)
+
+
+def test_many_tasks_throughput(ray_start_regular):
+    @ray_tpu.remote
+    def noop(i):
+        return i
+
+    refs = [noop.remote(i) for i in range(500)]
+    assert sum(ray_tpu.get(refs)) == sum(range(500))
+
+
+def test_nested_object_refs(ray_start_regular):
+    inner = ray_tpu.put("inner-value")
+    outer = ray_tpu.put({"ref": inner})
+
+    @ray_tpu.remote
+    def deref(d):
+        return ray_tpu.get(d["ref"])
+
+    assert ray_tpu.get(deref.remote(outer)) == "inner-value"
+
+
+def test_runtime_context(ray_start_regular):
+    @ray_tpu.remote
+    def whoami():
+        ctx = ray_tpu.get_runtime_context()
+        return ctx.get_task_id(), ctx.get_node_id(), ctx.get_job_id()
+
+    task_id, node_id, job_id = ray_tpu.get(whoami.remote())
+    assert task_id and node_id and job_id
+
+
+def test_reinit_guard():
+    import ray_tpu
+    ray_tpu.init(num_cpus=1)
+    with pytest.raises(RuntimeError):
+        ray_tpu.init()
+    ray_tpu.init(ignore_reinit_error=True)
+    ray_tpu.shutdown()

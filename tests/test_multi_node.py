@@ -1,0 +1,67 @@
+"""Multi-node cluster tests (reference:
+python/ray/tests/test_multi_node.py via cluster_utils.Cluster)."""
+
+import time
+
+import numpy as np
+
+import ray_tpu
+
+
+def test_add_remove_node(ray_start_cluster):
+    cluster = ray_start_cluster(num_cpus=1)
+    n2 = cluster.add_node(num_cpus=2)
+    assert cluster.wait_for_nodes(2)
+    assert ray_tpu.cluster_resources()["CPU"] == 3.0
+    cluster.remove_node(n2)
+    time.sleep(0.2)
+    assert ray_tpu.cluster_resources()["CPU"] == 1.0
+
+
+def test_cross_node_object_transfer(ray_start_cluster):
+    cluster = ray_start_cluster(num_cpus=1)
+    cluster.add_node(num_cpus=1, resources={"there": 1})
+    assert cluster.wait_for_nodes(2)
+
+    @ray_tpu.remote(resources={"there": 0.5}, num_cpus=0)
+    def produce():
+        return np.arange(1_000_000, dtype=np.float32)  # 4MB -> node store
+
+    @ray_tpu.remote(num_cpus=1)
+    def consume(x):
+        return float(x.sum())
+
+    ref = produce.remote()
+    # consume runs on the head node (no "there" resource) -> cross-node pull
+    assert ray_tpu.get(consume.remote(ref)) == float(
+        np.arange(1_000_000, dtype=np.float32).sum())
+
+
+def test_tasks_flow_to_many_nodes(ray_start_cluster):
+    cluster = ray_start_cluster(num_cpus=2)
+    for _ in range(3):
+        cluster.add_node(num_cpus=2)
+    assert cluster.wait_for_nodes(4)
+    time.sleep(0.3)
+
+    @ray_tpu.remote
+    def where():
+        time.sleep(0.1)
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    nodes = set(ray_tpu.get([where.remote() for _ in range(16)]))
+    assert len(nodes) >= 3
+
+
+def test_actor_on_remote_node(ray_start_cluster):
+    cluster = ray_start_cluster(num_cpus=1)
+    remote_node = cluster.add_node(num_cpus=4, resources={"spot": 1})
+    assert cluster.wait_for_nodes(2)
+
+    @ray_tpu.remote(resources={"spot": 1}, num_cpus=1)
+    class A:
+        def where(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    a = A.remote()
+    assert ray_tpu.get(a.where.remote()) == remote_node.node_id.hex()

@@ -1,0 +1,122 @@
+"""Placement group tests (reference:
+python/ray/tests/test_placement_group.py, 5 files)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import (
+    placement_group, placement_group_table, remove_placement_group)
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+
+def test_pack_pg_created(ray_start_cluster):
+    cluster = ray_start_cluster(num_cpus=4)
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.wait(5)
+    info = placement_group_table(pg)
+    assert info["state"] == "CREATED"
+    # PACK on one node.
+    assert len(set(info["bundle_nodes"].values())) == 1
+
+
+def test_strict_spread_needs_distinct_nodes(ray_start_cluster):
+    cluster = ray_start_cluster(num_cpus=2)
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert not pg.wait(0.5), "only one node: STRICT_SPREAD must pend"
+    cluster.add_node(num_cpus=2)
+    assert pg.wait(5)
+    info = placement_group_table(pg)
+    assert len(set(info["bundle_nodes"].values())) == 2
+
+
+def test_strict_pack_single_node(ray_start_cluster):
+    cluster = ray_start_cluster(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    pg = placement_group([{"CPU": 2}, {"CPU": 2}], strategy="STRICT_PACK")
+    assert not pg.wait(0.5), "no single node has 4 CPUs"
+    cluster.add_node(num_cpus=8)
+    assert pg.wait(5)
+    info = placement_group_table(pg)
+    assert len(set(info["bundle_nodes"].values())) == 1
+
+
+def test_task_in_placement_group(ray_start_cluster):
+    cluster = ray_start_cluster(num_cpus=2)
+    target = cluster.add_node(num_cpus=4)
+    pg = placement_group([{"CPU": 3}], strategy="PACK")
+    assert pg.wait(5)
+
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        return ray_tpu.get_runtime_context().get_node_id()
+
+    node = ray_tpu.get(where.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0)).remote())
+    info = placement_group_table(pg)
+    assert node == info["bundle_nodes"][0]
+
+
+def test_actor_in_placement_group(ray_start_cluster):
+    cluster = ray_start_cluster(num_cpus=2)
+    cluster.add_node(num_cpus=4)
+    pg = placement_group([{"CPU": 2}], strategy="PACK")
+    assert pg.wait(5)
+
+    @ray_tpu.remote(num_cpus=1)
+    class A:
+        def where(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    a = A.options(scheduling_strategy=PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=0)).remote()
+    node = ray_tpu.get(a.where.remote())
+    assert node == placement_group_table(pg)["bundle_nodes"][0]
+
+
+def test_remove_placement_group_frees_resources(ray_start_regular):
+    pg = placement_group([{"CPU": 3}], strategy="PACK")
+    assert pg.wait(5)
+    avail = ray_tpu.available_resources()
+    assert avail.get("CPU", 0) <= 1.0
+    remove_placement_group(pg)
+    time.sleep(0.3)
+    avail = ray_tpu.available_resources()
+    assert avail.get("CPU", 0) >= 3.0
+    assert placement_group_table(pg)["state"] == "REMOVED"
+
+
+def test_pg_ready_api(ray_start_regular):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert ray_tpu.get(pg.ready(), timeout=10) is True
+
+
+def test_invalid_bundles(ray_start_regular):
+    with pytest.raises(ValueError):
+        placement_group([])
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": 0}])
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": -1}])
+
+
+def test_pg_reschedules_on_node_death(ray_start_cluster):
+    cluster = ray_start_cluster(num_cpus=1)
+    extra = cluster.add_node(num_cpus=4, resources={"big": 1})
+    pg = placement_group([{"CPU": 4}], strategy="PACK")
+    assert pg.wait(5)
+    spare = cluster.add_node(num_cpus=4)
+    # Graceful removal triggers immediate death notification.
+    cluster.remove_node(extra)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        info = placement_group_table(pg)
+        if info["state"] == "CREATED" and \
+                spare.node_id.hex() in info["bundle_nodes"].values():
+            break
+        time.sleep(0.05)
+    info = placement_group_table(pg)
+    assert info["state"] == "CREATED"
+    assert list(info["bundle_nodes"].values()) == [spare.node_id.hex()]

@@ -1,0 +1,76 @@
+"""Reference counting / object lifetime tests
+(reference: python/ray/tests/test_reference_counting.py +
+src/ray/core_worker/reference_count_test.cc semantics)."""
+
+import gc
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu._private import worker as worker_mod
+
+
+def _core():
+    return worker_mod.global_worker().core_worker
+
+
+def test_out_of_scope_frees_object(ray_start_regular):
+    ref = ray_tpu.put(np.zeros(1024 * 1024, dtype=np.uint8))
+    oid = ref.object_id()
+    core = _core()
+    assert core.reference_counter.has_reference(oid)
+    del ref
+    gc.collect()
+    assert not core.reference_counter.has_reference(oid)
+    # Freed from the node store too.
+    raylet = worker_mod.global_worker().cluster.head_node
+    assert not raylet.object_store.contains(oid)
+
+
+def test_submitted_task_ref_pins(ray_start_regular):
+    import time
+
+    @ray_tpu.remote
+    def slow_identity(x):
+        time.sleep(0.3)
+        return x
+
+    ref = ray_tpu.put(123)
+    oid = ref.object_id()
+    out = slow_identity.remote(ref)
+    del ref
+    gc.collect()
+    core = _core()
+    # The pending task still holds a reference.
+    assert core.reference_counter.has_reference(oid)
+    assert ray_tpu.get(out) == 123
+
+
+def test_contained_ref_kept_alive(ray_start_regular):
+    inner = ray_tpu.put("payload")
+    inner_id = inner.object_id()
+    outer = ray_tpu.put([inner])
+    del inner
+    gc.collect()
+    core = _core()
+    # Outer's value contains the inner ref -> still reachable.
+    assert core.reference_counter.has_reference(inner_id)
+    got_inner = ray_tpu.get(outer)[0]
+    assert ray_tpu.get(got_inner) == "payload"
+    del got_inner, outer
+    gc.collect()
+    assert not core.reference_counter.has_reference(inner_id)
+
+
+def test_return_value_lifetime(ray_start_regular):
+    @ray_tpu.remote
+    def make():
+        return np.ones(4)
+
+    ref = make.remote()
+    np.testing.assert_array_equal(ray_tpu.get(ref), np.ones(4))
+    oid = ref.object_id()
+    core = _core()
+    del ref
+    gc.collect()
+    assert not core.reference_counter.has_reference(oid)

@@ -1,0 +1,139 @@
+"""Scheduler unit + integration tests (reference:
+python/ray/tests/test_scheduling.py and
+src/ray/raylet/scheduling/*_test.cc driven via fake NodeResources maps)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.ids import NodeID
+from ray_tpu.scheduler.policy import (
+    SchedulingOptions, SchedulingType, schedule)
+from ray_tpu.scheduler.resources import (
+    ClusterResourceView, NodeResources, ResourceRequest)
+
+
+def make_view(node_specs):
+    """node_specs: list of dicts of totals; returns (view, node_ids)."""
+    view = ClusterResourceView()
+    ids = []
+    for spec in node_specs:
+        nid = NodeID.from_random()
+        view.add_node(nid, NodeResources(spec))
+        ids.append(nid)
+    return view, ids
+
+
+class TestPolicies:
+    def test_hybrid_prefers_local_under_threshold(self):
+        view, ids = make_view([{"CPU": 8}, {"CPU": 8}])
+        target = schedule(view, ResourceRequest({"CPU": 1}),
+                          SchedulingOptions.hybrid(), local_node_id=ids[1])
+        assert target == ids[1]
+
+    def test_hybrid_spreads_over_threshold(self):
+        view, ids = make_view([{"CPU": 2}, {"CPU": 2}])
+        # Load the local node past the 0.5 threshold.
+        assert view.subtract(ids[0], ResourceRequest({"CPU": 2}))
+        target = schedule(view, ResourceRequest({"CPU": 1}),
+                          SchedulingOptions.hybrid(), local_node_id=ids[0])
+        assert target == ids[1]
+
+    def test_infeasible_returns_none(self):
+        view, ids = make_view([{"CPU": 2}])
+        target = schedule(view, ResourceRequest({"CPU": 16}),
+                          SchedulingOptions.hybrid(), local_node_id=ids[0])
+        assert target is None
+
+    def test_feasible_but_unavailable_queues_on_feasible_node(self):
+        view, ids = make_view([{"CPU": 1}, {"CPU": 8}])
+        view.subtract(ids[1], ResourceRequest({"CPU": 8}))
+        target = schedule(view, ResourceRequest({"CPU": 4}),
+                          SchedulingOptions.hybrid(), local_node_id=ids[0])
+        assert target == ids[1]
+
+    def test_avoid_tpu_nodes_for_cpu_work(self):
+        view, ids = make_view([{"CPU": 8, "TPU": 4}, {"CPU": 8}])
+        target = schedule(view, ResourceRequest({"CPU": 1}),
+                          SchedulingOptions.hybrid(), local_node_id=None)
+        assert target == ids[1]
+
+    def test_tpu_task_lands_on_tpu_node(self):
+        view, ids = make_view([{"CPU": 8}, {"CPU": 8, "TPU": 4}])
+        target = schedule(view, ResourceRequest({"TPU": 1}),
+                          SchedulingOptions.hybrid(), local_node_id=ids[0])
+        assert target == ids[1]
+
+    def test_spread_distributes(self):
+        view, ids = make_view([{"CPU": 4}] * 4)
+        seen = set()
+        for _ in range(16):
+            t = schedule(view, ResourceRequest({"CPU": 1}),
+                         SchedulingOptions.spread(), local_node_id=ids[0])
+            seen.add(t)
+            view.subtract(t, ResourceRequest({"CPU": 1}))
+        assert len(seen) == 4
+
+    def test_node_affinity(self):
+        view, ids = make_view([{"CPU": 4}, {"CPU": 4}])
+        target = schedule(view, ResourceRequest({"CPU": 1}),
+                          SchedulingOptions.affinity(ids[1]),
+                          local_node_id=ids[0])
+        assert target == ids[1]
+
+    def test_custom_resources(self):
+        view, ids = make_view([{"CPU": 4}, {"CPU": 4, "accel": 2}])
+        target = schedule(view, ResourceRequest({"accel": 1}),
+                          SchedulingOptions.hybrid(), local_node_id=ids[0])
+        assert target == ids[1]
+
+
+class TestSchedulingIntegration:
+    def test_custom_resource_task(self, ray_start_cluster):
+        cluster = ray_start_cluster(num_cpus=2)
+        cluster.add_node(num_cpus=2, resources={"special": 1})
+        assert cluster.wait_for_nodes(2)
+
+        @ray_tpu.remote(resources={"special": 1}, num_cpus=0)
+        def where():
+            return ray_tpu.get_runtime_context().get_node_id()
+
+        node_id = ray_tpu.get(where.remote())
+        special = [r for r in cluster.raylets()
+                   if "special" in r.local_resources.total][0]
+        assert node_id == special.node_id.hex()
+
+    def test_spillback_to_free_node(self, ray_start_cluster):
+        cluster = ray_start_cluster(num_cpus=1)
+        cluster.add_node(num_cpus=4)
+        assert cluster.wait_for_nodes(2)
+        time.sleep(0.3)  # let resource broadcast converge
+
+        @ray_tpu.remote(num_cpus=1)
+        def where():
+            time.sleep(0.2)
+            return ray_tpu.get_runtime_context().get_node_id()
+
+        nodes = set(ray_tpu.get([where.remote() for _ in range(5)]))
+        assert len(nodes) == 2, "load should spill beyond the head node"
+
+    def test_fractional_resources(self, ray_start_regular):
+        @ray_tpu.remote(num_cpus=0.5)
+        def f():
+            return 1
+
+        assert sum(ray_tpu.get([f.remote() for _ in range(8)])) == 8
+
+    def test_infeasible_task_waits_then_runs(self, ray_start_cluster):
+        cluster = ray_start_cluster(num_cpus=1)
+
+        @ray_tpu.remote(num_cpus=8)
+        def big():
+            return "ran"
+
+        ref = big.remote()
+        ready, _ = ray_tpu.wait([ref], timeout=0.5)
+        assert not ready  # infeasible: parked
+        cluster.add_node(num_cpus=8)
+        assert ray_tpu.get(ref, timeout=10) == "ran"
