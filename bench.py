@@ -1,0 +1,95 @@
+"""Headline benchmark: one scheduler tick at 1M pending tasks x 10k nodes.
+
+North star (BASELINE.md / BASELINE.json): snapshot the pending-task queue
+(deduped into scheduling classes, task_spec.h:297) and per-node resource
+vectors, solve the batched task->node assignment on TPU in <50 ms/tick on a
+single host.  The reference's greedy loop
+(``HybridSchedulingPolicy::Schedule`` per task over per-node hash maps)
+is replaced by ``ray_tpu.scheduler.jax_backend``'s dense [C,R]x[N,R] solve.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": <ms per tick>, "unit": "ms", "vs_baseline": x}
+vs_baseline > 1.0 means faster than the 50 ms target.
+
+Problem shape (config 5 of BASELINE.json, Google-cluster-trace shaped):
+1,000,000 tasks in 256 scheduling classes, 10,000 heterogeneous nodes,
+8 resource columns.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def build_problem(rng, num_tasks=1_000_000, C=256, N=10_000, R=8):
+    # Heterogeneous fleet: small CPU nodes, big CPU nodes, TPU hosts.
+    total = np.zeros((N, R), dtype=np.float32)
+    kinds = rng.choice(3, size=N, p=[0.6, 0.3, 0.1])
+    total[:, 0] = np.where(kinds == 0, 4, np.where(kinds == 1, 64, 8))  # CPU
+    total[:, 1] = np.where(kinds == 0, 16, np.where(kinds == 1, 256, 64))  # mem GB
+    total[:, 2] = np.where(kinds == 2, 4, 0)   # TPU chips
+    total[:, 3] = rng.integers(0, 2, N)        # GPU-ish custom accel
+    for r in range(4, R):
+        total[:, r] = rng.integers(0, 8, N)    # custom resources
+    used = rng.uniform(0.0, 0.6, size=(N, R)).astype(np.float32)
+    avail = np.floor(total * (1.0 - used))
+
+    # Trace-shaped demand: most classes small CPU tasks, a tail of
+    # memory-heavy and accelerator classes; counts follow a power law.
+    demand = np.zeros((C, R), dtype=np.float32)
+    demand[:, 0] = rng.choice([0.5, 1, 2, 4], size=C, p=[0.4, 0.4, 0.15, 0.05])
+    demand[:, 1] = rng.choice([1, 2, 4, 16], size=C, p=[0.5, 0.3, 0.15, 0.05])
+    accel_classes = rng.random(C) < 0.08
+    demand[accel_classes, 2] = rng.choice([1, 4], size=accel_classes.sum())
+    raw = rng.pareto(1.5, size=C) + 1.0
+    counts = np.floor(raw / raw.sum() * num_tasks).astype(np.int64)
+    counts[-1] += num_tasks - counts.sum()
+    accel_node = total[:, 2] > 0
+    return avail, total, demand, counts, accel_node, accel_classes
+
+
+def main():
+    rng = np.random.default_rng(42)
+    avail, total, demand, counts, accel_node, accel_class = build_problem(rng)
+
+    from ray_tpu.scheduler.jax_backend import BatchSolver
+    solver = BatchSolver(mode="waterfill")
+
+    # Warmup (compile) + correctness check on the real solve.
+    alloc = solver.solve_matrices(avail, total, demand, counts,
+                                  accel_node, accel_class, 0.5)
+    usage = alloc.T.astype(np.float64) @ demand.astype(np.float64)
+    assert (usage <= avail.astype(np.float64) + 1e-2).all(), \
+        "capacity violated"
+    assert (alloc.sum(axis=1) <= counts).all()
+    placed = int(alloc.sum())
+
+    # Timed ticks: fresh availability each tick (host->device transfer
+    # included — that IS the tick cost the raylet would pay).
+    iters = 20
+    t0 = time.perf_counter()
+    for i in range(iters):
+        solver.solve_matrices(avail, total, demand, counts,
+                              accel_node, accel_class, 0.5)
+    elapsed = time.perf_counter() - t0
+    ms_per_tick = elapsed / iters * 1000.0
+
+    baseline_ms = 50.0  # BASELINE.json target: <50 ms/tick
+    import jax
+    out = {
+        "metric": "scheduler_tick_1M_tasks_x_10k_nodes",
+        "value": round(ms_per_tick, 3),
+        "unit": "ms",
+        "vs_baseline": round(baseline_ms / ms_per_tick, 2),
+        "placed_tasks": placed,
+        "classes": int(demand.shape[0]),
+        "nodes": int(avail.shape[0]),
+        "backend": jax.default_backend(),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

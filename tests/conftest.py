@@ -15,6 +15,15 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+# The TPU-tunnel site registration force-sets jax_platforms="axon,cpu" via
+# jax.config (overriding the env var), and initializing that backend from a
+# test process can block on the tunnel.  Setting the config back to pure CPU
+# here — before any backend is initialized — pins the whole test session to
+# the virtual 8-device CPU mesh.  bench.py (real TPU) is unaffected.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
