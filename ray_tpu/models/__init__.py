@@ -1,0 +1,5 @@
+"""Model zoo built on ray_tpu.ops/parallel."""
+
+from ray_tpu.models.transformer import (  # noqa: F401
+    TransformerConfig, forward, init_params, loss_fn, make_train_state,
+    make_train_step, param_specs)

@@ -1,0 +1,246 @@
+"""Flagship model: a decoder-only transformer, TPU-first.
+
+Design notes (this is the model the framework's Train library and the
+graft entry exercise):
+  * Pure functional jax — params are a pytree of arrays, the whole train
+    step is one ``jit`` over a global ``Mesh``; XLA/GSPMD inserts all
+    collectives from the shardings (no hand-written allreduce, unlike the
+    reference's Train/torch DDP backend, ``python/ray/train/torch.py``).
+  * Megatron-style tensor parallelism over ``tp`` (heads + FFN hidden
+    sharded), data parallel over ``dp``, context parallel over ``sp``
+    via ring attention (ops/ring_attention.py), sequence-parallel
+    activation sharding between blocks.
+  * ``lax.scan`` over stacked layer params — one compilation regardless
+    of depth; optional ``jax.checkpoint`` rematerialisation.
+  * bf16 activations/params with f32 RMSNorm + softmax + Adam moments.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_tpu.ops.flash_attention import attention as flash_or_ref_attention
+from ray_tpu.ops.ring_attention import ring_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    d_ff: int = 1408
+    max_seq_len: int = 2048
+    rope_theta: float = 10_000.0
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    #: Use ring attention over the "sp" mesh axis when its size > 1.
+    context_parallel: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init_params(rng: jax.Array, cfg: TransformerConfig) -> Dict:
+    k_embed, k_layers, k_head = jax.random.split(rng, 3)
+    d, h, dh, f, nl = (cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff,
+                       cfg.n_layers)
+    init = jax.nn.initializers.normal(0.02)
+    lkeys = jax.random.split(k_layers, 6)
+
+    def stacked(key, shape):
+        return init(key, (nl,) + shape, jnp.float32).astype(cfg.dtype)
+
+    return {
+        "embed": init(k_embed, (cfg.vocab_size, d), jnp.float32
+                      ).astype(cfg.dtype),
+        "layers": {
+            "ln1": jnp.ones((nl, d), jnp.float32),
+            "ln2": jnp.ones((nl, d), jnp.float32),
+            "wq": stacked(lkeys[0], (d, h, dh)),
+            "wk": stacked(lkeys[1], (d, h, dh)),
+            "wv": stacked(lkeys[2], (d, h, dh)),
+            "wo": stacked(lkeys[3], (h, dh, d)),
+            "w1": stacked(lkeys[4], (d, f)),
+            "w3": stacked(lkeys[5], (d, f)),
+            "w2": stacked(jax.random.fold_in(k_layers, 7), (f, d)),
+        },
+        "ln_f": jnp.ones((d,), jnp.float32),
+        "lm_head": init(k_head, (d, cfg.vocab_size), jnp.float32
+                        ).astype(cfg.dtype),
+    }
+
+
+def param_specs(cfg: TransformerConfig) -> Dict:
+    """PartitionSpecs: Megatron TP on heads/FFN-hidden, vocab on lm_head."""
+    return {
+        "embed": P(None, "tp"),
+        "layers": {
+            "ln1": P(None, None),
+            "ln2": P(None, None),
+            "wq": P(None, None, "tp", None),
+            "wk": P(None, None, "tp", None),
+            "wv": P(None, None, "tp", None),
+            "wo": P(None, "tp", None, None),
+            "w1": P(None, None, "tp"),
+            "w3": P(None, None, "tp"),
+            "w2": P(None, "tp", None),
+        },
+        "ln_f": P(None),
+        "lm_head": P(None, "tp"),
+    }
+
+
+def batch_spec() -> P:
+    return P("dp", "sp")
+
+
+def _rms_norm(x, w, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    norm = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (norm * w).astype(x.dtype)
+
+
+def _rope(x, positions, theta):
+    # x: [B, S, H, D]; rotate pairs.
+    d = x.shape[-1]
+    half = d // 2
+    freqs = jnp.exp(-jnp.log(theta) *
+                    jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
+
+
+def _attention_core(q, k, v, mesh, cfg: TransformerConfig):
+    if (cfg.context_parallel and mesh is not None and
+            mesh.shape.get("sp", 1) > 1):
+        from jax import shard_map
+        fn = shard_map(
+            functools.partial(ring_attention, axis_name="sp", causal=True),
+            mesh=mesh,
+            in_specs=(P("dp", "sp", "tp", None),) * 3,
+            out_specs=P("dp", "sp", "tp", None),
+            check_vma=False)
+        return fn(q, k, v)
+    return flash_or_ref_attention(q, k, v, causal=True)
+
+
+def forward(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
+            mesh=None) -> jax.Array:
+    """tokens [B, S] int32 -> logits [B, S, V]."""
+    B, S = tokens.shape
+    x = jnp.take(params["embed"], tokens, axis=0)     # [B, S, D]
+    if mesh is not None:
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P("dp", "sp", None)))
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
+
+    def layer(x, lp):
+        h = _rms_norm(x, lp["ln1"])
+        q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
+        k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
+        v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        o = _attention_core(q, k, v, mesh, cfg)
+        x = x + jnp.einsum("bshk,hkd->bsd", o, lp["wo"])
+        h = _rms_norm(x, lp["ln2"])
+        gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, lp["w1"]))
+        up = jnp.einsum("bsd,df->bsf", h, lp["w3"])
+        x = x + jnp.einsum("bsf,fd->bsd", gate * up, lp["w2"])
+        if mesh is not None:
+            x = jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P("dp", "sp", None)))
+        return x, None
+
+    layer_fn = jax.checkpoint(layer) if cfg.remat else layer
+    x, _ = jax.lax.scan(lambda c, lp: layer_fn(c, lp), x, params["layers"])
+    x = _rms_norm(x, params["ln_f"])
+    return jnp.einsum("bsd,dv->bsv", x, params["lm_head"])
+
+
+def loss_fn(params: Dict, batch: Dict, cfg: TransformerConfig,
+            mesh=None) -> jax.Array:
+    """Next-token cross entropy.  batch = {"tokens": [B, S+1] int32}."""
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = forward(params, inputs, cfg, mesh).astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None],
+                               axis=-1).squeeze(-1)
+    return jnp.mean(logz - gold)
+
+
+# ---------------------------------------------------------------------------
+# Train state + step factory (used by ray_tpu.train and the graft entry).
+# ---------------------------------------------------------------------------
+
+def make_train_state(rng, cfg: TransformerConfig, mesh=None,
+                     learning_rate: float = 3e-4):
+    import optax
+    tx = optax.adamw(learning_rate, b1=0.9, b2=0.95, weight_decay=0.1)
+    params = init_params(rng, cfg)
+    opt_state = tx.init(params)
+    state = {"params": params, "opt": opt_state,
+             "step": jnp.zeros((), jnp.int32)}
+    if mesh is not None:
+        specs = param_specs(cfg)
+        state_specs = {
+            "params": specs,
+            "opt": jax.tree.map(
+                lambda _: P(), opt_state,
+                is_leaf=lambda x: isinstance(x, jnp.ndarray)),
+            "step": P(),
+        }
+        # Adam moments mirror the param tree's specs.
+        state_specs["opt"] = _opt_specs(opt_state, specs)
+        state = jax.device_put(
+            state, jax.tree.map(
+                lambda s: NamedSharding(mesh, s), state_specs,
+                is_leaf=lambda x: isinstance(x, P)))
+    return state, tx
+
+
+def _opt_specs(opt_state, param_spec_tree):
+    """Mirror param specs onto the Adam moment trees, P() elsewhere."""
+    def one(entry):
+        if hasattr(entry, "mu") and hasattr(entry, "nu"):
+            return type(entry)(count=P(), mu=param_spec_tree,
+                               nu=param_spec_tree)
+        return jax.tree.map(lambda _: P(), entry)
+    return tuple(one(e) for e in opt_state)
+
+
+def make_train_step(cfg: TransformerConfig, tx, mesh=None):
+    def train_step(state, batch):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, batch, cfg, mesh))(state["params"])
+        updates, new_opt = tx.update(grads, state["opt"], state["params"])
+        new_params = jax.tree.map(
+            lambda p, u: (p.astype(jnp.float32) + u).astype(p.dtype),
+            state["params"], updates)
+        new_state = {"params": new_params, "opt": new_opt,
+                     "step": state["step"] + 1}
+        metrics = {"loss": loss,
+                   "grad_norm": optax_global_norm(grads)}
+        return new_state, metrics
+
+    donate = (0,)
+    return jax.jit(train_step, donate_argnums=donate)
+
+
+def optax_global_norm(tree) -> jax.Array:
+    leaves = jax.tree.leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
+                        for x in leaves))

@@ -1,0 +1,4 @@
+"""TPU compute ops: attention kernels, collectives-based primitives."""
+
+from ray_tpu.ops.flash_attention import attention, flash_attention  # noqa: F401
+from ray_tpu.ops.ring_attention import full_attention, ring_attention  # noqa: F401

@@ -1,0 +1,4 @@
+"""Parallelism substrate: meshes, shardings, collectives over ICI."""
+
+from ray_tpu.parallel.mesh import (  # noqa: F401
+    MeshConfig, build_mesh, infer_mesh_config, single_device_mesh)

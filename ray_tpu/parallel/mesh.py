@@ -1,0 +1,86 @@
+"""Device-mesh construction and axis conventions.
+
+The TPU-native replacement for the reference's process-group plumbing
+(Train's ``torch.py`` TCP rendezvous + NCCL groups, SURVEY.md §5.7/§5.8):
+parallelism is expressed as a ``jax.sharding.Mesh`` with named axes and
+XLA inserts the collectives (psum/all_gather/reduce_scatter/ppermute)
+over ICI.
+
+Axis conventions used across models/ and train/:
+  * ``dp``  — data parallel (batch dimension; gradients psum over it)
+  * ``tp``  — tensor parallel (attention heads / FFN hidden sharded;
+              activations sequence-sharded between blocks = "sequence
+              parallelism" in the Megatron sense)
+  * ``sp``  — context parallel (sequence sharded for ring attention)
+  * ``pp``  — pipeline stages (lax.scan over layer groups)
+  * ``ep``  — expert parallel (MoE experts sharded)
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    dp: int = 1
+    tp: int = 1
+    sp: int = 1
+    pp: int = 1
+    ep: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.tp * self.sp * self.pp * self.ep
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {"dp": self.dp, "tp": self.tp, "sp": self.sp,
+                "pp": self.pp, "ep": self.ep}
+
+
+def infer_mesh_config(n_devices: int, *, tp: Optional[int] = None,
+                      sp: int = 1, pp: int = 1, ep: int = 1) -> MeshConfig:
+    """Pick (dp, tp) to fill ``n_devices`` given fixed sp/pp/ep.
+
+    tp defaults to min(n_remaining, 4) rounded down to a power of two —
+    keeps tensor-parallel collectives on the shortest ICI rings.
+    """
+    rem = n_devices // (sp * pp * ep)
+    if rem < 1:
+        raise ValueError(f"{n_devices} devices can't fit sp={sp} pp={pp} "
+                         f"ep={ep}")
+    if tp is None:
+        tp = 1
+        while tp * 2 <= min(rem, 4) and rem % (tp * 2) == 0:
+            tp *= 2
+    dp = rem // tp
+    if dp * tp * sp * pp * ep != n_devices:
+        raise ValueError(
+            f"dp({dp})*tp({tp})*sp({sp})*pp({pp})*ep({ep}) != {n_devices}")
+    return MeshConfig(dp=dp, tp=tp, sp=sp, pp=pp, ep=ep)
+
+
+def build_mesh(config: MeshConfig, devices: Optional[Sequence] = None):
+    """Build a Mesh with all five axes (size-1 axes cost nothing).
+
+    Axis order is (dp, sp, pp, ep, tp): tp innermost so tensor-parallel
+    collectives ride neighbouring ICI links; dp outermost so gradient
+    all-reduces tolerate the slowest hops (DCN on multi-host).
+    """
+    import jax
+    from jax.sharding import Mesh
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < config.size:
+        raise ValueError(f"Need {config.size} devices, have {len(devices)}")
+    arr = np.array(devices[:config.size]).reshape(
+        config.dp, config.sp, config.pp, config.ep, config.tp)
+    return Mesh(arr, ("dp", "sp", "pp", "ep", "tp"))
+
+
+def single_device_mesh():
+    import jax
+    return build_mesh(MeshConfig(), devices=jax.devices()[:1])
