@@ -1,0 +1,228 @@
+// Shared-memory object store — the native core of the per-node store.
+//
+// TPU-native equivalent of the reference's Plasma store
+// (src/ray/object_manager/plasma/: dlmalloc over mmap'd shm, object table,
+// create/seal lifecycle, eviction hooks).  Differences by design:
+//   * one flat shm segment with a first-fit free-list allocator
+//     (coalescing on free) instead of vendored dlmalloc;
+//   * the object table lives in process memory (the store is owned by the
+//     node daemon; clients in this runtime are threads, and future
+//     multi-process clients mmap the same segment read-only and receive
+//     (offset, size) handles — zero-copy reads, like plasma's clients);
+//   * eviction/spilling policy stays in the Python LocalObjectManager;
+//     this layer only reports usage.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstring>
+#include <stdexcept>
+#include <fcntl.h>
+#include <map>
+#include <mutex>
+#include <string>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <unordered_map>
+
+namespace {
+
+struct Block {
+  uint64_t offset;
+  uint64_t size;
+};
+
+struct ObjectEntry {
+  uint64_t offset;
+  uint64_t size;
+  bool sealed;
+};
+
+class ShmStore {
+ public:
+  ShmStore(const char* name, uint64_t capacity)
+      : name_(name), capacity_(capacity) {
+    fd_ = shm_open(name, O_CREAT | O_RDWR, 0600);
+    if (fd_ < 0) throw std::runtime_error("shm_open failed");
+    if (ftruncate(fd_, static_cast<off_t>(capacity)) != 0) {
+      close(fd_);
+      throw std::runtime_error("ftruncate failed");
+    }
+    base_ = static_cast<uint8_t*>(mmap(nullptr, capacity,
+                                       PROT_READ | PROT_WRITE, MAP_SHARED,
+                                       fd_, 0));
+    if (base_ == MAP_FAILED) {
+      close(fd_);
+      throw std::runtime_error("mmap failed");
+    }
+    // One free block spanning the whole segment.
+    free_by_offset_[0] = capacity;
+  }
+
+  ~ShmStore() {
+    munmap(base_, capacity_);
+    close(fd_);
+    shm_unlink(name_.c_str());
+  }
+
+  // Returns offset or -1 if out of memory / duplicate.
+  int64_t Put(const std::string& key, const uint8_t* data, uint64_t size) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (objects_.count(key)) return -2;  // already present
+    int64_t off = Allocate(Align(size));
+    if (off < 0) return -1;
+    std::memcpy(base_ + off, data, size);
+    objects_[key] = ObjectEntry{static_cast<uint64_t>(off), size, true};
+    used_ += Align(size);
+    return off;
+  }
+
+  // Create without copying (caller writes through the mapped segment,
+  // then seals) — the plasma create/seal lifecycle.
+  int64_t Create(const std::string& key, uint64_t size) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (objects_.count(key)) return -2;
+    int64_t off = Allocate(Align(size));
+    if (off < 0) return -1;
+    objects_[key] = ObjectEntry{static_cast<uint64_t>(off), size, false};
+    used_ += Align(size);
+    return off;
+  }
+
+  int Seal(const std::string& key) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = objects_.find(key);
+    if (it == objects_.end()) return -1;
+    it->second.sealed = true;
+    return 0;
+  }
+
+  // Returns (offset, size) through out params; -1 if missing/unsealed.
+  int Get(const std::string& key, uint64_t* offset, uint64_t* size) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = objects_.find(key);
+    if (it == objects_.end() || !it->second.sealed) return -1;
+    *offset = it->second.offset;
+    *size = it->second.size;
+    return 0;
+  }
+
+  int Delete(const std::string& key) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = objects_.find(key);
+    if (it == objects_.end()) return -1;
+    Free(it->second.offset, Align(it->second.size));
+    used_ -= Align(it->second.size);
+    objects_.erase(it);
+    return 0;
+  }
+
+  uint64_t Used() const { return used_; }
+  uint64_t Capacity() const { return capacity_; }
+  uint64_t NumObjects() {
+    std::lock_guard<std::mutex> g(mu_);
+    return objects_.size();
+  }
+  uint8_t* Base() const { return base_; }
+  int Fd() const { return fd_; }
+
+ private:
+  static uint64_t Align(uint64_t n) { return (n + 63) & ~uint64_t(63); }
+
+  // First-fit over the offset-ordered free map; splits the block.
+  int64_t Allocate(uint64_t size) {
+    for (auto it = free_by_offset_.begin(); it != free_by_offset_.end();
+         ++it) {
+      if (it->second >= size) {
+        uint64_t off = it->first;
+        uint64_t remaining = it->second - size;
+        free_by_offset_.erase(it);
+        if (remaining > 0) free_by_offset_[off + size] = remaining;
+        return static_cast<int64_t>(off);
+      }
+    }
+    return -1;
+  }
+
+  // Free with coalescing of adjacent blocks.
+  void Free(uint64_t offset, uint64_t size) {
+    auto next = free_by_offset_.lower_bound(offset);
+    // Merge with next block if adjacent.
+    if (next != free_by_offset_.end() && offset + size == next->first) {
+      size += next->second;
+      next = free_by_offset_.erase(next);
+    }
+    // Merge with previous block if adjacent.
+    if (next != free_by_offset_.begin()) {
+      auto prev = std::prev(next);
+      if (prev->first + prev->second == offset) {
+        prev->second += size;
+        return;
+      }
+    }
+    free_by_offset_[offset] = size;
+  }
+
+  std::string name_;
+  uint64_t capacity_;
+  int fd_;
+  uint8_t* base_;
+  std::mutex mu_;
+  std::unordered_map<std::string, ObjectEntry> objects_;
+  std::map<uint64_t, uint64_t> free_by_offset_;  // offset -> size
+  uint64_t used_ = 0;
+};
+
+std::string MakeKey(const uint8_t* key, uint32_t keylen) {
+  return std::string(reinterpret_cast<const char*>(key), keylen);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* store_open(const char* name, uint64_t capacity) {
+  try {
+    return new ShmStore(name, capacity);
+  } catch (...) {
+    return nullptr;
+  }
+}
+
+void store_close(void* s) { delete static_cast<ShmStore*>(s); }
+
+int64_t store_put(void* s, const uint8_t* key, uint32_t keylen,
+                  const uint8_t* data, uint64_t size) {
+  return static_cast<ShmStore*>(s)->Put(MakeKey(key, keylen), data, size);
+}
+
+int64_t store_create(void* s, const uint8_t* key, uint32_t keylen,
+                     uint64_t size) {
+  return static_cast<ShmStore*>(s)->Create(MakeKey(key, keylen), size);
+}
+
+int store_seal(void* s, const uint8_t* key, uint32_t keylen) {
+  return static_cast<ShmStore*>(s)->Seal(MakeKey(key, keylen));
+}
+
+int store_get(void* s, const uint8_t* key, uint32_t keylen, uint64_t* offset,
+              uint64_t* size) {
+  return static_cast<ShmStore*>(s)->Get(MakeKey(key, keylen), offset, size);
+}
+
+int store_delete(void* s, const uint8_t* key, uint32_t keylen) {
+  return static_cast<ShmStore*>(s)->Delete(MakeKey(key, keylen));
+}
+
+uint64_t store_used(void* s) { return static_cast<ShmStore*>(s)->Used(); }
+
+uint64_t store_capacity(void* s) {
+  return static_cast<ShmStore*>(s)->Capacity();
+}
+
+uint64_t store_num_objects(void* s) {
+  return static_cast<ShmStore*>(s)->NumObjects();
+}
+
+}  // extern "C"

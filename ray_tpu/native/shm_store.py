@@ -1,0 +1,125 @@
+"""ctypes binding for the native shared-memory store.
+
+Builds ``shm_store.cpp`` with g++ on first use (cached .so).  Reads are
+zero-copy: Python mmaps the same shm segment and returns memoryview
+slices at the (offset, size) handles the C++ side hands out — the same
+client model as plasma's mmap'd object views
+(src/ray/object_manager/plasma/client.cc).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import subprocess
+import threading
+import uuid
+from typing import Optional
+
+_BUILD_LOCK = threading.Lock()
+_SRC = os.path.join(os.path.dirname(__file__), "shm_store.cpp")
+_SO = os.path.join(os.path.dirname(__file__), "_build", "libshm_store.so")
+
+
+def _build() -> str:
+    with _BUILD_LOCK:
+        if os.path.exists(_SO) and \
+                os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+            return _SO
+        os.makedirs(os.path.dirname(_SO), exist_ok=True)
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC,
+               "-o", _SO, "-lrt"]
+        subprocess.run(cmd, check=True, capture_output=True)
+        return _SO
+
+
+def _load() -> ctypes.CDLL:
+    lib = ctypes.CDLL(_build())
+    lib.store_open.restype = ctypes.c_void_p
+    lib.store_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.store_close.argtypes = [ctypes.c_void_p]
+    lib.store_put.restype = ctypes.c_int64
+    lib.store_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                              ctypes.c_uint32, ctypes.c_char_p,
+                              ctypes.c_uint64]
+    lib.store_get.restype = ctypes.c_int
+    lib.store_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                              ctypes.c_uint32,
+                              ctypes.POINTER(ctypes.c_uint64),
+                              ctypes.POINTER(ctypes.c_uint64)]
+    lib.store_delete.restype = ctypes.c_int
+    lib.store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_uint32]
+    lib.store_used.restype = ctypes.c_uint64
+    lib.store_used.argtypes = [ctypes.c_void_p]
+    lib.store_capacity.restype = ctypes.c_uint64
+    lib.store_capacity.argtypes = [ctypes.c_void_p]
+    lib.store_num_objects.restype = ctypes.c_uint64
+    lib.store_num_objects.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+class NativeShmStore:
+    """One shm segment + object table; zero-copy mmap reads."""
+
+    def __init__(self, capacity: int = 256 * 1024 * 1024,
+                 name: Optional[str] = None):
+        self._lib = _load()
+        self._name = name or f"/raytpu-{uuid.uuid4().hex[:12]}"
+        self._handle = self._lib.store_open(self._name.encode(), capacity)
+        if not self._handle:
+            raise OSError("native shm store open failed")
+        # Map the same segment for zero-copy reads.
+        fd = os.open(f"/dev/shm{self._name}", os.O_RDWR)
+        try:
+            self._mm = mmap.mmap(fd, capacity)
+        finally:
+            os.close(fd)
+        self.capacity = capacity
+        self._closed = False
+
+    def put(self, key: bytes, data: bytes) -> None:
+        rc = self._lib.store_put(self._handle, key, len(key), data,
+                                 len(data))
+        if rc == -1:
+            raise MemoryError("native store full")
+        if rc == -2:
+            return  # idempotent re-put
+
+    def get(self, key: bytes) -> Optional[memoryview]:
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.store_get(self._handle, key, len(key),
+                                 ctypes.byref(off), ctypes.byref(size))
+        if rc != 0:
+            return None
+        return memoryview(self._mm)[off.value:off.value + size.value]
+
+    def delete(self, key: bytes) -> bool:
+        return self._lib.store_delete(self._handle, key, len(key)) == 0
+
+    def used_bytes(self) -> int:
+        return self._lib.store_used(self._handle)
+
+    def num_objects(self) -> int:
+        return self._lib.store_num_objects(self._handle)
+
+    def close(self):
+        if not self._closed:
+            self._closed = True
+            try:
+                self._mm.close()
+            except BufferError:
+                pass  # exported memoryviews still alive
+            self._lib.store_close(self._handle)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def open_store(capacity: int = 256 * 1024 * 1024) -> NativeShmStore:
+    return NativeShmStore(capacity=capacity)
