@@ -101,7 +101,22 @@ class LocalTaskManager:
                 if worker is None:
                     return  # no worker slot; retried when one frees up
                 self._dispatch_queue.popleft()
-                self._allocated[worker.worker_id] = spec.resources
+                held = spec.resources
+                if spec.is_actor_creation() and \
+                        spec.lifetime_resources is not None:
+                    # Return placement-only resources (default actor CPU)
+                    # to the node as soon as the actor is placed.
+                    held = spec.lifetime_resources
+                    placed = spec.resources.to_dict()
+                    kept = held.to_dict()
+                    delta = {k: v - kept.get(k, 0.0)
+                             for k, v in placed.items()
+                             if v - kept.get(k, 0.0) > 0}
+                    if delta:
+                        self._raylet.cluster_view.add_back(
+                            self._raylet.node_id, ResourceRequest(delta))
+                        self._raylet.cluster_task_manager.on_resources_freed()
+                self._allocated[worker.worker_id] = held
             for oid in spec.arg_object_ids():
                 self._raylet.object_store.pin(oid)
             reply({"worker": worker, "raylet": self._raylet,

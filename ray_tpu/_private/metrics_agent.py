@@ -1,0 +1,112 @@
+"""Process-wide metrics registry + Prometheus text exposition.
+
+Parity: the reference's stats pipeline — C++ OpenCensus registry
+(``src/ray/stats/metric_defs.h:46-107``) exported through each node's
+metrics agent (``python/ray/_private/metrics_agent.py``,
+``prometheus_exporter.py``) to a Prometheus scrape endpoint.  Here one
+in-process registry serves both internal runtime metrics and the
+user-facing ``ray_tpu.util.metrics`` API; the dashboard's ``/metrics``
+route renders it in Prometheus text format.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+class MetricRecord:
+    __slots__ = ("type", "description", "series", "buckets")
+
+    def __init__(self, mtype: str, description: str, buckets=None):
+        self.type = mtype
+        self.description = description
+        # label-tuple -> float (counter/gauge) or list of observations (hist)
+        self.series: Dict[_LabelKey, object] = {}
+        self.buckets = buckets or []
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, MetricRecord] = {}
+
+    def register(self, name: str, mtype: str, description: str = "",
+                 buckets=None) -> None:
+        with self._lock:
+            if name not in self._metrics:
+                self._metrics[name] = MetricRecord(mtype, description, buckets)
+
+    def inc(self, name: str, value: float, labels: _LabelKey) -> None:
+        with self._lock:
+            rec = self._metrics[name]
+            rec.series[labels] = rec.series.get(labels, 0.0) + value
+
+    def set(self, name: str, value: float, labels: _LabelKey) -> None:
+        with self._lock:
+            self._metrics[name].series[labels] = value
+
+    def observe(self, name: str, value: float, labels: _LabelKey) -> None:
+        with self._lock:
+            rec = self._metrics[name]
+            rec.series.setdefault(labels, []).append(value)
+
+    def get_value(self, name: str, labels: _LabelKey = ()):
+        with self._lock:
+            rec = self._metrics.get(name)
+            if rec is None:
+                return None
+            return rec.series.get(labels)
+
+    def snapshot(self) -> Dict[str, MetricRecord]:
+        with self._lock:
+            return dict(self._metrics)
+
+    # ---- Prometheus text format ----------------------------------------
+    def render_prometheus(self) -> str:
+        out: List[str] = []
+        for name, rec in sorted(self.snapshot().items()):
+            pname = name.replace(".", "_")
+            if rec.description:
+                out.append(f"# HELP {pname} {rec.description}")
+            ptype = {"counter": "counter", "gauge": "gauge",
+                     "histogram": "histogram"}[rec.type]
+            out.append(f"# TYPE {pname} {ptype}")
+            for labels, val in sorted(rec.series.items()):
+                lstr = ",".join(f'{k}="{v}"' for k, v in labels)
+                lsuf = "{" + lstr + "}" if lstr else ""
+                if rec.type == "histogram":
+                    obs = list(val)
+                    acc = 0
+                    for b in rec.buckets:
+                        acc = sum(1 for o in obs if o <= b)
+                        blab = (lstr + "," if lstr else "") + f'le="{b}"'
+                        out.append(f"{pname}_bucket{{{blab}}} {acc}")
+                    blab = (lstr + "," if lstr else "") + 'le="+Inf"'
+                    out.append(f"{pname}_bucket{{{blab}}} {len(obs)}")
+                    out.append(f"{pname}_sum{lsuf} {sum(obs)}")
+                    out.append(f"{pname}_count{lsuf} {len(obs)}")
+                else:
+                    out.append(f"{pname}{lsuf} {val}")
+        return "\n".join(out) + "\n"
+
+
+_registry = MetricsRegistry()
+
+
+def get_metrics_registry() -> MetricsRegistry:
+    return _registry
+
+
+def record_internal(name: str, value: float, mtype: str = "gauge",
+                    **labels) -> None:
+    """Fire-and-forget internal runtime metric (DECLARE_STATS parity)."""
+    _registry.register(name, mtype)
+    key = tuple(sorted(labels.items()))
+    if mtype == "counter":
+        _registry.inc(name, value, key)
+    else:
+        _registry.set(name, value, key)

@@ -105,6 +105,12 @@ class TaskSpec:
     runtime_env: Optional[dict] = None
     # Dynamic/streaming returns
     returns_dynamic: bool = False
+    # Actor creation only: resources held while the actor is alive.  The
+    # reference schedules actor placement with num_cpus (default 1) but
+    # releases the CPU once the actor is up unless the user set resources
+    # explicitly, so idle actors don't starve the node (task_spec.h
+    # GetRequiredResources vs GetRequiredPlacementResources).
+    lifetime_resources: Optional[ResourceRequest] = None
 
     @property
     def return_ids(self) -> List[ObjectID]:
@@ -131,6 +137,11 @@ def make_spec(*, job_id: JobID, owner_id: WorkerID, function_id: FunctionID,
               parent_task_id=None, depth=0, task_type=TaskType.NORMAL_TASK,
               **kwargs) -> TaskSpec:
     req = ResourceRequest(resources)
+    lifetime = kwargs.pop("lifetime_resources", None)
+    if lifetime is not None and not isinstance(lifetime, ResourceRequest):
+        lifetime = ResourceRequest(lifetime)
+    if lifetime is not None:
+        kwargs["lifetime_resources"] = lifetime
     options = options_from_strategy(scheduling_strategy)
     spec = TaskSpec(
         task_id=TaskID.from_random(),

@@ -20,7 +20,7 @@ from ray_tpu._private.task_spec import TaskType, make_spec
 from ray_tpu.remote_function import _resource_dict, resolve_pg_strategy
 
 _DEFAULT_ACTOR_OPTIONS = dict(
-    num_cpus=1, num_tpus=0, num_gpus=0, memory=0, resources=None,
+    num_cpus=None, num_tpus=0, num_gpus=0, memory=0, resources=None,
     max_restarts=0, max_task_retries=0, max_concurrency=1,
     name=None, namespace=None, lifetime=None, scheduling_strategy=None,
     runtime_env=None,
@@ -127,9 +127,18 @@ class ActorClass:
             worker_mod.init()
         core = w.core_worker
         function_id = core.function_manager.export(self._cls)
-        resources = _resource_dict(o)
+        explicit = _resource_dict(o)
+        # Reference semantics: default actors need 1 CPU to be *placed* but
+        # hold 0 while alive; explicitly-requested resources (including an
+        # explicit num_cpus=0) are held for the actor's lifetime (actor.py
+        # _process_option_dict + task_spec.h GetRequiredPlacementResources).
+        explicit_any = (o.get("num_cpus") is not None or o.get("num_tpus")
+                        or o.get("num_gpus") or o.get("memory")
+                        or o.get("resources"))
+        resources = explicit if explicit_any else {"CPU": 1.0}
         resources, strategy, pg_id, bundle_idx = resolve_pg_strategy(
             o, resources)
+        lifetime_resources = resources if explicit_any else {}
         flat = pack_args(args, kwargs)
         task_args, _, holders = core.build_args(flat)
         actor_id = ActorID.from_random()
@@ -153,6 +162,7 @@ class ActorClass:
             placement_group_id=pg_id,
             placement_group_bundle_index=bundle_idx,
             runtime_env=o.get("runtime_env"),
+            lifetime_resources=lifetime_resources,
         )
         namespace = o.get("namespace")
         core.create_actor(

@@ -77,6 +77,10 @@ class RuntimeContext:
         spec = worker_context.current_task_spec()
         if spec is None:
             return {}
+        # Default actors hold their lifetime resources (possibly none), not
+        # the placement-only CPU used to schedule the creation task.
+        if spec.is_actor_creation() and spec.lifetime_resources is not None:
+            return spec.lifetime_resources.to_dict()
         return spec.resources.to_dict()
 
     def get_placement_group_id(self) -> Optional[str]:

@@ -23,7 +23,15 @@ def __getattr__(name):
     if name == "ActorPool":
         from ray_tpu.util.actor_pool import ActorPool
         return ActorPool
-    if name == "collective":
-        from ray_tpu.util import collective
-        return collective
+    if name == "Queue":
+        from ray_tpu.util.queue import Queue
+        return Queue
+    if name in ("collective", "metrics", "iter", "queue", "multiprocessing",
+                "joblib"):
+        import importlib
+        try:
+            return importlib.import_module(f"ray_tpu.util.{name}")
+        except ImportError as e:
+            raise AttributeError(
+                f"module 'ray_tpu.util' has no attribute {name!r}") from e
     raise AttributeError(f"module 'ray_tpu.util' has no attribute {name!r}")

@@ -1,0 +1,116 @@
+"""ActorPool — schedule work over a fixed pool of actors.
+
+Parity: reference ``python/ray/util/actor_pool.py`` (``ActorPool.submit``,
+``get_next``, ``get_next_unordered``, ``map``, ``map_unordered``,
+``has_next``, ``has_free``, ``push``, ``pop_idle``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List
+
+import ray_tpu
+
+
+class ActorPool:
+    """Operate on a fixed pool of actors, distributing tasks to free ones.
+
+    >>> @ray_tpu.remote
+    ... class W:
+    ...     def double(self, v): return 2 * v
+    >>> pool = ActorPool([W.remote(), W.remote()])
+    >>> list(pool.map(lambda a, v: a.double.remote(v), [1, 2, 3, 4]))
+    [2, 4, 6, 8]
+    """
+
+    def __init__(self, actors: Iterable[Any]):
+        self._idle_actors: List[Any] = list(actors)
+        # ref -> actor for in-flight work, plus submission-order indexing.
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: List[tuple] = []
+
+    # ---- submission -----------------------------------------------------
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
+        """Apply ``fn(actor, value)`` on an idle actor (queues if none)."""
+        if self._idle_actors:
+            actor = self._idle_actors.pop()
+            future = fn(actor, value)
+            self._future_to_actor[future] = actor
+            self._index_to_future[self._next_task_index] = future
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._future_to_actor) or bool(self._pending_submits)
+
+    def has_free(self) -> bool:
+        return bool(self._idle_actors) and not self._pending_submits
+
+    # ---- retrieval ------------------------------------------------------
+    def get_next(self, timeout: float = None) -> Any:
+        """Next result in submission order."""
+        if not self.has_next():
+            raise StopIteration("No more results to get")
+        if self._next_return_index >= self._next_task_index or \
+                self._next_return_index not in self._index_to_future:
+            raise ValueError("It is not allowed to call get_next() after "
+                             "get_next_unordered()")
+        future = self._index_to_future.pop(self._next_return_index)
+        self._next_return_index += 1
+        result = ray_tpu.get(future, timeout=timeout)
+        self._return_actor(self._future_to_actor.pop(future))
+        return result
+
+    def get_next_unordered(self, timeout: float = None) -> Any:
+        """Next result to become ready, regardless of submission order."""
+        if not self.has_next():
+            raise StopIteration("No more results to get")
+        ready, _ = ray_tpu.wait(
+            list(self._future_to_actor), num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("Timed out waiting for result")
+        future = ready[0]
+        for i, f in list(self._index_to_future.items()):
+            if f is future or f == future:
+                del self._index_to_future[i]
+                break
+        result = ray_tpu.get(future)
+        self._return_actor(self._future_to_actor.pop(future))
+        return result
+
+    def _return_actor(self, actor) -> None:
+        self._idle_actors.append(actor)
+        if self._pending_submits:
+            self.submit(*self._pending_submits.pop(0))
+
+    # ---- bulk maps ------------------------------------------------------
+    def map(self, fn: Callable[[Any, Any], Any], values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable[[Any, Any], Any],
+                      values: Iterable[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    # ---- pool management ------------------------------------------------
+    def push(self, actor) -> None:
+        """Add an idle actor to the pool."""
+        busy = set(self._future_to_actor.values())
+        if actor in self._idle_actors or actor in busy:
+            raise ValueError("Actor already belongs to current ActorPool")
+        self._return_actor(actor)
+
+    def pop_idle(self):
+        """Remove and return an idle actor, or None if none are idle."""
+        if self.has_free():
+            return self._idle_actors.pop()
+        return None

@@ -1,0 +1,25 @@
+"""ray_tpu.util.collective — collective communication on actors/tasks.
+
+Parity: reference ``python/ray/util/collective/collective.py`` (group
+management + allreduce/allgather/reducescatter/broadcast/send/recv/
+barrier).  Backend difference: the reference rendezvouses an NCCL unique
+id through a named actor store and runs NCCL rings over NVLink/IB
+(``collective_group/nccl_collective_group.py:127``); here the cross-actor
+plane rendezvouses tensors through the object store and reduces them as
+one batched XLA op, while the *intra-mesh* plane — SPMD code inside
+``pjit``/``shard_map`` — uses native XLA collectives (psum/all_gather/
+ppermute) over ICI and needs no group management at all (SURVEY.md §5.8).
+"""
+
+from ray_tpu.util.collective.collective import (  # noqa: F401
+    allgather, allreduce, barrier, broadcast, create_collective_group,
+    destroy_collective_group, get_collective_group_size, get_rank,
+    init_collective_group, is_group_initialized, recv, reducescatter, send)
+from ray_tpu.util.collective.types import ReduceOp  # noqa: F401
+
+__all__ = [
+    "init_collective_group", "create_collective_group",
+    "destroy_collective_group", "is_group_initialized", "get_rank",
+    "get_collective_group_size", "allreduce", "allgather", "reducescatter",
+    "broadcast", "send", "recv", "barrier", "ReduceOp",
+]

@@ -1,0 +1,30 @@
+"""Collective types (reference python/ray/util/collective/types.py)."""
+
+from __future__ import annotations
+
+import enum
+
+
+class ReduceOp(enum.Enum):
+    SUM = 0
+    PRODUCT = 1
+    MIN = 2
+    MAX = 3
+    MEAN = 4  # extension: convenient for gradient averaging
+
+
+class Backend:
+    """Backend names (reference types.py Backend). NCCL/GLOO are mapped
+    onto the XLA/object-store implementation so reference code runs
+    unchanged."""
+
+    XLA = "xla"
+    NCCL = "nccl"
+    GLOO = "gloo"
+
+    @staticmethod
+    def normalize(name: str) -> str:
+        name = (name or "xla").lower()
+        if name not in (Backend.XLA, Backend.NCCL, Backend.GLOO):
+            raise ValueError(f"Unrecognized backend: {name!r}")
+        return Backend.XLA

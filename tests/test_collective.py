@@ -1,0 +1,121 @@
+"""Tests for ray_tpu.util.collective.
+
+Modeled on reference python/ray/util/collective/tests/ — allreduce /
+allgather / reducescatter / broadcast / send-recv / barrier across a
+group of actors (the cross-actor plane; the intra-mesh plane is jax
+collectives, exercised in test_model_parallel.py).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import collective as col
+from ray_tpu.util.collective import ReduceOp
+
+
+@ray_tpu.remote
+class Worker:
+    def __init__(self):
+        self.buf = None
+
+    def init_collective_group(self, world_size, rank, backend, group_name):
+        col.init_collective_group(world_size, rank, backend, group_name)
+        self.rank = rank
+        return rank
+
+    def do_allreduce(self, value, op=ReduceOp.SUM, group="default"):
+        return col.allreduce(np.array(value, dtype=np.float32), group, op)
+
+    def do_allgather(self, value, group="default"):
+        return col.allgather(np.array(value, dtype=np.float32), group)
+
+    def do_reducescatter(self, value, group="default"):
+        return col.reducescatter(np.array(value, dtype=np.float32), group)
+
+    def do_broadcast(self, value, src, group="default"):
+        return col.broadcast(np.array(value, dtype=np.float32), src, group)
+
+    def do_sendrecv(self, value, peer, group="default"):
+        if self.rank == 0:
+            col.send(np.array(value, dtype=np.float32), peer, group)
+            return None
+        return col.recv(0, group, timeout=10)
+
+    def do_barrier(self, group="default"):
+        col.barrier(group)
+        return self.rank
+
+    def group_info(self, group="default"):
+        return (col.get_rank(group), col.get_collective_group_size(group),
+                col.is_group_initialized(group))
+
+
+def _make_group(n, group_name="default"):
+    workers = [Worker.remote() for _ in range(n)]
+    col.create_collective_group(
+        workers, n, list(range(n)), "xla", group_name)
+    return workers
+
+
+def test_allreduce_sum(ray_start_regular):
+    workers = _make_group(2, "g1")
+    refs = [w.do_allreduce.remote([1.0, 2.0], ReduceOp.SUM, "g1")
+            for w in workers]
+    for out in ray_tpu.get(refs):
+        np.testing.assert_allclose(out, [2.0, 4.0])
+
+
+def test_allreduce_ops(ray_start_regular):
+    workers = _make_group(2, "g2")
+    r0 = workers[0].do_allreduce.remote([2.0], ReduceOp.MAX, "g2")
+    r1 = workers[1].do_allreduce.remote([5.0], ReduceOp.MAX, "g2")
+    out = ray_tpu.get([r0, r1])
+    np.testing.assert_allclose(out[0], [5.0])
+    np.testing.assert_allclose(out[1], [5.0])
+
+
+def test_allgather(ray_start_regular):
+    workers = _make_group(3, "g3")
+    refs = [w.do_allgather.remote([float(i)], "g3")
+            for i, w in enumerate(workers)]
+    for out in ray_tpu.get(refs):
+        assert [float(x[0]) for x in out] == [0.0, 1.0, 2.0]
+
+
+def test_reducescatter(ray_start_regular):
+    workers = _make_group(2, "g4")
+    refs = [w.do_reducescatter.remote([1.0, 2.0, 3.0, 4.0], "g4")
+            for w in workers]
+    out = ray_tpu.get(refs)
+    np.testing.assert_allclose(out[0], [2.0, 4.0])
+    np.testing.assert_allclose(out[1], [6.0, 8.0])
+
+
+def test_broadcast(ray_start_regular):
+    workers = _make_group(2, "g5")
+    refs = [w.do_broadcast.remote([7.0] if i == 1 else [0.0], 1, "g5")
+            for i, w in enumerate(workers)]
+    for out in ray_tpu.get(refs):
+        np.testing.assert_allclose(out, [7.0])
+
+
+def test_send_recv(ray_start_regular):
+    workers = _make_group(2, "g6")
+    refs = [w.do_sendrecv.remote([9.0, 9.5], 1, "g6") for w in workers]
+    out = ray_tpu.get(refs)
+    assert out[0] is None
+    np.testing.assert_allclose(out[1], [9.0, 9.5])
+
+
+def test_barrier_and_info(ray_start_regular):
+    workers = _make_group(2, "g7")
+    assert sorted(ray_tpu.get(
+        [w.do_barrier.remote("g7") for w in workers])) == [0, 1]
+    rank, size, inited = ray_tpu.get(workers[0].group_info.remote("g7"))
+    assert (rank, size, inited) == (0, 2, True)
+
+
+def test_uninitialized_group_raises(ray_start_regular):
+    with pytest.raises(RuntimeError):
+        col.allreduce(np.zeros(2), "nope")

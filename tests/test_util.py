@@ -1,0 +1,133 @@
+"""Tests for ray_tpu.util extras: ActorPool, Queue, metrics, iter.
+
+Modeled on reference python/ray/tests/test_actor_pool.py,
+test_queue.py, test_metrics_agent.py, test_iter.py.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+@ray_tpu.remote
+class _Doubler:
+    def double(self, v):
+        return 2 * v
+
+
+def test_actor_pool_map_ordered(ray_start_regular):
+    pool = ActorPool([_Doubler.remote(), _Doubler.remote()])
+    out = list(pool.map(lambda a, v: a.double.remote(v), [1, 2, 3, 4]))
+    assert out == [2, 4, 6, 8]
+
+
+def test_actor_pool_map_unordered(ray_start_regular):
+    pool = ActorPool([_Doubler.remote(), _Doubler.remote()])
+    out = list(pool.map_unordered(lambda a, v: a.double.remote(v),
+                                  [1, 2, 3, 4]))
+    assert sorted(out) == [2, 4, 6, 8]
+
+
+def test_actor_pool_submit_get_next(ray_start_regular):
+    pool = ActorPool([_Doubler.remote()])
+    pool.submit(lambda a, v: a.double.remote(v), 10)
+    pool.submit(lambda a, v: a.double.remote(v), 20)
+    assert pool.has_next()
+    assert pool.get_next() == 20
+    assert pool.get_next() == 40
+    assert not pool.has_next()
+
+
+def test_actor_pool_push_pop(ray_start_regular):
+    a, b = _Doubler.remote(), _Doubler.remote()
+    pool = ActorPool([a])
+    with pytest.raises(ValueError):
+        pool.push(a)
+    pool.push(b)
+    assert pool.pop_idle() is not None
+
+
+def test_queue_basics(ray_start_regular):
+    q = Queue(maxsize=2)
+    assert q.empty()
+    q.put(1)
+    q.put(2)
+    assert q.full()
+    assert q.size() == 2
+    with pytest.raises(Full):
+        q.put(3, block=False)
+    assert q.get() == 1
+    assert q.get() == 2
+    with pytest.raises(Empty):
+        q.get(block=False)
+    with pytest.raises(Empty):
+        q.get(timeout=0.05)
+
+
+def test_queue_batch(ray_start_regular):
+    q = Queue()
+    q.put_nowait_batch([1, 2, 3])
+    assert q.get_nowait_batch(2) == [1, 2]
+    with pytest.raises(Empty):
+        q.get_nowait_batch(5)
+
+
+def test_metrics_counter_gauge_histogram(ray_start_regular):
+    from ray_tpu._private.metrics_agent import get_metrics_registry
+    from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+    c = Counter("test_requests", description="reqs", tag_keys=("route",))
+    c.inc(tags={"route": "/"})
+    c.inc(2, tags={"route": "/"})
+    g = Gauge("test_inflight")
+    g.set(5)
+    h = Histogram("test_lat", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+
+    reg = get_metrics_registry()
+    assert reg.get_value("test_requests", (("route", "/"),)) == 3
+    assert reg.get_value("test_inflight") == 5
+    text = reg.render_prometheus()
+    assert "test_requests" in text and 'le="+Inf"' in text
+    assert "test_lat_count 3" in text
+
+
+def test_metrics_tag_validation(ray_start_regular):
+    from ray_tpu.util.metrics import Counter
+    c = Counter("test_tagged", tag_keys=("k",))
+    with pytest.raises(ValueError):
+        c.inc()  # missing tag value
+    with pytest.raises(ValueError):
+        c.inc(tags={"bad": "x"})
+
+
+def test_parallel_iterator(ray_start_regular):
+    from ray_tpu.util import iter as rit
+    it = rit.from_range(8, num_shards=2)
+    out = sorted(it.for_each(lambda x: x * 2).gather_sync().take(8))
+    assert out == [0, 2, 4, 6, 8, 10, 12, 14]
+
+
+def test_parallel_iterator_filter_batch(ray_start_regular):
+    from ray_tpu.util import iter as rit
+    it = rit.from_items(list(range(10)), num_shards=2)
+    batches = it.filter(lambda x: x % 2 == 0).batch(2).gather_sync().take(5)
+    flat = sorted(x for b in batches for x in b)
+    assert flat == [0, 2, 4, 6, 8]
+
+
+def test_parallel_iterator_gather_async(ray_start_regular):
+    from ray_tpu.util import iter as rit
+    it = rit.from_range(6, num_shards=3)
+    assert sorted(it.gather_async().take(6)) == list(range(6))
+
+
+def test_local_iterator_transforms(ray_start_regular):
+    from ray_tpu.util.iter import LocalIterator
+    it = LocalIterator(lambda: iter(range(6)))
+    assert it.for_each(lambda x: x + 1).filter(lambda x: x % 2 == 0) \
+        .batch(2).take(2) == [[2, 4], [6]]
