@@ -183,6 +183,17 @@ class ClusterTaskManager:
             return (sum(len(q) for q in self._queues.values()) +
                     sum(len(q) for q in self._infeasible.values()))
 
+    def resource_load(self) -> list:
+        """Pending per-task resource demands (queued + infeasible), the
+        raylet's contribution to the autoscaler's demand vector
+        (reference: ResourcesData.resource_load_by_shape)."""
+        with self._lock:
+            out = []
+            for q in list(self._queues.values()) + \
+                    list(self._infeasible.values()):
+                out.extend(spec.resources.to_dict() for spec, _ in q)
+            return out
+
     def debug_state(self) -> dict:
         with self._lock:
             return {

@@ -1,0 +1,25 @@
+"""Autoscaler: demand-driven cluster scaling.
+
+Parity: reference ``python/ray/autoscaler/`` — ``StandardAutoscaler``
+(`_private/autoscaler.py`), ``ResourceDemandScheduler``
+(`_private/resource_demand_scheduler.py:48`), ``LoadMetrics``
+(`_private/load_metrics.py`), ``NodeProvider`` plugin ABC
+(`node_provider.py`) and the ``fake_multi_node`` provider used for
+single-machine multi-node tests.
+
+TPU-first twist: the bin-pack core is columnar ([D,R] demand matrix vs
+[N,R] availability matrix over a shared resource vocabulary) and reuses
+the same waterfill solve as the raylet's TPU scheduling kernel
+(``ray_tpu.scheduler.jax_backend``) — one kernel signature serves the
+raylet tick, GCS placement-group packing, and the autoscaler
+(SURVEY.md section 3.4).
+"""
+
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler  # noqa: F401
+from ray_tpu.autoscaler.load_metrics import LoadMetrics  # noqa: F401
+from ray_tpu.autoscaler.monitor import Monitor  # noqa: F401
+from ray_tpu.autoscaler.node_provider import (  # noqa: F401
+    FakeMultiNodeProvider, NodeProvider)
+from ray_tpu.autoscaler.resource_demand_scheduler import (  # noqa: F401
+    ResourceDemandScheduler, get_bin_pack_residual)
+from ray_tpu.autoscaler.sdk import request_resources  # noqa: F401

@@ -1,0 +1,89 @@
+"""Monitor: bridges the live cluster (GCS + raylets) to the autoscaler.
+
+Parity: reference ``python/ray/autoscaler/_private/monitor.py`` — the
+monitor process reads resource usage + demand from the GCS
+(``update_load_metrics``) and runs ``StandardAutoscaler.update`` each
+round. Here the monitor attaches to the in-process
+:class:`ray_tpu._private.cluster.Cluster` and can run on an interval
+thread or be ticked manually from tests (``update_all()``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from ray_tpu.autoscaler.autoscaler import StandardAutoscaler
+from ray_tpu.autoscaler.load_metrics import LoadMetrics
+from ray_tpu.autoscaler.node_provider import FakeMultiNodeProvider
+
+
+class Monitor:
+    def __init__(self, cluster, node_types: Dict[str, dict],
+                 max_workers: int = 10,
+                 idle_timeout_minutes: float = 5.0,
+                 upscaling_speed: float = 1.0,
+                 provider=None):
+        self.cluster = cluster
+        self.load_metrics = LoadMetrics()
+        self.provider = provider or FakeMultiNodeProvider(cluster, node_types)
+        self.autoscaler = StandardAutoscaler(
+            self.provider, self.load_metrics, node_types,
+            max_workers=max_workers,
+            idle_timeout_minutes=idle_timeout_minutes,
+            upscaling_speed=upscaling_speed)
+        self._timer: Optional[threading.Timer] = None
+        self._stopped = False
+        from ray_tpu.autoscaler import sdk
+        sdk._set_active_monitor(self)
+
+    # ------------------------------------------------------------------
+    def update_load_metrics(self):
+        """Pull resource reports + pending demand from every raylet and
+        pending PGs from the GCS (reference Monitor.update_load_metrics)."""
+        demands = []
+        gcs = self.cluster.gcs
+        for raylet in list(gcs.raylets().values()):
+            report = raylet.get_resource_report()
+            ip = raylet.node_id.hex()[:12]
+            demands.extend(raylet.cluster_task_manager.resource_load())
+            self.load_metrics.update(ip, report["total"], report["available"])
+        pending_pgs = []
+        pgm = getattr(gcs, "placement_group_manager", None)
+        if pgm is not None:
+            for pg_id in list(getattr(pgm, "_pending", [])):
+                pg = pgm.get(pg_id)
+                if pg is not None:
+                    pending_pgs.append({
+                        "strategy": pg.strategy,
+                        "bundles": [b.to_dict() for b in pg.bundles]})
+        self.load_metrics.pending_demands = demands
+        self.load_metrics.pending_placement_groups = pending_pgs
+        alive = [r.node_id.hex()[:12] for r in gcs.raylets().values()]
+        self.load_metrics.prune_active_ips(alive)
+
+    def update_all(self):
+        """One full monitor round: refresh metrics, run the autoscaler."""
+        self.update_load_metrics()
+        return self.autoscaler.update()
+
+    # ------------------------------------------------------------------
+    def start(self, interval_s: float = 5.0):
+        def tick():
+            if self._stopped:
+                return
+            try:
+                self.update_all()
+            finally:
+                if not self._stopped:
+                    self._timer = threading.Timer(interval_s, tick)
+                    self._timer.daemon = True
+                    self._timer.start()
+        self._timer = threading.Timer(interval_s, tick)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def stop(self):
+        self._stopped = True
+        if self._timer is not None:
+            self._timer.cancel()

@@ -1,0 +1,32 @@
+"""Autoscaler SDK: programmatic resource requests.
+
+Parity: reference ``python/ray/autoscaler/sdk.py`` —
+``request_resources(num_cpus=..., bundles=[...])`` asks the autoscaler
+to ensure the cluster can fit the given shape regardless of current
+demand (flows into ``ensure_min_cluster_size``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+# The live monitor registers itself here on start (in-process cluster).
+_active_monitor = None
+
+
+def _set_active_monitor(monitor):
+    global _active_monitor
+    _active_monitor = monitor
+
+
+def request_resources(num_cpus: Optional[int] = None,
+                      bundles: Optional[List[Dict[str, float]]] = None):
+    demands: List[Dict[str, float]] = []
+    if num_cpus:
+        demands.extend([{"CPU": 1}] * int(num_cpus))
+    if bundles:
+        demands.extend(dict(b) for b in bundles)
+    if _active_monitor is None:
+        raise RuntimeError("No autoscaler monitor is running; start one via "
+                           "ray_tpu.autoscaler.Monitor(cluster, node_types)")
+    _active_monitor.load_metrics.set_resource_requests(demands)

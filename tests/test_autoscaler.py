@@ -1,0 +1,234 @@
+"""Autoscaler tests (reference: python/ray/tests/
+test_resource_demand_scheduler.py + test_autoscaler.py +
+test_autoscaler_fake_multinode.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (
+    LoadMetrics, Monitor, ResourceDemandScheduler, StandardAutoscaler,
+    get_bin_pack_residual, request_resources)
+from ray_tpu.autoscaler.node_provider import (
+    MockProvider, NODE_KIND_WORKER, TAG_NODE_KIND, TAG_NODE_TYPE)
+from ray_tpu.autoscaler.resource_demand_scheduler import (
+    get_nodes_for, placement_groups_to_resource_demands)
+
+TYPES = {
+    "head": {"resources": {"CPU": 2}, "max_workers": 0},
+    "m4.large": {"resources": {"CPU": 2}, "min_workers": 0,
+                 "max_workers": 10},
+    "m4.4xlarge": {"resources": {"CPU": 16}, "min_workers": 0,
+                   "max_workers": 8},
+    "p2.xlarge": {"resources": {"CPU": 4, "TPU": 4}, "min_workers": 0,
+                  "max_workers": 4},
+}
+
+
+# ---------------------------------------------------------------------------
+# bin packing
+# ---------------------------------------------------------------------------
+
+def test_bin_pack_basic():
+    unfulfilled, after = get_bin_pack_residual(
+        [{"CPU": 4}, {"CPU": 4}], [{"CPU": 2}] * 4)
+    assert unfulfilled == []
+    assert all(n["CPU"] == 0 for n in after)
+
+
+def test_bin_pack_residual():
+    unfulfilled, _ = get_bin_pack_residual(
+        [{"CPU": 2}], [{"CPU": 2}, {"CPU": 2}, {"GPU": 1}])
+    assert {"CPU": 2} in unfulfilled and {"GPU": 1} in unfulfilled
+    assert len(unfulfilled) == 2
+
+
+def test_bin_pack_complex_first():
+    # The 2-resource demand must be placed before the big 1-resource one.
+    unfulfilled, _ = get_bin_pack_residual(
+        [{"CPU": 4, "TPU": 4}], [{"CPU": 4}, {"CPU": 2, "TPU": 4}])
+    assert unfulfilled == [{"CPU": 4}]
+
+
+def test_bin_pack_strict_spread():
+    # Three bundles, two nodes -> one unfulfilled even though capacity fits.
+    unfulfilled, _ = get_bin_pack_residual(
+        [{"CPU": 8}, {"CPU": 8}], [{"CPU": 1}] * 3, strict_spread=True)
+    assert len(unfulfilled) == 1
+
+
+def test_get_nodes_for_picks_fitting_type():
+    to_add, residual = get_nodes_for(TYPES, {}, 10, [{"TPU": 4}])
+    assert to_add == {"p2.xlarge": 1}
+    assert residual == []
+
+
+def test_get_nodes_for_respects_max_workers():
+    to_add, residual = get_nodes_for(
+        {"m4.large": {"resources": {"CPU": 2}, "max_workers": 2}},
+        {}, 100, [{"CPU": 2}] * 5)
+    assert to_add == {"m4.large": 2}
+    assert len(residual) == 3
+
+
+# ---------------------------------------------------------------------------
+# ResourceDemandScheduler
+# ---------------------------------------------------------------------------
+
+def _scheduler(max_workers=10, **kw):
+    return ResourceDemandScheduler(TYPES, max_workers, "head", **kw)
+
+
+def test_min_workers_fill():
+    types = dict(TYPES)
+    types["m4.large"] = {"resources": {"CPU": 2}, "min_workers": 3,
+                         "max_workers": 10}
+    sched = ResourceDemandScheduler(types, 10, "head")
+    to_launch, _ = sched.get_nodes_to_launch({"head": 1}, {}, [], {}, [])
+    assert to_launch == {"m4.large": 3}
+
+
+def test_demand_driven_launch():
+    sched = _scheduler()
+    to_launch, unfulfilled = sched.get_nodes_to_launch(
+        {"head": 1}, {}, [{"CPU": 16}] * 2,
+        {"head-ip": {"CPU": 2}}, [])
+    assert to_launch == {"m4.4xlarge": 2}
+    assert unfulfilled == []
+
+
+def test_no_launch_when_demand_fits():
+    sched = _scheduler()
+    to_launch, _ = sched.get_nodes_to_launch(
+        {"head": 1}, {}, [{"CPU": 1}], {"head-ip": {"CPU": 2}}, [])
+    assert to_launch == {}
+
+
+def test_launching_nodes_count():
+    sched = _scheduler()
+    # 16-CPU node already launching covers the demand.
+    to_launch, _ = sched.get_nodes_to_launch(
+        {"head": 1}, {"m4.4xlarge": 1}, [{"CPU": 16}],
+        {"head-ip": {"CPU": 0}}, [])
+    assert to_launch == {}
+
+
+def test_max_workers_cap():
+    sched = _scheduler(max_workers=2)
+    to_launch, unfulfilled = sched.get_nodes_to_launch(
+        {"head": 1}, {}, [{"CPU": 2}] * 50, {"head-ip": {"CPU": 0}}, [])
+    assert sum(to_launch.values()) <= 2
+    assert unfulfilled
+
+
+def test_pg_strict_spread_launch():
+    sched = _scheduler()
+    pgs = [{"strategy": "STRICT_SPREAD",
+            "bundles": [{"CPU": 2}, {"CPU": 2}, {"CPU": 2}]}]
+    to_launch, _ = sched.get_nodes_to_launch(
+        {"head": 1}, {}, [], {"head-ip": {"CPU": 2}}, pgs)
+    # Head can host one bundle; two more distinct nodes needed.
+    assert sum(to_launch.values()) == 2
+
+
+def test_pg_strict_pack_merges():
+    demands, spreads = placement_groups_to_resource_demands(
+        [{"strategy": "STRICT_PACK", "bundles": [{"CPU": 4}, {"CPU": 4}]}])
+    assert demands == [{"CPU": 8}]
+    assert spreads == []
+
+
+def test_tpu_demand_launches_tpu_node():
+    sched = _scheduler()
+    to_launch, _ = sched.get_nodes_to_launch(
+        {"head": 1}, {}, [{"TPU": 4, "CPU": 1}], {"head-ip": {"CPU": 2}}, [])
+    assert to_launch == {"p2.xlarge": 1}
+
+
+# ---------------------------------------------------------------------------
+# StandardAutoscaler on MockProvider
+# ---------------------------------------------------------------------------
+
+def _mock_autoscaler(**kw):
+    provider = MockProvider()
+    lm = LoadMetrics()
+    scaler = StandardAutoscaler(provider, lm, TYPES, head_node_type="head",
+                                **kw)
+    return provider, lm, scaler
+
+
+def test_autoscaler_launches_for_demand():
+    provider, lm, scaler = _mock_autoscaler(max_workers=10)
+    lm.update("h", {"CPU": 2}, {"CPU": 0},
+              pending_demands=[{"CPU": 16}])
+    scaler.update()
+    workers = provider.non_terminated_nodes(
+        {TAG_NODE_KIND: NODE_KIND_WORKER})
+    assert len(workers) == 1
+    assert provider.node_tags(workers[0])[TAG_NODE_TYPE] == "m4.4xlarge"
+
+
+def test_autoscaler_idle_termination():
+    provider, lm, scaler = _mock_autoscaler(
+        max_workers=10, idle_timeout_minutes=0.0)
+    lm.update("h", {"CPU": 2}, {"CPU": 0}, pending_demands=[{"CPU": 16}])
+    scaler.update()
+    (worker,) = provider.non_terminated_nodes(
+        {TAG_NODE_KIND: NODE_KIND_WORKER})
+    # Node comes up fully idle; with a zero idle timeout it gets reaped.
+    ip = provider.internal_ip(worker)
+    lm.update("h", {"CPU": 2}, {"CPU": 2}, pending_demands=[])
+    lm.update(ip, {"CPU": 16}, {"CPU": 16})
+    scaler.last_used_time_by_node[worker] = time.time() - 10
+    scaler.update()
+    assert provider.is_terminated(worker)
+
+
+def test_autoscaler_max_workers_termination():
+    provider, lm, scaler = _mock_autoscaler(max_workers=1)
+    provider.create_node({}, {TAG_NODE_KIND: NODE_KIND_WORKER,
+                              TAG_NODE_TYPE: "m4.large"}, 3)
+    scaler.update()
+    workers = provider.non_terminated_nodes(
+        {TAG_NODE_KIND: NODE_KIND_WORKER})
+    assert len(workers) == 1
+
+
+# ---------------------------------------------------------------------------
+# e2e: FakeMultiNodeProvider adds real schedulable nodes
+# ---------------------------------------------------------------------------
+
+def test_fake_multinode_autoscales(ray_start_cluster):
+    cluster = ray_start_cluster(num_cpus=1)
+    monitor = Monitor(cluster, {
+        "head": {"resources": {"CPU": 1}, "max_workers": 0},
+        "worker": {"resources": {"CPU": 4, "bigmem": 1}, "min_workers": 0,
+                   "max_workers": 4},
+    }, max_workers=4, idle_timeout_minutes=60)
+
+    @ray_tpu.remote(num_cpus=0, resources={"bigmem": 0.5})
+    def task():
+        return ray_tpu.get_runtime_context().node_id
+
+    # Submit a task no current node can run -> becomes pending demand.
+    ref = task.remote()
+    time.sleep(0.3)
+    monitor.update_all()  # sees the infeasible demand, launches a worker
+    assert cluster.wait_for_nodes(2)
+    node = ray_tpu.get(ref, timeout=10)  # task now runs on the new node
+    assert node != cluster.head_node.node_id
+    monitor.stop()
+
+
+def test_request_resources(ray_start_cluster):
+    cluster = ray_start_cluster(num_cpus=1)
+    monitor = Monitor(cluster, {
+        "head": {"resources": {"CPU": 1}, "max_workers": 0},
+        "worker": {"resources": {"CPU": 8}, "min_workers": 0,
+                   "max_workers": 4},
+    }, max_workers=4, idle_timeout_minutes=60)
+    request_resources(bundles=[{"CPU": 8}, {"CPU": 8}])
+    monitor.update_all()
+    assert cluster.wait_for_nodes(3)  # head + 2 workers
+    monitor.stop()
