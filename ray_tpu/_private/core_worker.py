@@ -212,22 +212,56 @@ class CoreWorker:
         return self.task_manager.is_pending(object_id.task_id())
 
     def get_for_executor(self, object_id: ObjectID, node) -> Any:
-        """Executor-side arg materialization (GetAndPinArgsForExecutor)."""
-        entry = node.object_store.get(object_id)
-        if entry is not None:
-            return entry_value(entry)
-        entry = self.memory_store.get_entry(object_id)
-        if entry is not None and entry.sealed and \
-                not isinstance(entry.data, InPlasmaMarker):
-            return self._entry_to_value(object_id, entry)
-        # Pull to this node, then read.
-        done = threading.Event()
-        node.object_manager.pull_async(object_id, lambda ok: done.set())
-        done.wait(timeout=30.0)
-        entry = node.object_store.get(object_id)
-        if entry is None:
-            raise exceptions.ObjectLostError(object_id, "arg fetch failed")
-        return entry_value(entry)
+        """Executor-side arg materialization (GetAndPinArgsForExecutor).
+
+        Loops store-check -> pull -> store-check: a pull can complete via
+        the *owner memory store* fast path (small returns are inlined
+        there, never copied into the node store), and the producing task
+        may seal the entry between any two checks — so after every pull
+        both stores are re-read rather than assuming the bytes landed in
+        the node store.
+        """
+        deadline = time.monotonic() + 30.0
+        misses = 0
+        while True:
+            entry = node.object_store.get(object_id)
+            if entry is not None:
+                return entry_value(entry)
+            entry = self.memory_store.get_entry(object_id)
+            if entry is not None and entry.sealed and \
+                    not isinstance(entry.data, InPlasmaMarker):
+                return self._entry_to_value(object_id, entry)
+            if misses:
+                # Only reached when a completed "successful" pull did NOT
+                # materialize the bytes (e.g. a sealed InPlasmaMarker whose
+                # backing node died): back off, and after repeated misses
+                # try lineage reconstruction instead of spinning.
+                if misses >= 5:
+                    self.recover_object(object_id)
+                time.sleep(min(0.005 * misses, 0.1))
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise exceptions.ObjectLostError(object_id,
+                                                 "arg fetch failed")
+            done = threading.Event()
+            ok_box = [False]
+
+            def _cb(ok, done=done, ok_box=ok_box):
+                ok_box[0] = ok
+                done.set()
+            node.object_manager.pull_async(object_id, _cb)
+            if not done.wait(timeout=remaining):
+                raise exceptions.ObjectLostError(object_id,
+                                                 "arg fetch timed out")
+            if not ok_box[0]:
+                # Failed pull (e.g. source node died): try lineage
+                # reconstruction, then loop to re-check/pull again.
+                if not self.recover_object(object_id):
+                    raise exceptions.ObjectLostError(
+                        object_id, "arg fetch failed and not recoverable")
+                time.sleep(0.01)
+            else:
+                misses += 1  # re-check stores first; sleep only on miss
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None,

@@ -23,12 +23,18 @@ from ray_tpu._private.task_spec import TaskSpec
 
 
 class _SchedulingKeyState:
-    __slots__ = ("queue", "idle_workers", "pending_leases")
+    __slots__ = ("queue", "idle_workers", "pending_leases", "leased_task_ids")
 
     def __init__(self):
         self.queue: deque = deque()
         self.idle_workers: List[Tuple[object, object]] = []  # (worker, raylet)
         self.pending_leases = 0
+        # Task ids with an in-flight lease request: each lease request must
+        # carry a DISTINCT representative spec — the raylet dep-waits on the
+        # representative's args, and two in-flight waits for one task id
+        # would collide (reference: pending_lease_requests_ keyed by TaskID,
+        # direct_task_transport.h).
+        self.leased_task_ids: set = set()
 
 
 class DirectTaskSubmitter:
@@ -63,8 +69,12 @@ class DirectTaskSubmitter:
                     continue
                 if state.pending_leases >= self._max_pending:
                     return
+                spec = next((s for s in state.queue
+                             if s.task_id not in state.leased_task_ids), None)
+                if spec is None:
+                    return  # every queued task already has a lease in flight
                 state.pending_leases += 1
-                spec = state.queue[0]
+                state.leased_task_ids.add(spec.task_id)
             self._request_lease(spec, key)
             return
 
@@ -103,6 +113,7 @@ class DirectTaskSubmitter:
                 with self._lock:
                     state = self._keys[key]
                     state.pending_leases -= 1
+                    state.leased_task_ids.discard(spec.task_id)
                     if state.queue and state.queue[0].task_id == spec.task_id:
                         state.queue.popleft()
                         dispatch = spec
@@ -110,6 +121,8 @@ class DirectTaskSubmitter:
                         dispatch = state.queue.popleft()
                     else:
                         dispatch = None
+                    if dispatch is not None:
+                        state.leased_task_ids.discard(dispatch.task_id)
                 if dispatch is None:
                     # Queue drained while the lease was in flight; return it.
                     result["raylet"].return_worker(result["worker"])
@@ -124,6 +137,7 @@ class DirectTaskSubmitter:
                 if target is None or hops > 10:
                     with self._lock:
                         self._keys[key].pending_leases -= 1
+                        self._keys[key].leased_task_ids.discard(spec.task_id)
                     self._pump(key)
                 else:
                     self._request_lease(spec, key, raylet=target,
@@ -139,6 +153,7 @@ class DirectTaskSubmitter:
         with self._lock:
             state = self._keys[key]
             state.pending_leases = max(0, state.pending_leases - 1)
+            state.leased_task_ids.discard(spec.task_id)
             try:
                 state.queue.remove(spec)
             except ValueError:

@@ -45,21 +45,26 @@ class DependencyManager:
             ready_cb()
             return
         state = _Waiting(spec, ready_cb, set(missing))
+        # Keyed by a unique token, NOT task_id: duplicate lease requests may
+        # carry the same representative spec, and an overwritten wait state
+        # would silently drop its lease reply (observed as a starvation
+        # hang under pipelined submission).
+        token = object()
         with self._lock:
-            self._waiting[spec.task_id] = state
+            self._waiting[token] = state
         for oid in missing:
             self._raylet.object_manager.pull_async(
-                oid, lambda ok, oid=oid: self._on_arg(spec.task_id, oid, ok))
+                oid, lambda ok, oid=oid: self._on_arg(token, oid, ok))
 
-    def _on_arg(self, task_id, oid, ok):
+    def _on_arg(self, token, oid, ok):
         with self._lock:
-            state = self._waiting.get(task_id)
+            state = self._waiting.get(token)
             if state is None:
                 return
             state.missing.discard(oid)
             done = not state.missing
             if done:
-                del self._waiting[task_id]
+                del self._waiting[token]
         if done:
             state.reply()
 

@@ -136,6 +136,15 @@ class NodeObjectManager:
             self._raylet.loop.post(
                 lambda: attempt(next(iter(locations))), "pull")
             return
+        # Freed object: nothing will ever produce it again — fail fast
+        # instead of subscribing forever (the caller may try lineage
+        # reconstruction).
+        core = self._raylet.core_worker
+        if core is not None and \
+                not core.reference_counter.has_reference(object_id) and \
+                not core.task_manager.is_pending(object_id.task_id()):
+            finish(False)
+            return
         # No location yet: the object may still be computing.  Watch both
         # signals — a directory location (big objects land in a node store)
         # and the owner's memory store (small returns are "inlined" there,

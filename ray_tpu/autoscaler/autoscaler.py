@@ -94,9 +94,15 @@ class StandardAutoscaler:
                 continue
             if self._is_idle(nid, now):
                 logger.info("Terminating %s: idle", nid)
+                ip = self.provider.internal_ip(nid)
                 self.provider.terminate_node(nid)
                 counts[node_type] -= 1
                 self.num_terminations += 1
+                # Drop the dead node's resources immediately so this
+                # round's bin-pack doesn't place demand on it.
+                with self.load_metrics.lock:
+                    self.load_metrics.static_resources_by_ip.pop(ip, None)
+                    self.load_metrics.dynamic_resources_by_ip.pop(ip, None)
 
         # (3) what to launch.
         counts = self._node_type_counts()

@@ -57,8 +57,9 @@ class Monitor:
                     pending_pgs.append({
                         "strategy": pg.strategy,
                         "bundles": [b.to_dict() for b in pg.bundles]})
-        self.load_metrics.pending_demands = demands
-        self.load_metrics.pending_placement_groups = pending_pgs
+        with self.load_metrics.lock:
+            self.load_metrics.pending_demands = demands
+            self.load_metrics.pending_placement_groups = pending_pgs
         alive = [r.node_id.hex()[:12] for r in gcs.raylets().values()]
         self.load_metrics.prune_active_ips(alive)
 

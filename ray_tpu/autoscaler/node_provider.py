@@ -53,8 +53,7 @@ class NodeProvider:
         raise NotImplementedError
 
     def set_node_tags(self, node_id: str, tags: Dict[str, str]) -> None:
-        for node_id_tags in (self.node_tags(node_id),):
-            node_id_tags.update(tags)
+        raise NotImplementedError
 
 
 class MockProvider(NodeProvider):
@@ -171,6 +170,7 @@ class FakeMultiNodeProvider(NodeProvider):
                 raylet = self.cluster.add_node(
                     num_cpus=resources.get("CPU", 0),
                     num_tpus=resources.get("TPU", 0),
+                    memory=resources.get("memory"),
                     resources={k: v for k, v in resources.items()
                                if k not in ("CPU", "TPU", "memory")},
                     object_store_memory=None)

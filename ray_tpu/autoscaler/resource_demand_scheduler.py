@@ -13,8 +13,10 @@ TPU-first twist: instead of dict-of-dict first-fit loops, the packer is
 columnar — demands dedup into (class, count) runs over a shared resource
 vocabulary and each class is waterfilled against an [N, R] availability
 matrix, the *same* math as ``ray_tpu.scheduler.jax_backend``'s device
-solve (the numpy path here is exact; the jax path batches all classes in
-one [C,R]x[N,R] kernel call for large problems).
+solve. ``get_bin_pack_residual`` is the exact numpy path;
+``pack_with_jax_kernel`` is the batched one-kernel-call alternative for
+very large sweeps (callers opt in; its packing order follows the
+kernel's utilization scoring rather than strict first-fit-decreasing).
 """
 
 from __future__ import annotations
@@ -26,10 +28,6 @@ import numpy as np
 
 ResourceDict = Dict[str, float]
 NodeType = str
-
-# Above this demands x nodes product the packer ships the whole problem
-# to the TPU kernel in one batched call instead of looping classes.
-_JAX_PACK_THRESHOLD = 512 * 512
 
 
 def _vocab(node_resources: List[ResourceDict],

@@ -1,0 +1,23 @@
+"""ray_tpu.data: distributed datasets on the object store.
+
+Parity: reference ``python/ray/data/`` (Dataset, DatasetPipeline,
+read_api, GroupedDataset). See module docstrings for the per-file map.
+"""
+
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata  # noqa: F401
+from ray_tpu.data.dataset import Dataset, GroupedDataset  # noqa: F401
+from ray_tpu.data.dataset_pipeline import DatasetPipeline  # noqa: F401
+from ray_tpu.data.impl.compute import (  # noqa: F401
+    ActorPoolStrategy, TaskPoolStrategy)
+from ray_tpu.data.read_api import (  # noqa: F401
+    from_arrow, from_items, from_numpy, from_pandas, range, range_table,
+    read_binary_files, read_csv, read_json, read_numpy, read_parquet,
+    read_text)
+
+__all__ = [
+    "ActorPoolStrategy", "Block", "BlockAccessor", "BlockMetadata",
+    "Dataset", "DatasetPipeline", "GroupedDataset", "TaskPoolStrategy",
+    "from_arrow", "from_items", "from_numpy", "from_pandas", "range",
+    "range_table", "read_binary_files", "read_csv", "read_json",
+    "read_numpy", "read_parquet", "read_text",
+]

@@ -1,0 +1,83 @@
+"""Compute strategies: run a block transform via tasks or an actor pool.
+
+Parity: reference ``python/ray/data/impl/compute.py`` — ``TaskPoolStrategy``
+(one task per block) and ``ActorPoolStrategy`` (autoscaling pool of
+stateful actors; used for e.g. model inference where setup is expensive).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+
+BlockTransform = Callable[[Block], Block]
+
+
+@ray_tpu.remote(num_cpus=1, num_returns=2)
+def _transform_block(fn: BlockTransform, block: Block):
+    out = fn(block)
+    return out, BlockAccessor(out).get_metadata()
+
+
+class TaskPoolStrategy:
+    def apply(self, fn: BlockTransform, blocks: List, *,
+              remote_args: Optional[dict] = None
+              ) -> Tuple[List, List[BlockMetadata]]:
+        task = _transform_block
+        if remote_args:
+            task = task.options(num_returns=2, **remote_args)
+        pairs = [task.remote(fn, b) for b in blocks]
+        out_refs = [p[0] for p in pairs]
+        meta = ray_tpu.get([p[1] for p in pairs])
+        return out_refs, meta
+
+
+class _PoolWorker:
+    def __init__(self, init_fn: Optional[Callable] = None):
+        self.state = init_fn() if init_fn else None
+
+    def transform(self, fn: BlockTransform, block: Block):
+        out = fn(block) if self.state is None else fn(block, self.state)
+        return out, BlockAccessor(out).get_metadata()
+
+
+class ActorPoolStrategy:
+    """min_size..max_size actors; blocks are dealt to idle actors
+    (reference ActorPoolStrategy)."""
+
+    def __init__(self, min_size: int = 1, max_size: Optional[int] = None,
+                 init_fn: Optional[Callable] = None):
+        self.min_size = min_size
+        self.max_size = max_size or max(min_size, 2)
+        self.init_fn = init_fn
+
+    def apply(self, fn: BlockTransform, blocks: List, *,
+              remote_args: Optional[dict] = None
+              ) -> Tuple[List, List[BlockMetadata]]:
+        from ray_tpu.util.actor_pool import ActorPool
+        n = max(self.min_size, min(self.max_size, len(blocks)))
+        actor_cls = ray_tpu.remote(**(remote_args or {"num_cpus": 1}))(
+            _PoolWorker)
+        actors = [actor_cls.remote(self.init_fn) for _ in range(n)]
+        pool = ActorPool(actors)
+        pairs = list(pool.map(
+            lambda a, b: a.transform.remote(fn, b), list(blocks)))
+        out_refs, meta = [], []
+        for out, m in pairs:
+            out_refs.append(ray_tpu.put(out))
+            meta.append(m)
+        for a in actors:
+            ray_tpu.kill(a)
+        return out_refs, meta
+
+
+def get_compute(compute) -> Any:
+    if compute is None or compute == "tasks":
+        return TaskPoolStrategy()
+    if compute == "actors":
+        return ActorPoolStrategy()
+    if isinstance(compute, (TaskPoolStrategy, ActorPoolStrategy)):
+        return compute
+    raise ValueError(f"unknown compute strategy: {compute!r}")

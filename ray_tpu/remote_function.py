@@ -81,8 +81,10 @@ class RemoteFunction:
         if not w.connected:
             worker_mod.init()
         core = w.core_worker
-        if self._function_id is None:
-            self._function_id = core.function_manager.export(self._function)
+        # Export every call: the FunctionManager dedupes per cluster, and a
+        # RemoteFunction defined at module scope outlives init/shutdown
+        # cycles (a cached id would dangle into the new cluster's empty KV).
+        self._function_id = core.function_manager.export(self._function)
         resources = _resource_dict(options)
         resources, strategy, pg_id, bundle_idx = \
             resolve_pg_strategy(options, resources)

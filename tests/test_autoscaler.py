@@ -232,3 +232,13 @@ def test_request_resources(ray_start_cluster):
     monitor.update_all()
     assert cluster.wait_for_nodes(3)  # head + 2 workers
     monitor.stop()
+
+
+def test_pack_with_jax_kernel():
+    from ray_tpu.autoscaler.resource_demand_scheduler import (
+        pack_with_jax_kernel)
+    nodes = [{"CPU": 4}, {"CPU": 4}, {"CPU": 2, "TPU": 4}]
+    demands = [{"CPU": 2}] * 4 + [{"TPU": 4}] + [{"CPU": 16}]
+    unfulfilled, alloc = pack_with_jax_kernel(nodes, demands)
+    assert unfulfilled == [{"CPU": 16}]
+    assert alloc.sum() == 5

@@ -1,0 +1,220 @@
+"""Dataset tests (reference: python/ray/data/tests/test_dataset.py,
+test_dataset_pipeline.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data
+
+
+@pytest.fixture
+def ray_8():
+    ctx = ray_tpu.init(num_cpus=8)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def test_range_basic(ray_8):
+    ds = data.range(100, parallelism=4)
+    assert ds.count() == 100
+    assert ds.num_blocks() == 4
+    assert ds.take(5) == [0, 1, 2, 3, 4]
+    assert ds.sum() == 4950
+
+
+def test_range_table(ray_8):
+    ds = data.range_table(10, parallelism=2)
+    rows = ds.take(3)
+    assert rows[0]["value"] == 0
+    assert ds.schema() == {"value": "int64"}
+
+
+def test_from_items_map_filter(ray_8):
+    ds = data.from_items(list(range(20)), parallelism=3)
+    out = ds.map(lambda x: x * 2).filter(lambda x: x % 4 == 0)
+    assert sorted(out.take(100)) == [0, 4, 8, 12, 16, 20, 24, 28, 32, 36]
+
+
+def test_flat_map(ray_8):
+    ds = data.from_items([1, 2, 3])
+    out = ds.flat_map(lambda x: [x, x * 10])
+    assert sorted(out.take(10)) == [1, 2, 3, 10, 20, 30]
+
+
+def test_map_batches_numpy(ray_8):
+    ds = data.range_table(32, parallelism=2)
+    out = ds.map_batches(lambda b: {"value": b["value"] * 2},
+                         batch_size=8, batch_format="numpy")
+    assert out.sum("value") == 2 * sum(range(32))
+
+
+def test_map_batches_pandas(ray_8):
+    ds = data.range_table(16, parallelism=2)
+
+    def add_col(df):
+        df["double"] = df["value"] * 2
+        return df
+    out = ds.map_batches(add_col, batch_format="pandas")
+    assert out.take(1)[0]["double"] == 0
+    assert out.sum("double") == 2 * sum(range(16))
+
+
+def test_map_batches_actors(ray_8):
+    ds = data.range_table(24, parallelism=3)
+    out = ds.map_batches(lambda b: {"value": b["value"] + 1},
+                         batch_format="numpy", compute="actors")
+    assert out.sum("value") == sum(range(24)) + 24
+
+
+def test_repartition(ray_8):
+    ds = data.range(100, parallelism=2).repartition(5)
+    assert ds.num_blocks() == 5
+    assert ds.count() == 100
+    assert ds.sum() == 4950
+
+
+def test_random_shuffle(ray_8):
+    ds = data.range(100, parallelism=4)
+    shuffled = ds.random_shuffle(seed=0)
+    vals = shuffled.take(100)
+    assert sorted(vals) == list(range(100))
+    assert vals != list(range(100))
+
+
+def test_sort_simple(ray_8):
+    rng = np.random.default_rng(0)
+    items = [int(x) for x in rng.permutation(50)]
+    ds = data.from_items(items, parallelism=4).sort()
+    assert ds.take(50) == sorted(items)
+
+
+def test_sort_key_descending(ray_8):
+    ds = data.from_items([{"a": i % 7, "b": i} for i in range(30)],
+                         parallelism=3)
+    out = ds.sort(key="a", descending=True).take(30)
+    assert [r["a"] for r in out] == sorted([i % 7 for i in range(30)],
+                                           reverse=True)
+
+
+def test_groupby(ray_8):
+    ds = data.from_items([{"k": i % 3, "v": i} for i in range(12)],
+                         parallelism=3)
+    counts = {r["k"]: r["count"] for r in ds.groupby("k").count().take(10)}
+    assert counts == {0: 4, 1: 4, 2: 4}
+    sums = {r["k"]: r["sum(v)"] for r in
+            ds.groupby("k").sum("v").take(10)}
+    assert sums == {0: 0 + 3 + 6 + 9, 1: 1 + 4 + 7 + 10, 2: 2 + 5 + 8 + 11}
+
+
+def test_split_union_zip(ray_8):
+    ds = data.range(30, parallelism=6)
+    parts = ds.split(3)
+    assert sum(p.count() for p in parts) == 30
+    u = parts[0].union(parts[1], parts[2])
+    assert u.count() == 30
+    a = data.from_items([1, 2, 3])
+    b = data.from_items(["x", "y", "z"])
+    assert a.zip(b).take(3) == [(1, "x"), (2, "y"), (3, "z")]
+
+
+def test_limit_take(ray_8):
+    ds = data.range(100, parallelism=4)
+    assert ds.limit(7).count() == 7
+    assert ds.take(3) == [0, 1, 2]
+
+
+def test_iter_batches_static_shapes(ray_8):
+    ds = data.range_table(50, parallelism=3)
+    shapes = [len(b["value"]) for b in
+              ds.iter_batches(batch_size=16, pad_to_batch=True,
+                              batch_format="numpy")]
+    assert all(s == 16 for s in shapes)
+
+
+def test_to_jax(ray_8):
+    import jax.numpy as jnp
+    ds = data.range_table(32, parallelism=2)
+    batches = list(ds.to_jax(batch_size=8))
+    assert all(isinstance(b["value"], jnp.ndarray) for b in batches)
+    assert all(b["value"].shape == (8,) for b in batches)
+
+
+def test_csv_roundtrip(ray_8, tmp_path):
+    import pandas as pd
+    df = pd.DataFrame({"a": range(10), "b": [f"s{i}" for i in range(10)]})
+    ds = data.from_pandas(df)
+    out_dir = str(tmp_path / "csv")
+    ds.write_csv(out_dir)
+    back = data.read_csv(out_dir)
+    assert back.count() == 10
+    assert back.sum("a") == 45
+
+
+def test_parquet_roundtrip(ray_8, tmp_path):
+    ds = data.range_table(20, parallelism=2)
+    out_dir = str(tmp_path / "pq")
+    ds.write_parquet(out_dir)
+    back = data.read_parquet(out_dir)
+    assert back.count() == 20
+    assert back.sum("value") == sum(range(20))
+
+
+def test_numpy_roundtrip(ray_8, tmp_path):
+    ds = data.from_numpy(np.arange(12))
+    out_dir = str(tmp_path / "np")
+    ds.write_numpy(out_dir)
+    back = data.read_numpy(out_dir)
+    assert back.sum("value") == 66
+
+
+def test_read_text(ray_8, tmp_path):
+    p = tmp_path / "f.txt"
+    p.write_text("alpha\nbeta\ngamma\n")
+    ds = data.read_text(str(p))
+    assert ds.take(5) == ["alpha", "beta", "gamma"]
+
+
+def test_pipeline_window(ray_8):
+    pipe = data.range(40, parallelism=8).window(blocks_per_window=2)
+    doubled = pipe.map(lambda x: x * 2)
+    assert sum(doubled.iter_rows()) == 2 * sum(range(40))
+
+
+def test_pipeline_repeat(ray_8):
+    pipe = data.range(10, parallelism=2).repeat(3)
+    assert pipe.count() == 30
+
+
+def test_pipeline_shuffle_each_window(ray_8):
+    pipe = data.range(20, parallelism=4).window(blocks_per_window=2) \
+        .random_shuffle_each_window(seed=1)
+    assert sorted(pipe.iter_rows()) == list(range(20))
+
+
+def test_pipeline_split(ray_8):
+    pipe = data.range(24, parallelism=4).window(blocks_per_window=2)
+    shards = pipe.split(2)
+    total = sum(shards[0].iter_rows()) + sum(shards[1].iter_rows())
+    assert total == sum(range(24))
+
+
+def test_stats_aggregates(ray_8):
+    ds = data.from_items([float(i) for i in range(10)])
+    assert ds.mean() == 4.5
+    assert ds.min() == 0
+    assert ds.max() == 9
+    assert abs(ds.std() - np.std(np.arange(10.0), ddof=1)) < 1e-9
+
+
+def test_pipeline_split_single_execution(ray_8):
+    # Unseeded shuffle: split must still give disjoint, complete coverage
+    # because the pipeline executes once via the shared coordinator.
+    pipe = data.range(40, parallelism=4).window(blocks_per_window=2) \
+        .random_shuffle_each_window()
+    a, b = pipe.split(2)
+    rows_a = list(a.iter_rows())
+    rows_b = list(b.iter_rows())
+    assert sorted(rows_a + rows_b) == list(range(40))
