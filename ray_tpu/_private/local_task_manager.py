@@ -20,12 +20,13 @@ from ray_tpu.scheduler.resources import ResourceRequest
 
 
 class _Waiting:
-    __slots__ = ("spec", "reply", "missing")
+    __slots__ = ("spec", "reply", "missing", "retries")
 
     def __init__(self, spec, reply, missing):
         self.spec = spec
         self.reply = reply
         self.missing = missing
+        self.retries = {}  # oid -> failed-pull retry count
 
 
 class DependencyManager:
@@ -56,15 +57,41 @@ class DependencyManager:
             self._raylet.object_manager.pull_async(
                 oid, lambda ok, oid=oid: self._on_arg(token, oid, ok))
 
+    _MAX_PULL_RETRIES = 3
+
     def _on_arg(self, token, oid, ok):
         with self._lock:
             state = self._waiting.get(token)
             if state is None:
                 return
-            state.missing.discard(oid)
+            if not ok:
+                state.retries[oid] = state.retries.get(oid, 0) + 1
+                retry = state.retries[oid] <= self._MAX_PULL_RETRIES
+            else:
+                retry = False
+            if not retry:
+                # Either the arg is ready, or retries are exhausted — in
+                # the latter case dispatch anyway and let the executor's
+                # fetch loop raise a proper ObjectLostError to the owner.
+                state.missing.discard(oid)
             done = not state.missing
             if done:
                 del self._waiting[token]
+        if retry:
+            # Failed pull (source died / object freed): ask the owner to
+            # reconstruct from lineage, then re-pull after a short delay.
+            core = self._raylet.core_worker
+            if core is not None:
+                try:
+                    core.recover_object(oid)
+                except Exception:
+                    pass
+            self._raylet.loop.schedule_after(
+                0.02 * state.retries[oid],
+                lambda: self._raylet.object_manager.pull_async(
+                    oid, lambda ok2, oid=oid: self._on_arg(token, oid, ok2)),
+                "dep.repull")
+            return
         if done:
             state.reply()
 

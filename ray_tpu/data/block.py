@@ -182,8 +182,15 @@ class BlockBuilder:
     def build(self) -> Block:
         if self._tables and not self._rows:
             keys = list(self._tables[0].keys())
-            return {k: np.concatenate([t[k] for t in self._tables])
-                    for k in keys}
+            if all(set(t.keys()) == set(keys) for t in self._tables):
+                return {k: np.concatenate([t[k] for t in self._tables])
+                        for k in keys}
+            # Mismatched schemas (e.g. union of unrelated tables):
+            # degrade to rows rather than KeyError or dropping columns.
+            rows: List[Any] = []
+            for t in self._tables:
+                rows.extend(BlockAccessor(t).iter_rows())
+            return rows
         if self._tables:
             # Mixed: degrade to rows.
             rows = list(self._rows)

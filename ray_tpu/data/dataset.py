@@ -29,12 +29,13 @@ from ray_tpu.data.impl.compute import get_compute
 T = Any
 
 
-@ray_tpu.remote(num_cpus=1)
-def _merge_blocks(*blocks: Block) -> Block:
+@ray_tpu.remote(num_cpus=1, num_returns=2)
+def _merge_blocks(*blocks: Block):
     builder = BlockBuilder()
     for b in blocks:
         builder.add_block(b)
-    return builder.build()
+    out = builder.build()
+    return out, BlockAccessor(out).get_metadata()
 
 
 @ray_tpu.remote(num_cpus=1)
@@ -60,15 +61,16 @@ def _shuffle_map(block: Block, n: int, seed: Optional[int], idx: int):
     return parts[0] if n == 1 else parts
 
 
-@ray_tpu.remote(num_cpus=1)
-def _shuffle_reduce(seed: Optional[int], idx: int, *shards: Block) -> Block:
+@ray_tpu.remote(num_cpus=1, num_returns=2)
+def _shuffle_reduce(seed: Optional[int], idx: int, *shards: Block):
     builder = BlockBuilder()
     for s in shards:
         builder.add_block(s)
     merged = builder.build()
     acc = BlockAccessor(merged)
     rng = np.random.default_rng(None if seed is None else seed * 31 + idx)
-    return acc.take_indices(rng.permutation(acc.num_rows()))
+    out = acc.take_indices(rng.permutation(acc.num_rows()))
+    return out, BlockAccessor(out).get_metadata()
 
 
 def _sort_key_fn(key) -> Callable[[Any], Any]:
@@ -113,8 +115,8 @@ def _sort_map(block: Block, key, boundaries: List[Any], descending: bool
     return out[0] if len(out) == 1 else out
 
 
-@ray_tpu.remote(num_cpus=1)
-def _sort_reduce(key, descending: bool, *shards: Block) -> Block:
+@ray_tpu.remote(num_cpus=1, num_returns=2)
+def _sort_reduce(key, descending: bool, *shards: Block):
     builder = BlockBuilder()
     for s in shards:
         builder.add_block(s)
@@ -125,7 +127,8 @@ def _sort_reduce(key, descending: bool, *shards: Block) -> Block:
     b = BlockBuilder()
     for r in rows:
         b.add(r)
-    return b.build()
+    out = b.build()
+    return out, BlockAccessor(out).get_metadata()
 
 
 @ray_tpu.remote(num_cpus=1)
@@ -140,8 +143,8 @@ def _groupby_map(block: Block, key, n: int):
     return built[0] if n == 1 else built
 
 
-@ray_tpu.remote(num_cpus=1)
-def _groupby_reduce(key, agg_name: str, on, *shards: Block) -> Block:
+@ray_tpu.remote(num_cpus=1, num_returns=2)
+def _groupby_reduce(key, agg_name: str, on, *shards: Block):
     groups: Dict[Any, List[Any]] = {}
     kf = _sort_key_fn(key)
     for s in shards:
@@ -168,25 +171,39 @@ def _groupby_reduce(key, agg_name: str, on, *shards: Block) -> Block:
             raise ValueError(agg_name)
         out.add({(key if isinstance(key, str) else "key"): k,
                  f"{agg_name}({on})" if on else agg_name: v})
-    return out.build()
+    built = out.build()
+    return built, BlockAccessor(built).get_metadata()
 
 
 class Dataset:
     def __init__(self, blocks: List, metadata: Optional[List[BlockMetadata]]
-                 = None):
+                 = None, metadata_refs: Optional[List] = None):
+        """``metadata_refs`` keeps metadata as pending ObjectRefs so
+        constructing a Dataset never blocks on upstream tasks — stages
+        stay pipelineable; refs resolve lazily on first metadata use."""
         self._blocks = list(blocks)
-        if metadata is None:
-            metadata = ray_tpu.get(
-                [_meta_of.remote(b) for b in self._blocks])
-        self._metadata = list(metadata)
+        self._meta_cache = list(metadata) if metadata is not None else None
+        if self._meta_cache is None and metadata_refs is not None:
+            self._meta_refs = list(metadata_refs)
+        elif self._meta_cache is None:
+            self._meta_refs = [_meta_of.remote(b) for b in self._blocks]
+        else:
+            self._meta_refs = None
+
+    @property
+    def _metadata(self) -> List[BlockMetadata]:
+        if self._meta_cache is None:
+            self._meta_cache = ray_tpu.get(self._meta_refs)
+            self._meta_refs = None
+        return self._meta_cache
 
     # ---- transforms ------------------------------------------------------
     def _transform(self, fn, compute=None, **remote_args) -> "Dataset":
         strategy = get_compute(compute)
-        refs, meta = strategy.apply(
+        refs, meta_refs = strategy.apply(
             fn, self._blocks,
             remote_args=remote_args or None)
-        return Dataset(refs, meta)
+        return Dataset(refs, metadata_refs=meta_refs)
 
     def map(self, fn: Callable[[T], T], *, compute=None, **remote_args
             ) -> "Dataset":
@@ -249,10 +266,10 @@ class Dataset:
                   for b in self._blocks]
         if n == 1:
             splits = [[s] for s in splits]
-        new_blocks = [
-            _merge_blocks.remote(*[s[j] for s in splits])
-            for j in range(n)]
-        return Dataset(new_blocks)
+        pairs = [_merge_blocks.remote(*[s[j] for s in splits])
+                 for j in range(n)]
+        return Dataset([p[0] for p in pairs],
+                       metadata_refs=[p[1] for p in pairs])
 
     def random_shuffle(self, *, seed: Optional[int] = None,
                        num_blocks: Optional[int] = None) -> "Dataset":
@@ -261,10 +278,10 @@ class Dataset:
                 for i, b in enumerate(self._blocks)]
         if n == 1:
             maps = [[m] for m in maps]
-        new_blocks = [
-            _shuffle_reduce.remote(seed, j, *[m[j] for m in maps])
-            for j in range(n)]
-        return Dataset(new_blocks)
+        pairs = [_shuffle_reduce.remote(seed, j, *[m[j] for m in maps])
+                 for j in range(n)]
+        return Dataset([p[0] for p in pairs],
+                       metadata_refs=[p[1] for p in pairs])
 
     def sort(self, key=None, descending: bool = False) -> "Dataset":
         if not self._blocks:
@@ -280,10 +297,10 @@ class Dataset:
             b, key, boundaries, descending) for b in self._blocks]
         if n == 1:
             maps = [[m] for m in maps]
-        new_blocks = [
-            _sort_reduce.remote(key, descending, *[m[j] for m in maps])
-            for j in range(n)]
-        return Dataset(new_blocks)
+        pairs = [_sort_reduce.remote(key, descending, *[m[j] for m in maps])
+                 for j in range(n)]
+        return Dataset([p[0] for p in pairs],
+                       metadata_refs=[p[1] for p in pairs])
 
     def groupby(self, key) -> "GroupedDataset":
         return GroupedDataset(self, key)
@@ -298,27 +315,43 @@ class Dataset:
         return Dataset(blocks, meta)
 
     def zip(self, other: "Dataset") -> "Dataset":
-        def _zip(a: Block, b: Block) -> Block:
-            out = BlockBuilder()
-            for ra, rb in zip(BlockAccessor(a).iter_rows(),
-                              BlockAccessor(b).iter_rows()):
-                if isinstance(ra, dict) and isinstance(rb, dict):
-                    merged = dict(ra)
-                    merged.update(rb)
-                    out.add(merged)
-                else:
-                    out.add((ra, rb))
-            return out.build()
-        zipper = ray_tpu.remote(num_cpus=1)(_zip)
-        return Dataset([zipper.remote(a, b)
-                        for a, b in zip(self._blocks, other._blocks)])
+        pairs = [_zip_blocks.remote(a, b)
+                 for a, b in zip(self._blocks, other._blocks)]
+        return Dataset([p[0] for p in pairs],
+                       metadata_refs=[p[1] for p in pairs])
 
     def split(self, n: int, *, equal: bool = False,
               locality_hints=None) -> List["Dataset"]:
         if equal:
-            flat = self.repartition(n)
-            return [Dataset([b], [m]) for b, m in
-                    zip(flat._blocks, flat._metadata)]
+            # Row-exact split: global row bounds total*i//n mapped onto
+            # per-block slices (reference _split_at_indices).
+            total = self.count()
+            bounds = [total * i // n for i in range(n + 1)]
+            starts = [0]
+            for m in self._metadata:
+                starts.append(starts[-1] + m.num_rows)
+            shards: List[List] = [[] for _ in range(n)]
+            for bi, (b, m) in enumerate(zip(self._blocks, self._metadata)):
+                blo, bhi = starts[bi], starts[bi + 1]
+                for s in range(n):
+                    lo, hi = max(blo, bounds[s]), min(bhi, bounds[s + 1])
+                    if lo >= hi:
+                        continue
+                    if lo == blo and hi == bhi:
+                        shards[s].append((b, m))
+                    else:
+                        shards[s].append((
+                            _slice_range.remote(b, lo - blo, hi - blo),
+                            None))
+            out = []
+            for s in range(n):
+                blocks = [b for b, _ in shards[s]]
+                metas = [m for _, m in shards[s]]
+                if all(m is not None for m in metas):
+                    out.append(Dataset(blocks, metas))
+                else:
+                    out.append(Dataset(blocks))
+            return out
         out = []
         for i in range(n):
             blocks = self._blocks[i::n]
@@ -554,16 +587,28 @@ class Dataset:
         @ray_tpu.remote(num_cpus=1)
         def write_one(block: Block, out: str):
             from ray_tpu.data.block import _PANDAS_LOCK
+            if fmt == "parquet":
+                # Pure pyarrow: pandas' parquet writer segfaults when
+                # invoked from worker threads (even serialized) in the
+                # pandas 3.0/pyarrow 25 combination; pq.write_table from
+                # threads is safe.
+                import pyarrow as pa
+                import pyarrow.parquet as pq
+                acc = BlockAccessor(block)
+                cols = block if is_table(block) else \
+                    BlockAccessor.batch_to_block(acc.to_pandas())
+                table = pa.table({k: pa.array(np.asarray(v))
+                                  for k, v in cols.items()})
+                pq.write_table(table, out)
+                return out
             df = BlockAccessor(block).to_pandas()
-            # Serialize: to_parquet/to_csv build arrow arrays, which are
-            # not construction-thread-safe (see block._PANDAS_LOCK).
+            # Serialize: to_csv/to_json build arrow string arrays, which
+            # are not construction-thread-safe (see block._PANDAS_LOCK).
             with _PANDAS_LOCK:
                 if fmt == "csv":
                     df.to_csv(out, index=False)
-                elif fmt == "json":
-                    df.to_json(out, orient="records", lines=True)
                 else:
-                    df.to_parquet(out)
+                    df.to_json(out, orient="records", lines=True)
             return out
         ray_tpu.get([
             write_one.remote(b, os.path.join(path, f"block_{i:05d}.{fmt}"))
@@ -580,6 +625,26 @@ def _slice_head(block: Block, k: int) -> Block:
     return BlockAccessor(block).slice(0, k)
 
 
+@ray_tpu.remote(num_cpus=1)
+def _slice_range(block: Block, lo: int, hi: int) -> Block:
+    return BlockAccessor(block).slice(lo, hi)
+
+
+@ray_tpu.remote(num_cpus=1, num_returns=2)
+def _zip_blocks(a: Block, b: Block):
+    out = BlockBuilder()
+    for ra, rb in zip(BlockAccessor(a).iter_rows(),
+                      BlockAccessor(b).iter_rows()):
+        if isinstance(ra, dict) and isinstance(rb, dict):
+            merged = dict(ra)
+            merged.update(rb)
+            out.add(merged)
+        else:
+            out.add((ra, rb))
+    built = out.build()
+    return built, BlockAccessor(built).get_metadata()
+
+
 class GroupedDataset:
     """Hash-partition groupby (reference ``grouped_dataset.py``)."""
 
@@ -593,10 +658,11 @@ class GroupedDataset:
                 for b in self._ds._blocks]
         if n == 1:
             maps = [[m] for m in maps]
-        blocks = [
+        pairs = [
             _groupby_reduce.remote(self._key, name, on, *[m[j] for m in maps])
             for j in range(n)]
-        return Dataset(blocks)
+        return Dataset([p[0] for p in pairs],
+                       metadata_refs=[p[1] for p in pairs])
 
     def count(self) -> Dataset:
         return self._agg("count")

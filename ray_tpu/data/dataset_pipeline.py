@@ -130,9 +130,17 @@ class _SplitCoordinator:
         self._cache: dict = {}   # window idx -> (splits, remaining_count)
         self._next_idx = 0
         self._exhausted = False
+        self._consumed = [0] * n  # next expected window per consumer
 
     def get_shard(self, window_idx: int, consumer: int):
         with self._lock:
+            if window_idx != self._consumed[consumer]:
+                raise RuntimeError(
+                    "A split() pipeline shard can be iterated only once: "
+                    f"consumer {consumer} already took window "
+                    f"{self._consumed[consumer] - 1}; re-splitting requires "
+                    "rebuilding the pipeline.")
+            self._consumed[consumer] += 1
             while window_idx >= self._next_idx and not self._exhausted:
                 try:
                     ds = next(self._source)

@@ -24,14 +24,15 @@ def _transform_block(fn: BlockTransform, block: Block):
 class TaskPoolStrategy:
     def apply(self, fn: BlockTransform, blocks: List, *,
               remote_args: Optional[dict] = None
-              ) -> Tuple[List, List[BlockMetadata]]:
+              ) -> Tuple[List, List]:
+        """Returns (block_refs, metadata_refs) — no blocking, so a
+        downstream stage can start on finished blocks while this stage's
+        stragglers still run (DatasetPipeline overlap)."""
         task = _transform_block
         if remote_args:
             task = task.options(num_returns=2, **remote_args)
         pairs = [task.remote(fn, b) for b in blocks]
-        out_refs = [p[0] for p in pairs]
-        meta = ray_tpu.get([p[1] for p in pairs])
-        return out_refs, meta
+        return [p[0] for p in pairs], [p[1] for p in pairs]
 
 
 class _PoolWorker:
@@ -55,22 +56,41 @@ class ActorPoolStrategy:
 
     def apply(self, fn: BlockTransform, blocks: List, *,
               remote_args: Optional[dict] = None
-              ) -> Tuple[List, List[BlockMetadata]]:
-        from ray_tpu.util.actor_pool import ActorPool
+              ) -> Tuple[List, List]:
         n = max(self.min_size, min(self.max_size, len(blocks)))
         actor_cls = ray_tpu.remote(**(remote_args or {"num_cpus": 1}))(
             _PoolWorker)
         actors = [actor_cls.remote(self.init_fn) for _ in range(n)]
-        pool = ActorPool(actors)
-        pairs = list(pool.map(
-            lambda a, b: a.transform.remote(fn, b), list(blocks)))
-        out_refs, meta = [], []
-        for out, m in pairs:
-            out_refs.append(ray_tpu.put(out))
-            meta.append(m)
-        for a in actors:
-            ray_tpu.kill(a)
-        return out_refs, meta
+        # Round-robin blocks over the pool; outputs stay as ObjectRefs
+        # (num_returns=2) — blocks never transit the driver.
+        pairs = [
+            actors[i % n].transform.options(num_returns=2).remote(fn, b)
+            for i, b in enumerate(blocks)]
+        block_refs = [p[0] for p in pairs]
+        meta_refs = [p[1] for p in pairs]
+        # Kill the pool only after all work finished; fire-and-forget
+        # cleanup keeps apply() non-blocking.
+        def _reap(_meta):
+            for a in actors:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
+        _wait_then(meta_refs, _reap)
+        return block_refs, meta_refs
+
+
+def _wait_then(refs: List, cb: Callable):
+    """Run cb(values) on a helper thread once all refs resolve."""
+    import threading
+
+    def run():
+        try:
+            vals = ray_tpu.get(list(refs))
+        except Exception:
+            vals = None
+        cb(vals)
+    threading.Thread(target=run, daemon=True).start()
 
 
 def get_compute(compute) -> Any:

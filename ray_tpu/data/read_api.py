@@ -26,9 +26,11 @@ def _expand_paths(paths: Union[str, List[str]]) -> List[str]:
     out: List[str] = []
     for p in paths:
         if os.path.isdir(p):
-            out.extend(sorted(
-                os.path.join(p, f) for f in os.listdir(p)
-                if not f.startswith(".")))
+            for root, dirs, files in sorted(os.walk(p)):
+                dirs.sort()
+                out.extend(sorted(
+                    os.path.join(root, f) for f in files
+                    if not f.startswith(".")))
         else:
             out.append(p)
     return out
@@ -143,10 +145,12 @@ def read_json(paths, **pd_kwargs) -> Dataset:
 
 def read_parquet(paths, columns: Optional[List[str]] = None) -> Dataset:
     def reader(path):
-        from ray_tpu.data.block import _PANDAS_LOCK, _pd
-        with _PANDAS_LOCK:
-            df = _pd().read_parquet(path, columns=columns)
-            return {c: df[c].to_numpy() for c in df.columns}
+        # Pure pyarrow: pandas' parquet reader shares the thread-unsafe
+        # writer machinery (see Dataset._write).
+        import pyarrow.parquet as pq
+        table = pq.read_table(path, columns=columns)
+        return {c: table[c].to_numpy(zero_copy_only=False)
+                for c in table.column_names}
     return _read_files(paths, reader)
 
 

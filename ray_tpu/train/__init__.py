@@ -1,0 +1,28 @@
+"""ray_tpu.train: distributed training orchestration.
+
+Parity: reference ``python/ray/train/`` — ``Trainer`` (trainer.py) ->
+``BackendExecutor`` (backend.py) -> ``WorkerGroup`` of actors
+(worker_group.py); per-worker ``session`` with ``report``/``checkpoint``
+(session.py); callbacks (callbacks/). The reference's backends wire up
+torch DDP / TF MultiWorkerMirrored process groups; here the first-class
+backend is **JAX SPMD** (collective group over the device mesh), with a
+torch CPU backend for parity.
+"""
+
+from ray_tpu.train.backend import (  # noqa: F401
+    BackendConfig, JaxConfig, TorchConfig)
+from ray_tpu.train.callbacks import (  # noqa: F401
+    JsonLoggerCallback, PrintCallback, TrainingCallback)
+from ray_tpu.train.checkpoint import CheckpointStrategy  # noqa: F401
+from ray_tpu.train.session import (  # noqa: F401
+    local_rank, load_checkpoint, report, save_checkpoint, world_rank,
+    world_size)
+from ray_tpu.train.trainer import Trainer  # noqa: F401
+from ray_tpu.train.worker_group import WorkerGroup  # noqa: F401
+
+__all__ = [
+    "BackendConfig", "CheckpointStrategy", "JaxConfig", "JsonLoggerCallback",
+    "PrintCallback", "TorchConfig", "Trainer", "TrainingCallback",
+    "WorkerGroup", "load_checkpoint", "local_rank", "report",
+    "save_checkpoint", "world_rank", "world_size",
+]

@@ -218,3 +218,25 @@ def test_pipeline_split_single_execution(ray_8):
     rows_a = list(a.iter_rows())
     rows_b = list(b.iter_rows())
     assert sorted(rows_a + rows_b) == list(range(40))
+
+
+def test_split_equal_rows(ray_8):
+    ds = data.from_items(list(range(6)), parallelism=2)
+    a, b = ds.split(2, equal=True)
+    assert a.count() == 3 and b.count() == 3
+    assert sorted(list(a.iter_rows()) + list(b.iter_rows())) == list(range(6))
+
+
+def test_pipeline_split_reiterate_raises(ray_8):
+    pipe = data.range(8, parallelism=2).window(blocks_per_window=1)
+    a, b = pipe.split(2)
+    list(a.iter_rows())
+    with pytest.raises(RuntimeError, match="iterated only once"):
+        list(a.iter_rows())
+
+
+def test_union_mixed_schema_repartition(ray_8):
+    u = data.from_numpy(np.arange(4)).union(
+        data.from_items([{"x": 1}, {"x": 2}]))
+    rows = u.repartition(2).take(10)
+    assert len(rows) == 6

@@ -1,0 +1,174 @@
+"""Train tests (reference: python/ray/train/tests/test_trainer.py,
+test_worker_group.py, test_callbacks.py)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train import (CheckpointStrategy, JsonLoggerCallback, Trainer,
+                           WorkerGroup)
+
+
+@pytest.fixture
+def ray_8():
+    ctx = ray_tpu.init(num_cpus=8)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def test_worker_group_execute(ray_8):
+    wg = WorkerGroup(num_workers=3, num_cpus_per_worker=1)
+    assert wg.execute(lambda: 7) == [7, 7, 7]
+    assert wg.execute_single(1, lambda x: x * 2, 21) == 42
+    wg.shutdown()
+
+
+def test_trainer_basic(ray_8):
+    def train_func():
+        for i in range(3):
+            train.report(step=i)
+        return train.world_rank()
+
+    trainer = Trainer(backend="base", num_workers=2)
+    results = trainer.run(train_func)
+    assert results == [0, 1]
+    trainer.shutdown()
+
+
+def test_trainer_config_and_topology(ray_8):
+    def train_func(config):
+        return (train.world_rank(), train.world_size(), config["lr"])
+
+    trainer = Trainer(backend="base", num_workers=2)
+    out = trainer.run(train_func, config={"lr": 0.1})
+    assert out == [(0, 2, 0.1), (1, 2, 0.1)]
+    trainer.shutdown()
+
+
+def test_trainer_reports_in_order(ray_8):
+    def train_func():
+        for i in range(4):
+            train.report(iter=i)
+
+    trainer = Trainer(backend="base", num_workers=2)
+    rounds = list(trainer.run_iterator(train_func))
+    assert len(rounds) == 4
+    for i, reports in enumerate(rounds):
+        assert all(r.get("iter") == i for r in reports)
+    trainer.shutdown()
+
+
+def test_trainer_jax_allreduce(ray_8):
+    """Data-parallel gradient averaging through the collective plane."""
+    def train_func():
+        from ray_tpu.util.collective import collective
+        rank = train.world_rank()
+        grad = np.full(4, float(rank + 1), dtype=np.float32)
+        avg = collective.allreduce(grad, group_name="train") / \
+            train.world_size()
+        train.report(avg0=float(avg[0]))
+        return float(avg.sum())
+
+    trainer = Trainer(backend="jax", num_workers=2)
+    results = trainer.run(train_func)
+    # mean of [1,1,1,1] and [2,2,2,2] -> 1.5 each
+    assert results == [6.0, 6.0]
+    trainer.shutdown()
+
+
+def test_trainer_checkpointing(ray_8, tmp_path):
+    def train_func():
+        ckpt = train.load_checkpoint()
+        start = ckpt["step"] + 1 if ckpt else 0
+        for i in range(start, start + 3):
+            train.save_checkpoint(step=i, loss=10.0 - i)
+            train.report(step=i)
+        return start
+
+    trainer = Trainer(backend="base", num_workers=2,
+                      logdir=str(tmp_path / "run"))
+    trainer.run(train_func,
+                checkpoint_strategy=CheckpointStrategy(
+                    num_to_keep=2, checkpoint_score_attribute="loss",
+                    checkpoint_score_order="min"))
+    assert trainer.latest_checkpoint["step"] == 2
+    best = trainer.load_checkpoint_from_path(trainer.best_checkpoint_path)
+    assert best["loss"] == 8.0  # step 2 has the lowest loss
+
+    # resume from checkpoint
+    starts = trainer.run(train_func, checkpoint=trainer.latest_checkpoint)
+    assert starts == [3, 3]
+    trainer.shutdown()
+
+
+def test_trainer_error_propagates(ray_8):
+    def train_func():
+        if train.world_rank() == 1:
+            raise ValueError("boom")
+        train.report(ok=True)
+
+    trainer = Trainer(backend="base", num_workers=2)
+    with pytest.raises(Exception, match="boom"):
+        trainer.run(train_func)
+    trainer.shutdown()
+
+
+def test_json_logger_callback(ray_8, tmp_path):
+    def train_func():
+        train.report(loss=1.0)
+        train.report(loss=0.5)
+
+    cb = JsonLoggerCallback(logdir=str(tmp_path))
+    trainer = Trainer(backend="base", num_workers=2)
+    trainer.run(train_func, callbacks=[cb])
+    lines = [json.loads(line) for line in open(cb.log_path)]
+    assert len(lines) == 2
+    assert lines[1][0]["loss"] == 0.5
+    trainer.shutdown()
+
+
+def test_trainer_jax_spmd_step(ray_8):
+    """Each worker jits a step over its mesh slice (dp over workers,
+    device parallelism inside the worker via the virtual mesh)."""
+    def train_func():
+        import jax
+        import jax.numpy as jnp
+        from ray_tpu.util.collective import collective
+
+        @jax.jit
+        def step(w, x, y):
+            def loss_fn(w):
+                return jnp.mean((x @ w - y) ** 2)
+            loss, g = jax.value_and_grad(loss_fn)(w)
+            return loss, g
+
+        rng = np.random.default_rng(train.world_rank())
+        x = rng.normal(size=(16, 4)).astype(np.float32)
+        w_true = np.arange(4, dtype=np.float32)
+        y = x @ w_true
+        w = jnp.zeros(4, jnp.float32)
+        for i in range(40):
+            loss, g = step(w, x, y)
+            g = collective.allreduce(np.asarray(g), group_name="train") / \
+                train.world_size()
+            w = w - 0.1 * jnp.asarray(g)
+        train.report(loss=float(loss))
+        return np.allclose(np.asarray(w), w_true, atol=0.15)
+
+    trainer = Trainer(backend="jax", num_workers=2)
+    assert trainer.run(train_func) == [True, True]
+    trainer.shutdown()
+
+
+def test_to_tune_trainable(ray_8):
+    def train_func(config):
+        train.report(score=config["x"] * 2)
+
+    trainer = Trainer(backend="base", num_workers=2)
+    trainable = trainer.to_tune_trainable(train_func)
+    assert callable(trainable)
+    trainer.shutdown()
