@@ -129,9 +129,16 @@ def is_initialized() -> bool:
 
 
 def _require_connected() -> Worker:
+    """get/put/wait/kill require an initialized cluster (reference:
+    "ray.init has not been called yet" RayConnectionError). No auto-init
+    here: a background thread (e.g. an actor-pool reaper) touching the
+    API after shutdown() must not silently boot a fresh cluster — that
+    leaves connected=True and breaks the next init()."""
     w = global_worker()
     if not w.connected:
-        init()
+        raise RuntimeError(
+            "ray_tpu.init() has not been called yet (or the cluster was "
+            "shut down); call ray_tpu.init() first.")
     return w
 
 

@@ -124,6 +124,12 @@ class ActorClass:
         o = self._options
         w = worker_mod.global_worker()
         if not w.connected:
+            # Main-thread-only auto-init (see RemoteFunction._remote).
+            import threading
+            if threading.current_thread() is not threading.main_thread():
+                raise RuntimeError(
+                    "ray_tpu.init() has not been called yet (or the "
+                    "cluster was shut down).")
             worker_mod.init()
         core = w.core_worker
         function_id = core.function_manager.export(self._cls)

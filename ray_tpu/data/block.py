@@ -28,6 +28,74 @@ import numpy as np
 _PANDAS_LOCK = threading.Lock()
 _pandas_configured = False
 
+_io_lock = threading.Lock()
+
+# Parquet WRITES run in an isolated subprocess (below); parquet reads and
+# csv/json IO run inside ordinary task threads (reads have never shown
+# the writer's crash) under _PANDAS_LOCK where pandas is involved.
+
+_PQ_WRITER_SCRIPT = """\
+import pickle, sys
+path, cols = pickle.load(sys.stdin.buffer)
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+table = pa.table({k: pa.array(np.asarray(v)) for k, v in cols.items()})
+pq.write_table(table, path)
+"""
+
+_PQ_READER_SCRIPT = """\
+import pickle, sys
+path, columns = pickle.load(sys.stdin.buffer)
+import pyarrow.parquet as pq
+table = pq.read_table(path, columns=columns)
+cols = {c: table[c].to_numpy(zero_copy_only=False)
+        for c in table.column_names}
+sys.stdout.buffer.write(pickle.dumps(cols))
+"""
+
+
+def parquet_read(path: str, columns=None) -> Dict[str, np.ndarray]:
+    """Read a parquet file in a fresh isolated subprocess (same
+    rationale as :func:`parquet_write` — pyarrow's parquet open/write
+    paths crash intermittently inside this heavily threaded process)."""
+    import pickle
+    import subprocess
+    import sys
+    proc = subprocess.run(
+        [sys.executable, "-c", _PQ_READER_SCRIPT],
+        input=pickle.dumps((path, columns)), capture_output=True,
+        timeout=300)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"parquet reader subprocess failed (rc={proc.returncode}): "
+            f"{proc.stderr.decode(errors='replace')[-500:]}")
+    return pickle.loads(proc.stdout)
+
+
+def parquet_write(cols: Dict[str, np.ndarray], path: str):
+    """Write a columnar dict to parquet in a fresh isolated subprocess:
+    ParquetWriter construction segfaults intermittently inside this
+    (heavily threaded) process in the pandas 3.0 / pyarrow 25 / jax
+    environment, regardless of which thread or lock discipline is used —
+    process isolation sidesteps it entirely. A short-lived
+    ``python -c`` child (not multiprocessing spawn) avoids re-importing
+    the user's ``__main__`` and surfaces child crashes as errors instead
+    of hanging."""
+    import pickle
+    import subprocess
+    import sys
+    payload = pickle.dumps((path, {k: np.asarray(v)
+                                   for k, v in cols.items()}))
+    proc = subprocess.run(
+        [sys.executable, "-c", _PQ_WRITER_SCRIPT], input=payload,
+        capture_output=True, timeout=300)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"parquet writer subprocess failed (rc={proc.returncode}): "
+            f"{proc.stderr.decode(errors='replace')[-500:]}")
+    return path
+
 
 def _pd():
     global _pandas_configured

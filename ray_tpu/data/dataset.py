@@ -588,18 +588,12 @@ class Dataset:
         def write_one(block: Block, out: str):
             from ray_tpu.data.block import _PANDAS_LOCK
             if fmt == "parquet":
-                # Pure pyarrow: pandas' parquet writer segfaults when
-                # invoked from worker threads (even serialized) in the
-                # pandas 3.0/pyarrow 25 combination; pq.write_table from
-                # threads is safe.
-                import pyarrow as pa
-                import pyarrow.parquet as pq
+                # Isolated-subprocess write (see block.parquet_write).
+                from ray_tpu.data.block import parquet_write
                 acc = BlockAccessor(block)
                 cols = block if is_table(block) else \
                     BlockAccessor.batch_to_block(acc.to_pandas())
-                table = pa.table({k: pa.array(np.asarray(v))
-                                  for k, v in cols.items()})
-                pq.write_table(table, out)
+                parquet_write(cols, out)
                 return out
             df = BlockAccessor(block).to_pandas()
             # Serialize: to_csv/to_json build arrow string arrays, which

@@ -145,12 +145,10 @@ def read_json(paths, **pd_kwargs) -> Dataset:
 
 def read_parquet(paths, columns: Optional[List[str]] = None) -> Dataset:
     def reader(path):
-        # Pure pyarrow: pandas' parquet reader shares the thread-unsafe
-        # writer machinery (see Dataset._write).
-        import pyarrow.parquet as pq
-        table = pq.read_table(path, columns=columns)
-        return {c: table[c].to_numpy(zero_copy_only=False)
-                for c in table.column_names}
+        # Isolated-subprocess read; still parallel across files (one
+        # child per file task). See block.parquet_read.
+        from ray_tpu.data.block import parquet_read
+        return parquet_read(path, columns)
     return _read_files(paths, reader)
 
 

@@ -79,6 +79,14 @@ class RemoteFunction:
     def _remote(self, args, kwargs, options):
         w = worker_mod.global_worker()
         if not w.connected:
+            # Auto-init only from the main thread: a background thread
+            # (actor-pool reaper, monitor timer) touching the API after
+            # shutdown() must not silently boot a fresh cluster.
+            import threading
+            if threading.current_thread() is not threading.main_thread():
+                raise RuntimeError(
+                    "ray_tpu.init() has not been called yet (or the "
+                    "cluster was shut down).")
             worker_mod.init()
         core = w.core_worker
         # Export every call: the FunctionManager dedupes per cluster, and a

@@ -1,0 +1,32 @@
+"""ray_tpu.tune: hyperparameter search.
+
+Parity: reference ``python/ray/tune/`` — ``tune.run`` + TrialRunner
+event loop, search spaces (sample.py), BasicVariantGenerator + Searcher
+ABC, trial schedulers (FIFO/ASHA/median-stopping/PBT), function and
+class trainables, ExperimentAnalysis.
+"""
+
+from ray_tpu.tune.analysis import ExperimentAnalysis  # noqa: F401
+from ray_tpu.tune.sample import (  # noqa: F401
+    choice, grid_search, loguniform, qrandint, quniform, randint,
+    sample_from, uniform)
+from ray_tpu.tune.schedulers import (  # noqa: F401
+    AsyncHyperBandScheduler, FIFOScheduler, MedianStoppingRule,
+    PopulationBasedTraining, TrialScheduler)
+from ray_tpu.tune.suggest import BasicVariantGenerator, Searcher  # noqa: F401
+from ray_tpu.tune.trainable import (  # noqa: F401
+    Trainable, get_trial_id, load_checkpoint, report, save_checkpoint)
+from ray_tpu.tune.trial import Trial  # noqa: F401
+from ray_tpu.tune.trial_runner import TrialRunner, TuneError  # noqa: F401
+from ray_tpu.tune.tune import run  # noqa: F401
+
+ASHAScheduler = AsyncHyperBandScheduler
+
+__all__ = [
+    "ASHAScheduler", "AsyncHyperBandScheduler", "BasicVariantGenerator",
+    "ExperimentAnalysis", "FIFOScheduler", "MedianStoppingRule",
+    "PopulationBasedTraining", "Searcher", "Trainable", "Trial",
+    "TrialRunner", "TrialScheduler", "TuneError", "choice", "get_trial_id",
+    "grid_search", "load_checkpoint", "loguniform", "qrandint", "quniform",
+    "randint", "report", "run", "sample_from", "save_checkpoint", "uniform",
+]

