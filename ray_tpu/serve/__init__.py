@@ -1,0 +1,18 @@
+"""ray_tpu.serve: model serving on the actor runtime.
+
+Parity: reference ``python/ray/serve/`` — detached ``ServeController``
+holding goal state (controller.py:39), ``DeploymentState`` reconciler
+scaling replica actors (deployment_state.py), ``Router`` with
+round-robin + backpressure (router.py:170), ``@serve.deployment`` API
+(api.py:1032), ``@serve.batch`` batching (batching.py), long-poll config
+push (long_poll.py), queue-metric autoscaling (autoscaling_policy.py),
+HTTP proxy (http_proxy.py; stdlib ThreadingHTTPServer here).
+"""
+
+from ray_tpu.serve.api import (  # noqa: F401
+    delete, deployment, get_deployment, list_deployments, shutdown, start)
+from ray_tpu.serve.batching import batch  # noqa: F401
+from ray_tpu.serve.handle import DeploymentHandle  # noqa: F401
+
+__all__ = ["DeploymentHandle", "batch", "delete", "deployment",
+           "get_deployment", "list_deployments", "shutdown", "start"]

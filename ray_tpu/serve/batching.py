@@ -1,0 +1,99 @@
+"""@serve.batch: transparent request batching inside a replica.
+
+Parity: reference ``python/ray/serve/batching.py`` — concurrent calls
+to the decorated method are queued; a flusher invokes the underlying
+function ONCE with the list of requests when ``max_batch_size`` is
+reached or ``batch_wait_timeout_s`` elapses; each caller gets its own
+element of the returned list. Callers are concurrent actor-thread
+requests here (the reference's are asyncio tasks).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Any, Callable, List, Optional
+
+
+class _Pending:
+    __slots__ = ("arg", "event", "result", "error")
+
+    def __init__(self, arg):
+        self.arg = arg
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+
+
+class _BatchQueue:
+    def __init__(self, fn: Callable, max_batch_size: int,
+                 batch_wait_timeout_s: float):
+        self._fn = fn
+        self._max = max_batch_size
+        self._timeout = batch_wait_timeout_s
+        self._lock = threading.Lock()
+        self._queue: List[_Pending] = []
+        self._flush_scheduled = False
+
+    def submit(self, self_obj, arg) -> Any:
+        p = _Pending(arg)
+        flush_now = False
+        with self._lock:
+            self._queue.append(p)
+            if len(self._queue) >= self._max:
+                flush_now = True
+            elif not self._flush_scheduled:
+                self._flush_scheduled = True
+                t = threading.Timer(self._timeout, self._flush, (self_obj,))
+                t.daemon = True
+                t.start()
+        if flush_now:
+            self._flush(self_obj)
+        p.event.wait(timeout=60.0)
+        if p.error is not None:
+            raise p.error
+        return p.result
+
+    def _flush(self, self_obj):
+        with self._lock:
+            batch, self._queue = self._queue, []
+            self._flush_scheduled = False
+        if not batch:
+            return
+        try:
+            args = [p.arg for p in batch]
+            results = self._fn(self_obj, args) if self_obj is not None \
+                else self._fn(args)
+            if len(results) != len(batch):
+                raise ValueError(
+                    f"@serve.batch function returned {len(results)} results "
+                    f"for a batch of {len(batch)}")
+            for p, r in zip(batch, results):
+                p.result = r
+                p.event.set()
+        except BaseException as e:  # noqa: BLE001
+            for p in batch:
+                p.error = e
+                p.event.set()
+
+
+def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 10,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorator: ``@serve.batch`` or ``@serve.batch(max_batch_size=...,
+    batch_wait_timeout_s=...)``."""
+
+    def wrap(fn: Callable):
+        queue = _BatchQueue(fn, max_batch_size, batch_wait_timeout_s)
+
+        @functools.wraps(fn)
+        def wrapper(*args):
+            if len(args) == 2:   # bound method: (self, request)
+                return queue.submit(args[0], args[1])
+            return queue.submit(None, args[0])
+        wrapper._batch_queue = queue
+        return wrapper
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap

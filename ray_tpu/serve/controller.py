@@ -1,0 +1,198 @@
+"""ServeController: the serve control plane actor.
+
+Parity: reference ``python/ray/serve/controller.py`` (:39
+``ServeController``) + ``deployment_state.py`` (:45,602 reconciler) —
+goal state per deployment (replica count, config), reconcile loop
+creating/stopping replica actors, long-poll change notifications
+(long_poll.py), queue-metric autoscaling (autoscaling_policy.py:
+scale to ceil(total_queued / target_num_ongoing_requests_per_replica)
+clamped to [min,max]).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+CONTROLLER_NAME = "SERVE_CONTROLLER_ACTOR"
+
+
+class DeploymentInfo:
+    def __init__(self, name: str, serialized_init, num_replicas: int,
+                 ray_actor_options: Optional[dict] = None,
+                 max_concurrent_queries: int = 100,
+                 autoscaling_config: Optional[dict] = None,
+                 version: int = 0):
+        self.name = name
+        self.serialized_init = serialized_init
+        self.num_replicas = num_replicas
+        self.ray_actor_options = dict(ray_actor_options or {})
+        self.max_concurrent_queries = max_concurrent_queries
+        self.autoscaling_config = autoscaling_config
+        self.version = version
+
+
+class ServeController:
+    def __init__(self):
+        self._deployments: Dict[str, DeploymentInfo] = {}
+        self._replicas: Dict[str, List] = {}   # name -> actor handles
+        self._config_version = 0
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self._shutdown = False
+        self._reconcile_thread = threading.Thread(
+            target=self._reconcile_loop, daemon=True, name="serve-reconcile")
+        self._reconcile_thread.start()
+
+    # ---- API (called from serve.api) ----------------------------------
+    def deploy(self, name: str, serialized_init, num_replicas: int = 1,
+               ray_actor_options: Optional[dict] = None,
+               max_concurrent_queries: int = 100,
+               autoscaling_config: Optional[dict] = None) -> bool:
+        with self._lock:
+            prev = self._deployments.get(name)
+            version = (prev.version + 1) if prev else 0
+            self._deployments[name] = DeploymentInfo(
+                name, serialized_init, num_replicas, ray_actor_options,
+                max_concurrent_queries, autoscaling_config, version)
+            if prev is not None:
+                # Code/config changed: replace existing replicas.
+                self._stop_replicas(name, len(self._replicas.get(name, [])))
+            self._cv.notify_all()
+        self._reconcile_once()
+        return True
+
+    def delete_deployment(self, name: str) -> bool:
+        with self._lock:
+            if name not in self._deployments:
+                return False
+            del self._deployments[name]
+            self._stop_replicas(name, len(self._replicas.get(name, [])))
+            self._replicas.pop(name, None)
+            self._bump()
+        return True
+
+    def get_deployment_info(self, name: str) -> Optional[dict]:
+        with self._lock:
+            info = self._deployments.get(name)
+            if info is None:
+                return None
+            return {"name": info.name, "num_replicas": info.num_replicas,
+                    "version": info.version,
+                    "num_running_replicas":
+                        len(self._replicas.get(name, []))}
+
+    def list_deployments(self) -> List[str]:
+        with self._lock:
+            return list(self._deployments)
+
+    def get_replica_handles(self, name: str) -> List:
+        with self._lock:
+            return list(self._replicas.get(name, []))
+
+    # ---- long poll (reference long_poll.py) ---------------------------
+    def listen_for_change(self, known_version: int, timeout: float = 10.0
+                          ) -> int:
+        """Blocks until the routing config version advances past
+        ``known_version`` (or timeout); returns the current version."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._config_version <= known_version and \
+                    not self._shutdown:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cv.wait(timeout=remaining)
+            return self._config_version
+
+    def _bump(self):
+        self._config_version += 1
+        self._cv.notify_all()
+
+    # ---- reconciliation ------------------------------------------------
+    def _target_replicas(self, info: DeploymentInfo) -> int:
+        cfg = info.autoscaling_config
+        if not cfg:
+            return info.num_replicas
+        import math
+        handles = self._replicas.get(info.name, [])
+        if not handles:
+            return max(1, cfg.get("min_replicas", 1))
+        try:
+            inflight = sum(ray_tpu.get(
+                [h.get_num_inflight.remote() for h in handles]))
+        except Exception:
+            return len(handles)
+        target_per = cfg.get("target_num_ongoing_requests_per_replica", 1)
+        want = math.ceil(inflight / max(target_per, 1e-9)) if inflight \
+            else cfg.get("min_replicas", 1)
+        return max(cfg.get("min_replicas", 1),
+                   min(cfg.get("max_replicas", 10), want))
+
+    def _reconcile_once(self):
+        from ray_tpu.serve.replica import ReplicaActor
+        with self._lock:
+            if self._shutdown:
+                return
+            work = []
+            for name, info in self._deployments.items():
+                have = self._replicas.setdefault(name, [])
+                want = self._target_replicas(info)
+                if len(have) < want:
+                    work.append((name, info, want - len(have)))
+                elif len(have) > want:
+                    self._stop_replicas(name, len(have) - want)
+                    self._bump()
+            deployments = dict(self._deployments)
+        changed = False
+        for name, info, count in work:
+            opts = dict(info.ray_actor_options)
+            opts.setdefault("num_cpus", 1)
+            opts["max_concurrency"] = max(2, info.max_concurrent_queries)
+            cls = ray_tpu.remote(**opts)(ReplicaActor)
+            new = [cls.remote(info.serialized_init) for _ in range(count)]
+            with self._lock:
+                if name in self._deployments and \
+                        self._deployments[name].version == info.version:
+                    self._replicas[name].extend(new)
+                    changed = True
+                else:
+                    for h in new:
+                        try:
+                            ray_tpu.kill(h)
+                        except Exception:
+                            pass
+        if changed:
+            with self._lock:
+                self._bump()
+
+    def _stop_replicas(self, name: str, count: int):
+        # Must hold lock.
+        handles = self._replicas.get(name, [])
+        victims, self._replicas[name] = handles[:count], handles[count:]
+        for h in victims:
+            try:
+                ray_tpu.kill(h)
+            except Exception:
+                pass
+
+    def _reconcile_loop(self):
+        while not self._shutdown:
+            try:
+                self._reconcile_once()
+            except Exception:
+                pass
+            time.sleep(0.25)
+
+    def shutdown(self) -> bool:
+        with self._lock:
+            self._shutdown = True
+            for name in list(self._deployments):
+                self._stop_replicas(name,
+                                    len(self._replicas.get(name, [])))
+            self._deployments.clear()
+            self._cv.notify_all()
+        return True

@@ -1,0 +1,41 @@
+"""DeploymentHandle: Python-side entry to a deployment.
+
+Parity: reference ``python/ray/serve/handle.py`` — ``RayServeHandle``:
+``handle.remote(*args)`` routes through the Router and returns an
+ObjectRef; ``handle.method_name.remote(...)`` targets a method
+(``.options(method_name=...)`` in the reference).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class _MethodCaller:
+    def __init__(self, handle: "DeploymentHandle", method_name: str):
+        self._handle = handle
+        self._method = method_name
+
+    def remote(self, *args, **kwargs):
+        return self._handle._router.assign_request(self._method, args,
+                                                   kwargs)
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, router):
+        self.deployment_name = deployment_name
+        self._router = router
+
+    def remote(self, *args, **kwargs):
+        return self._router.assign_request("__call__", args, kwargs)
+
+    def options(self, method_name: str = "__call__") -> _MethodCaller:
+        return _MethodCaller(self, method_name)
+
+    def __getattr__(self, name: str) -> _MethodCaller:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _MethodCaller(self, name)
+
+    def __repr__(self):
+        return f"DeploymentHandle({self.deployment_name!r})"

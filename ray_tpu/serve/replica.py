@@ -1,0 +1,62 @@
+"""Replica actor: wraps the user's deployment callable.
+
+Parity: reference ``python/ray/serve/replica.py`` — ``RayServeReplica``
+wraps the user class/function, counts in-flight requests (the router's
+backpressure signal), runs reconfigure, reports health.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+
+class ReplicaActor:
+    def __init__(self, serialized_init):
+        deployment_def, init_args, init_kwargs, user_config = serialized_init
+        if isinstance(deployment_def, type):
+            self._callable = deployment_def(*init_args, **(init_kwargs or {}))
+        else:
+            self._callable = deployment_def
+        self._is_function = not isinstance(deployment_def, type)
+        self._inflight = 0
+        self._lock = threading.Lock()
+        self.num_requests = 0
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    def reconfigure(self, user_config):
+        fn = getattr(self._callable, "reconfigure", None)
+        if fn is not None:
+            fn(user_config)
+        return True
+
+    def handle_request(self, method_name: str, args, kwargs) -> Any:
+        with self._lock:
+            self._inflight += 1
+            self.num_requests += 1
+        try:
+            if self._is_function:
+                target = self._callable
+            elif method_name in ("__call__", "", None):
+                target = self._callable
+            else:
+                target = getattr(self._callable, method_name)
+            return target(*args, **(kwargs or {}))
+        finally:
+            with self._lock:
+                self._inflight -= 1
+
+    def get_num_inflight(self) -> int:
+        return self._inflight
+
+    def get_metrics(self) -> Dict[str, float]:
+        return {"num_requests": self.num_requests,
+                "inflight": self._inflight}
+
+    def check_health(self) -> bool:
+        fn = getattr(self._callable, "check_health", None)
+        if fn is not None:
+            fn()
+        return True

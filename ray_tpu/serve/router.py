@@ -1,0 +1,102 @@
+"""Router: picks a replica for each request.
+
+Parity: reference ``python/ray/serve/router.py:170`` —
+``Router.assign_request``: round-robin over the replica set with
+backpressure (skip replicas at ``max_concurrent_queries``; block when
+all are saturated), replica set refreshed via the controller long-poll
+(``long_poll.py`` ``LongPollClient``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional
+
+import ray_tpu
+
+
+class Router:
+    def __init__(self, controller, deployment_name: str,
+                 max_concurrent_queries: int = 100):
+        self._controller = controller
+        self._name = deployment_name
+        self._max_q = max_concurrent_queries
+        self._replicas: List = []
+        self._inflight: Dict[int, int] = {}  # replica idx -> inflight
+        self._rr = itertools.count()
+        self._lock = threading.Condition()
+        self._version = -1
+        self._refresh(block=True)
+        self._poll_thread = threading.Thread(
+            target=self._long_poll_loop, daemon=True,
+            name=f"serve-router-{deployment_name}")
+        self._poll_thread.start()
+
+    # ---- replica set maintenance ---------------------------------------
+    def _refresh(self, block: bool = False):
+        deadline = time.monotonic() + 10.0
+        while True:
+            handles = ray_tpu.get(
+                self._controller.get_replica_handles.remote(self._name))
+            if handles or not block:
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no replicas for deployment {self._name!r}")
+            time.sleep(0.05)
+        with self._lock:
+            self._replicas = handles
+            self._inflight = {i: 0 for i in range(len(handles))}
+            self._lock.notify_all()
+
+    def _long_poll_loop(self):
+        while True:
+            try:
+                version = ray_tpu.get(
+                    self._controller.listen_for_change.remote(
+                        self._version, 5.0))
+                if version != self._version:
+                    self._version = version
+                    self._refresh()
+            except Exception:
+                return  # controller gone — router is dead
+
+    # ---- request path ---------------------------------------------------
+    def assign_request(self, method_name: str, args, kwargs):
+        """Round-robin with backpressure; returns an ObjectRef."""
+        deadline = time.monotonic() + 30.0
+        while True:
+            with self._lock:
+                n = len(self._replicas)
+                if n:
+                    for _ in range(n):
+                        i = next(self._rr) % n
+                        if self._inflight.get(i, 0) < self._max_q:
+                            self._inflight[i] = \
+                                self._inflight.get(i, 0) + 1
+                            replica = self._replicas[i]
+                            break
+                    else:
+                        replica = None
+                else:
+                    replica = None
+                if replica is None:
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"deployment {self._name!r}: all replicas "
+                            "saturated for 30s")
+                    self._lock.wait(timeout=0.1)
+                    continue
+            ref = replica.handle_request.remote(method_name, args, kwargs)
+            self._track(ref, i)
+            return ref
+
+    def _track(self, ref, idx: int):
+        def done(_fut):
+            with self._lock:
+                if idx in self._inflight:
+                    self._inflight[idx] -= 1
+                self._lock.notify_all()
+        ref.future().add_done_callback(done)
