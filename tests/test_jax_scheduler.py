@@ -66,6 +66,41 @@ class TestWaterfillKernel:
         assert alloc.sum() == 0
 
 
+class TestTickStream:
+    def test_stream_matches_closed_loop_oracle(self):
+        rng = np.random.default_rng(3)
+        solver = BatchSolver(mode="waterfill")
+        avail, total, demand, counts, an, ac = random_problem(rng)
+        solver.prepare_device(avail, total, demand, accel_node=an,
+                              accel_class=ac, spread_threshold=0.5)
+        K = 4
+        arrivals = np.stack([np.roll(counts, k) for k in range(K)])
+        out = solver.solve_stream(arrivals, nnz_max=512)
+        assert out["ok"].all()
+        # Host-side replay of the closed loop: queue_k = pending + arrivals,
+        # pending' = queue_k - placed.
+        pending = np.zeros_like(counts)
+        for k in range(K):
+            queue_k = pending + arrivals[k]
+            alloc = solver.expand_sparse(out["idx"][k], out["vals"][k])
+            want = waterfill_oracle(avail, total, demand, queue_k, an, ac,
+                                    spread_threshold=0.5)
+            np.testing.assert_array_equal(alloc, want, err_msg=f"tick {k}")
+            assert int(out["nnz"][k]) == int((want > 0).sum())
+            assert int(out["placed"][k]) == int(want.sum())
+            pending = queue_k - want.sum(axis=1)
+
+    def test_stream_overflow_flagged(self):
+        # nnz_max smaller than the true nonzero count must trip ok=False.
+        solver = BatchSolver(mode="waterfill")
+        avail = total = np.full((16, 2), 100.0, dtype=np.float32)
+        demand = np.ones((8, 2), dtype=np.float32)
+        solver.prepare_device(avail, total, demand)
+        stream = np.full((1, 8), 16, dtype=np.int64)  # fills many cells
+        out = solver.solve_stream(stream, nnz_max=4)
+        assert not out["ok"].all()
+
+
 class TestSinkhornKernel:
     def test_capacity_respected_and_spreads(self):
         solver = BatchSolver(mode="sinkhorn")
