@@ -5,14 +5,19 @@ holding goal state (controller.py:39), ``DeploymentState`` reconciler
 scaling replica actors (deployment_state.py), ``Router`` with
 round-robin + backpressure (router.py:170), ``@serve.deployment`` API
 (api.py:1032), ``@serve.batch`` batching (batching.py), long-poll config
-push (long_poll.py), queue-metric autoscaling (autoscaling_policy.py),
-HTTP proxy (http_proxy.py; stdlib ThreadingHTTPServer here).
+push (reference long_poll.py; here ``ServeController.listen_for_change``),
+queue-metric autoscaling (autoscaling_policy.py), HTTP proxy
+(reference http_proxy.py; stdlib ThreadingHTTPServer in our
+``serve/http_proxy.py``).
 """
 
 from ray_tpu.serve.api import (  # noqa: F401
-    delete, deployment, get_deployment, list_deployments, shutdown, start)
+    Deployment, delete, deployment, get_deployment, list_deployments, run,
+    shutdown, start)
 from ray_tpu.serve.batching import batch  # noqa: F401
 from ray_tpu.serve.handle import DeploymentHandle  # noqa: F401
+from ray_tpu.serve.http_proxy import HTTPRequest  # noqa: F401
 
-__all__ = ["DeploymentHandle", "batch", "delete", "deployment",
-           "get_deployment", "list_deployments", "shutdown", "start"]
+__all__ = ["Deployment", "DeploymentHandle", "HTTPRequest", "batch",
+           "delete", "deployment", "get_deployment", "list_deployments",
+           "run", "shutdown", "start"]

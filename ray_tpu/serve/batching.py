@@ -78,20 +78,48 @@ class _BatchQueue:
                 p.event.set()
 
 
+# Queues are created lazily in the replica process (a queue holds
+# threading primitives, which must not be pickled with the deployment
+# definition).  Bound methods store their queue in the owning instance's
+# __dict__ so it dies with the replica; bare functions use a module-level
+# registry bounded by the number of decorated functions.  The wrapper
+# reaches them only through _get_queue — an importable module-level
+# function that cloudpickle serializes by reference, keeping the
+# lock/registry out of the pickle.
+_FN_QUEUES: dict = {}
+_QUEUES_LOCK = threading.Lock()
+_INSTANCE_ATTR = "_serve_batch_queues"
+
+
+def _get_queue(self_obj, fn, max_batch_size, batch_wait_timeout_s):
+    with _QUEUES_LOCK:
+        if self_obj is not None:
+            registry = self_obj.__dict__.setdefault(_INSTANCE_ATTR, {})
+            key = fn.__qualname__
+        else:
+            registry, key = _FN_QUEUES, fn.__qualname__
+        queue = registry.get(key)
+        if queue is None:
+            queue = _BatchQueue(fn, max_batch_size, batch_wait_timeout_s)
+            registry[key] = queue
+        return queue
+
+
 def batch(_fn: Optional[Callable] = None, *, max_batch_size: int = 10,
           batch_wait_timeout_s: float = 0.01):
     """Decorator: ``@serve.batch`` or ``@serve.batch(max_batch_size=...,
     batch_wait_timeout_s=...)``."""
 
     def wrap(fn: Callable):
-        queue = _BatchQueue(fn, max_batch_size, batch_wait_timeout_s)
-
         @functools.wraps(fn)
         def wrapper(*args):
             if len(args) == 2:   # bound method: (self, request)
-                return queue.submit(args[0], args[1])
-            return queue.submit(None, args[0])
-        wrapper._batch_queue = queue
+                self_obj, arg = args
+            else:
+                self_obj, arg = None, args[0]
+            queue = _get_queue(self_obj, fn, max_batch_size,
+                               batch_wait_timeout_s)
+            return queue.submit(self_obj, arg)
         return wrapper
 
     if _fn is not None:

@@ -25,6 +25,7 @@ class DeploymentInfo:
                  ray_actor_options: Optional[dict] = None,
                  max_concurrent_queries: int = 100,
                  autoscaling_config: Optional[dict] = None,
+                 route_prefix: Optional[str] = None,
                  version: int = 0):
         self.name = name
         self.serialized_init = serialized_init
@@ -32,6 +33,7 @@ class DeploymentInfo:
         self.ray_actor_options = dict(ray_actor_options or {})
         self.max_concurrent_queries = max_concurrent_queries
         self.autoscaling_config = autoscaling_config
+        self.route_prefix = route_prefix
         self.version = version
 
 
@@ -51,13 +53,21 @@ class ServeController:
     def deploy(self, name: str, serialized_init, num_replicas: int = 1,
                ray_actor_options: Optional[dict] = None,
                max_concurrent_queries: int = 100,
-               autoscaling_config: Optional[dict] = None) -> bool:
+               autoscaling_config: Optional[dict] = None,
+               route_prefix: Optional[str] = None) -> bool:
         with self._lock:
+            if route_prefix is not None:
+                for other, info in self._deployments.items():
+                    if other != name and info.route_prefix == route_prefix:
+                        raise ValueError(
+                            f"route_prefix {route_prefix!r} is already "
+                            f"used by deployment {other!r}")
             prev = self._deployments.get(name)
             version = (prev.version + 1) if prev else 0
             self._deployments[name] = DeploymentInfo(
                 name, serialized_init, num_replicas, ray_actor_options,
-                max_concurrent_queries, autoscaling_config, version)
+                max_concurrent_queries, autoscaling_config, route_prefix,
+                version)
             if prev is not None:
                 # Code/config changed: replace existing replicas.
                 self._stop_replicas(name, len(self._replicas.get(name, [])))
@@ -88,6 +98,28 @@ class ServeController:
     def list_deployments(self) -> List[str]:
         with self._lock:
             return list(self._deployments)
+
+    def get_deployment_spec(self, name: str):
+        """(serialized_init, config dict) for rebuilding a Deployment
+        (serve.get_deployment parity)."""
+        with self._lock:
+            info = self._deployments.get(name)
+            if info is None:
+                return None
+            return (info.serialized_init, {
+                "num_replicas": info.num_replicas,
+                "ray_actor_options": info.ray_actor_options,
+                "max_concurrent_queries": info.max_concurrent_queries,
+                "autoscaling_config": info.autoscaling_config,
+                "route_prefix": info.route_prefix,
+            })
+
+    def get_route_table(self) -> Dict[str, str]:
+        """route_prefix -> deployment name (http_proxy route updates)."""
+        with self._lock:
+            return {info.route_prefix: name
+                    for name, info in self._deployments.items()
+                    if info.route_prefix}
 
     def get_replica_handles(self, name: str) -> List:
         with self._lock:
@@ -151,7 +183,10 @@ class ServeController:
         for name, info, count in work:
             opts = dict(info.ray_actor_options)
             opts.setdefault("num_cpus", 1)
-            opts["max_concurrency"] = max(2, info.max_concurrent_queries)
+            # +2 headroom so control calls (get_num_inflight, health) never
+            # queue behind saturated request slots — the router, not actor
+            # concurrency, enforces max_concurrent_queries.
+            opts["max_concurrency"] = max(2, info.max_concurrent_queries) + 2
             cls = ray_tpu.remote(**opts)(ReplicaActor)
             new = [cls.remote(info.serialized_init) for _ in range(count)]
             with self._lock:

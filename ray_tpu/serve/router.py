@@ -28,6 +28,7 @@ class Router:
         self._rr = itertools.count()
         self._lock = threading.Condition()
         self._version = -1
+        self._stopped = threading.Event()
         self._refresh(block=True)
         self._poll_thread = threading.Thread(
             target=self._long_poll_loop, daemon=True,
@@ -51,12 +52,18 @@ class Router:
             self._inflight = {i: 0 for i in range(len(handles))}
             self._lock.notify_all()
 
+    def stop(self):
+        """Stop the long-poll thread (router no longer usable)."""
+        self._stopped.set()
+
     def _long_poll_loop(self):
-        while True:
+        while not self._stopped.is_set():
             try:
                 version = ray_tpu.get(
                     self._controller.listen_for_change.remote(
                         self._version, 5.0))
+                if self._stopped.is_set():
+                    return
                 if version != self._version:
                     self._version = version
                     self._refresh()

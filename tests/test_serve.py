@@ -1,0 +1,234 @@
+"""Serve end-to-end: deploy, handle calls, real HTTP requests, batching,
+autoscaling, redeploy/delete.
+
+Reference test model: python/ray/serve/tests/test_standalone.py,
+test_deploy.py, test_batching.py, test_autoscaling_policy.py.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_instance():
+    ray_tpu.init(num_cpus=12)
+    serve.start(http_options={"host": "127.0.0.1", "port": 0})
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _http(port, path, data=None, method=None):
+    url = f"http://127.0.0.1:{port}{path}"
+    req = urllib.request.Request(
+        url, data=data, method=method or ("POST" if data else "GET"),
+        headers={"Content-Type": "application/json"} if data else {})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.status, resp.read()
+
+
+def _proxy_port():
+    proxy = ray_tpu.get_actor("SERVE_PROXY_ACTOR")
+    return ray_tpu.get(proxy.ready.remote())
+
+
+class TestDeployAndHandle:
+    def test_function_deployment_handle(self, serve_instance):
+        @serve.deployment
+        def double(req):
+            return req * 2
+
+        double.deploy()
+        h = double.get_handle()
+        assert ray_tpu.get(h.remote(21)) == 42
+        assert "double" in serve.list_deployments()
+
+    def test_class_deployment_methods(self, serve_instance):
+        @serve.deployment(num_replicas=2)
+        class Counter:
+            def __init__(self, start):
+                self.x = start
+
+            def __call__(self, req):
+                return ("call", req)
+
+            def add(self, n):
+                return self.x + n
+
+        Counter.deploy(10)
+        h = Counter.get_handle()
+        assert ray_tpu.get(h.remote("hi")) == ("call", "hi")
+        assert ray_tpu.get(h.add.remote(5)) == 15
+        info = ray_tpu.get(serve.api._get_controller()
+                           .get_deployment_info.remote("Counter"))
+        assert info["num_running_replicas"] == 2
+
+    def test_redeploy_new_version(self, serve_instance):
+        @serve.deployment
+        def v(req):
+            return "v1"
+
+        v.deploy()
+        h = v.get_handle()
+        assert ray_tpu.get(h.remote(None)) == "v1"
+
+        @serve.deployment(name="v")
+        def v2(req):
+            return "v2"
+
+        v2.deploy()
+        time.sleep(0.3)  # long-poll pushes the new replica set
+        h2 = serve.get_deployment("v").get_handle()
+        assert ray_tpu.get(h2.remote(None)) == "v2"
+
+    def test_route_prefix_collision_rejected(self, serve_instance):
+        @serve.deployment(name="a", route_prefix="/shared")
+        def a(req):
+            return 1
+
+        @serve.deployment(name="b", route_prefix="/shared")
+        def b(req):
+            return 2
+
+        a.deploy()
+        with pytest.raises(ValueError, match="route_prefix"):
+            b.deploy()
+
+    def test_delete_deployment(self, serve_instance):
+        @serve.deployment
+        def gone(req):
+            return 1
+
+        gone.deploy()
+        serve.delete("gone")
+        assert "gone" not in serve.list_deployments()
+
+
+class TestHTTP:
+    def test_http_json_roundtrip(self, serve_instance):
+        @serve.deployment
+        def echo(request):
+            payload = request.json()
+            return {"got": payload, "path": request.path,
+                    "method": request.method}
+
+        echo.deploy()
+        port = _proxy_port()
+        status, body = _http(port, "/echo",
+                             data=json.dumps({"x": 1}).encode())
+        assert status == 200
+        out = json.loads(body)
+        assert out == {"got": {"x": 1}, "path": "/", "method": "POST"}
+
+    def test_http_query_params_and_subpath(self, serve_instance):
+        @serve.deployment(route_prefix="/api")
+        def api(request):
+            return {"q": request.query_params, "path": request.path}
+
+        api.deploy()
+        port = _proxy_port()
+        status, body = _http(port, "/api/users?id=7")
+        assert status == 200
+        assert json.loads(body) == {"q": {"id": "7"}, "path": "/users"}
+
+    def test_http_404(self, serve_instance):
+        port = _proxy_port()
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _http(port, "/nothing-here")
+        assert e.value.code == 404
+
+    def test_http_500_on_user_error(self, serve_instance):
+        @serve.deployment
+        def boom(request):
+            raise ValueError("kapow")
+
+        boom.deploy()
+        port = _proxy_port()
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _http(port, "/boom")
+        assert e.value.code == 500
+
+
+class TestBatching:
+    def test_batch_collects_concurrent_requests(self, serve_instance):
+        @serve.deployment(max_concurrent_queries=16)
+        class Batched:
+            def __init__(self):
+                self.sizes = []
+
+            @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.1)
+            def __call__(self, requests):
+                self.sizes.append(len(requests))
+                return [r * 10 for r in requests]
+
+            def get_sizes(self):
+                return self.sizes
+
+        Batched.deploy()
+        h = Batched.get_handle()
+        refs = [h.remote(i) for i in range(8)]
+        assert sorted(ray_tpu.get(refs)) == [i * 10 for i in range(8)]
+        sizes = ray_tpu.get(h.get_sizes.remote())
+        assert max(sizes) > 1  # batching actually happened
+
+
+class TestAutoscaling:
+    def test_scale_up_then_down(self, serve_instance):
+        @serve.deployment(
+            max_concurrent_queries=2,
+            autoscaling_config={
+                "min_replicas": 1, "max_replicas": 3,
+                "target_num_ongoing_requests_per_replica": 1,
+            })
+        def slow(request):
+            time.sleep(0.4)
+            return "ok"
+
+        slow.deploy()
+        controller = serve.api._get_controller()
+
+        h = slow.get_handle()
+        stop = threading.Event()
+
+        def load():
+            while not stop.is_set():
+                try:
+                    ray_tpu.get(h.remote(None))
+                except Exception:
+                    return
+
+        threads = [threading.Thread(target=load, daemon=True)
+                   for _ in range(6)]
+        for t in threads:
+            t.start()
+        try:
+            deadline = time.monotonic() + 15
+            peak = 1
+            while time.monotonic() < deadline:
+                info = ray_tpu.get(
+                    controller.get_deployment_info.remote("slow"))
+                peak = max(peak, info["num_running_replicas"])
+                if peak >= 2:
+                    break
+                time.sleep(0.1)
+            assert peak >= 2, "never scaled up under load"
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=5)
+        # Load gone: controller should shrink back to min_replicas.
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            info = ray_tpu.get(
+                controller.get_deployment_info.remote("slow"))
+            if info["num_running_replicas"] == 1:
+                break
+            time.sleep(0.2)
+        assert info["num_running_replicas"] == 1, "never scaled down"
