@@ -35,12 +35,31 @@ class TaskPoolStrategy:
         return [p[0] for p in pairs], [p[1] for p in pairs]
 
 
+def _accepts_state(fn: Callable) -> bool:
+    """True if fn can take (block, state) — Dataset transforms pass plain
+    1-arg block fns, which must keep working when init_fn is set."""
+    import inspect
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    positional = [
+        p for p in sig.parameters.values()
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
+    has_varargs = any(p.kind == p.VAR_POSITIONAL
+                      for p in sig.parameters.values())
+    return len(positional) >= 2 or has_varargs
+
+
 class _PoolWorker:
     def __init__(self, init_fn: Optional[Callable] = None):
         self.state = init_fn() if init_fn else None
 
     def transform(self, fn: BlockTransform, block: Block):
-        out = fn(block) if self.state is None else fn(block, self.state)
+        if self.state is not None and _accepts_state(fn):
+            out = fn(block, self.state)
+        else:
+            out = fn(block)
         return out, BlockAccessor(out).get_metadata()
 
 

@@ -58,6 +58,12 @@ class JaxConfig(BackendConfig):
 
         def setup(rank):
             collective.init_collective_group(n, rank, group_name=name)
+            base = name.split("~", 1)[0]
+            if base != name:
+                # User train functions address the group by the stable
+                # documented name; resolve it per worker to this run's
+                # scoped group so concurrent trainers don't collide.
+                collective.set_group_alias(base, name)
             return True
         import ray_tpu
         ray_tpu.get([
@@ -86,6 +92,7 @@ class TorchConfig(BackendConfig):
 
     backend: str = "gloo"
     init_method: str = "tcp"
+    group_name: str = "train"
 
     def backend_name(self) -> str:
         return "torch"
@@ -96,9 +103,13 @@ class TorchConfig(BackendConfig):
         # through the host collective plane like the jax backend.
         from ray_tpu.util.collective import collective
         n = len(worker_group)
+        name = self.group_name
 
         def setup(rank):
-            collective.init_collective_group(n, rank, group_name="train")
+            collective.init_collective_group(n, rank, group_name=name)
+            base = name.split("~", 1)[0]
+            if base != name:
+                collective.set_group_alias(base, name)
             return True
         import ray_tpu
         ray_tpu.get([
@@ -106,15 +117,16 @@ class TorchConfig(BackendConfig):
             for i in range(n)])
 
 
-def _start_session_on_worker(fn: Callable, config: Dict, rank: int,
-                             world_size: int, checkpoint: Optional[Dict]):
+def _start_session_on_worker(run_id: str, fn: Callable, config: Dict,
+                             rank: int, world_size: int,
+                             checkpoint: Optional[Dict]):
     """Runs inside the worker actor: create + start the session."""
     import functools
     fn_bound = functools.partial(fn, dict(config)) if _fn_takes_config(fn) \
         else fn
     session = Session(fn_bound, world_rank=rank, local_rank=rank,
                       world_size=world_size, checkpoint=checkpoint)
-    _WORKER_SESSIONS[rank] = session
+    _WORKER_SESSIONS[(run_id, rank)] = session
     session.start()
     return True
 
@@ -128,17 +140,24 @@ def _fn_takes_config(fn: Callable) -> bool:
     return len(sig.parameters) >= 1
 
 
-# In-process actors share module globals; key by rank (see verify skill
-# gotcha: module-level state is shared across "workers").
-_WORKER_SESSIONS: Dict[int, Session] = {}
+# In-process actors share module globals; key by (run_id, rank) so two
+# concurrent BackendExecutors (e.g. parallel tune trials over
+# to_tune_trainable) never cross-wire each other's sessions (see verify
+# skill gotcha: module-level state is shared across "workers").
+_WORKER_SESSIONS: Dict[Any, Session] = {}
 
 
-def _get_next_on_worker(rank: int, timeout: float = 300.0) -> TrainingResult:
-    session = _WORKER_SESSIONS.get(rank)
+def _get_next_on_worker(run_id: str, rank: int,
+                        timeout: float = 300.0) -> TrainingResult:
+    session = _WORKER_SESSIONS.get((run_id, rank))
     if session is None:
         return TrainingResult("error",
                               RuntimeError(f"no session for rank {rank}"))
     return session.get_next(timeout=timeout)
+
+
+def _drop_session_on_worker(run_id: str, rank: int) -> bool:
+    return _WORKER_SESSIONS.pop((run_id, rank), None) is not None
 
 
 class TrainBackendError(RuntimeError):
@@ -154,8 +173,10 @@ class BackendExecutor:
                  num_cpus_per_worker: float = 1,
                  num_tpus_per_worker: float = 0,
                  additional_resources_per_worker: Optional[Dict] = None):
+        import uuid
         self._config = backend_config
         self._num_workers = num_workers
+        self._run_id = uuid.uuid4().hex[:12]
         self._worker_args = dict(
             num_workers=num_workers,
             num_cpus_per_worker=num_cpus_per_worker,
@@ -164,16 +185,29 @@ class BackendExecutor:
         self.worker_group: Optional[WorkerGroup] = None
 
     def start(self):
+        import copy
+        import uuid
+        # Fresh per run: executors are pickled into tune trainables, so
+        # ids minted at __init__ would be shared by every unpickled copy.
+        self._run_id = uuid.uuid4().hex[:12]
         self.worker_group = WorkerGroup(**self._worker_args)
-        self._config.on_start(self.worker_group)
+        # Run a copy of the config with a run-scoped collective group so
+        # concurrent executors sharing one config object never collide;
+        # workers alias the user-facing base name to the scoped one.
+        cfg = copy.copy(self._config)
+        base = getattr(cfg, "group_name", None)
+        if base:
+            cfg.group_name = f"{base}~{self._run_id}"
+        self._started_config = cfg
+        cfg.on_start(self.worker_group)
 
     def start_training(self, train_func: Callable, config: Optional[Dict],
                        checkpoint: Optional[Dict] = None):
         import ray_tpu
         refs = [
             self.worker_group.execute_single_async(
-                rank, _start_session_on_worker, train_func, config or {},
-                rank, self._num_workers, checkpoint)
+                rank, _start_session_on_worker, self._run_id, train_func,
+                config or {}, rank, self._num_workers, checkpoint)
             for rank in range(self._num_workers)]
         ray_tpu.get(refs)
 
@@ -186,14 +220,23 @@ class BackendExecutor:
         get_next_results pairs results by type). Raises on the first
         worker error. Once every worker is "done" the same final results
         are returned on every poll."""
+        import time
         import ray_tpu
         results: List[TrainingResult] = []
         for r in range(self._num_workers):
+            deadline = time.monotonic() + 600.0
             while True:
                 res = ray_tpu.get(self.worker_group.execute_single_async(
-                    r, _get_next_on_worker, r))
+                    r, _get_next_on_worker, self._run_id, r))
                 if res.type == "error":
                     raise TrainBackendError(str(res.data)) from res.data
+                if res.type == "timeout":
+                    # A hung worker must surface, not spin silently.
+                    if time.monotonic() > deadline:
+                        raise TrainBackendError(
+                            f"worker rank {r} produced no result within "
+                            "600s (hung train function?)")
+                    continue
                 if res.type == "checkpoint":
                     if checkpoint_handler is not None:
                         checkpoint_handler(r, res.data)
@@ -204,6 +247,14 @@ class BackendExecutor:
 
     def shutdown(self):
         if self.worker_group is not None:
-            self._config.on_shutdown(self.worker_group)
+            import ray_tpu
+            self._started_config.on_shutdown(self.worker_group)
+            try:
+                ray_tpu.get([
+                    self.worker_group.execute_single_async(
+                        r, _drop_session_on_worker, self._run_id, r)
+                    for r in range(self._num_workers)])
+            except Exception:
+                pass
             self.worker_group.shutdown()
             self.worker_group = None

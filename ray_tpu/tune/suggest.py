@@ -118,12 +118,14 @@ class SearcherVariantGenerator:
         return self._remaining + self._count
 
     def next_variant(self):
+        """(tag, config, trial_id) — the trial_id is the one suggest()
+        saw, so the Trial must carry it (TrialRunner passes it through)."""
         if self._remaining <= 0:
             return None
-        trial_id = f"suggested_{self._count}"
+        trial_id = f"suggested_{self._count:05d}"
         cfg = self._searcher.suggest(trial_id)
         if cfg is None:
             return None
         self._remaining -= 1
         self._count += 1
-        return f"search_{self._count}", cfg
+        return f"search_{self._count}", cfg, trial_id

@@ -22,8 +22,12 @@ class Trial:
 
     def __init__(self, config: Dict[str, Any],
                  resources: Optional[Dict[str, float]] = None,
-                 experiment_tag: str = ""):
-        self.trial_id = f"trial_{next(_trial_ids):05d}"
+                 experiment_tag: str = "",
+                 trial_id: Optional[str] = None):
+        # A searcher-proposed trial keeps the id it was suggested under so
+        # on_trial_result/on_trial_complete reach the searcher with an id
+        # it knows (reference SearchGenerator threads one trial_id).
+        self.trial_id = trial_id or f"trial_{next(_trial_ids):05d}"
         self.config = dict(config)
         self.resources = dict(resources or {"cpu": 1})
         self.experiment_tag = experiment_tag

@@ -52,9 +52,9 @@ class TrialRunner:
             v = variant_source.next_variant()
             if v is None:
                 break
-            tag, cfg = v
+            tag, cfg, trial_id = v if len(v) == 3 else (*v, None)
             trial = Trial(cfg, resources=self._resources,
-                          experiment_tag=tag)
+                          experiment_tag=tag, trial_id=trial_id)
             self.trials.append(trial)
             self._scheduler.on_trial_add(trial)
         if max_concurrent_trials is None:

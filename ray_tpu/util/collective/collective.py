@@ -108,6 +108,22 @@ class _GroupState:
 # ranks (reference has one table per OS process, collective.py:70).
 _groups_lock = threading.Lock()
 _groups: Dict[tuple, _GroupState] = {}
+# Worker-local name aliases: lets library code (e.g. train's
+# BackendExecutor) hand user functions a stable default name like
+# "train" while the real group is scoped per run.
+_aliases: Dict[tuple, str] = {}
+
+
+def set_group_alias(alias: str, group_name: str) -> None:
+    """In this worker, collective ops called with ``alias`` resolve to
+    ``group_name``."""
+    with _groups_lock:
+        _aliases[_ctx_key(alias)] = group_name
+
+
+def _resolve_name(group_name: str) -> str:
+    with _groups_lock:
+        return _aliases.get(_ctx_key(group_name), group_name)
 
 
 def _ctx_key(group_name: str) -> tuple:
@@ -173,8 +189,13 @@ def create_collective_group(actors, world_size: int, ranks: List[int],
 
 
 def destroy_collective_group(group_name: str = "default") -> None:
+    actual = _resolve_name(group_name)
     with _groups_lock:
-        state = _groups.pop(_ctx_key(group_name), None)
+        state = _groups.pop(_ctx_key(actual), None)
+        owner = _ctx_key("")[0]
+        for k in [k for k, v in _aliases.items()
+                  if v == actual and k[0] == owner]:
+            _aliases.pop(k, None)
     if state is not None and state.rank == 0:
         try:
             ray_tpu.kill(state.rendezvous)
@@ -183,8 +204,9 @@ def destroy_collective_group(group_name: str = "default") -> None:
 
 
 def is_group_initialized(group_name: str = "default") -> bool:
+    name = _resolve_name(group_name)
     with _groups_lock:
-        return _ctx_key(group_name) in _groups
+        return _ctx_key(name) in _groups
 
 
 def get_rank(group_name: str = "default") -> int:
@@ -198,6 +220,7 @@ def get_collective_group_size(group_name: str = "default") -> int:
 
 
 def _group(group_name: str) -> _GroupState:
+    group_name = _resolve_name(group_name)
     with _groups_lock:
         state = _groups.get(_ctx_key(group_name))
     if state is None:

@@ -240,3 +240,15 @@ def test_union_mixed_schema_repartition(ray_8):
         data.from_items([{"x": 1}, {"x": 2}]))
     rows = u.repartition(2).take(10)
     assert len(rows) == 6
+
+
+def test_actor_pool_init_fn_with_one_arg_fn(ray_8):
+    """Regression: init_fn state must not break plain 1-arg block fns."""
+    import numpy as np
+    from ray_tpu.data.impl.compute import ActorPoolStrategy
+
+    ds = ray_tpu.data.range(8)
+    out = ds.map(lambda row: row * 2,
+                 compute=ActorPoolStrategy(init_fn=lambda: 5))
+    assert sorted(int(x) for x in out.take(8)) == [
+        0, 2, 4, 6, 8, 10, 12, 14]

@@ -172,3 +172,32 @@ def test_to_tune_trainable(ray_8):
     trainable = trainer.to_tune_trainable(train_func)
     assert callable(trainable)
     trainer.shutdown()
+
+
+def test_concurrent_executors_do_not_cross_wire(ray_8):
+    """Regression: two live BackendExecutors must keep separate worker
+    sessions and collective groups (module globals are shared in the
+    in-process runtime)."""
+    from ray_tpu.train.backend import BackendExecutor, JaxConfig
+
+    def make(tag):
+        def train_func(config):
+            for i in range(3):
+                train.report(tag=config["tag"], step=i)
+        return train_func
+
+    ex_a = BackendExecutor(JaxConfig(), num_workers=2)
+    ex_b = BackendExecutor(JaxConfig(), num_workers=2)
+    ex_a.start()
+    ex_b.start()
+    try:
+        ex_a.start_training(make("a"), {"tag": "a"})
+        ex_b.start_training(make("b"), {"tag": "b"})
+        for step in range(3):
+            ra = ex_a.get_next_results()
+            rb = ex_b.get_next_results()
+            assert [r.data["tag"] for r in ra] == ["a", "a"], (step, ra)
+            assert [r.data["tag"] for r in rb] == ["b", "b"], (step, rb)
+    finally:
+        ex_a.shutdown()
+        ex_b.shutdown()

@@ -209,3 +209,36 @@ def test_analysis_dataframe(ray_8):
     df = analysis.dataframe()
     assert len(df) == 2
     assert set(df["config/x"]) == {1, 2}
+
+
+def test_searcher_sees_suggested_trial_ids(ray_8):
+    """Regression: the trial must carry the id suggest() was called with,
+    or a searcher keyed by its own ids never matches results."""
+    class IdSearcher(tune.Searcher):
+        def __init__(self):
+            super().__init__(metric="score", mode="max")
+            self.suggested = []
+            self.resulted = []
+            self.completed = []
+            self._i = 0
+
+        def suggest(self, trial_id):
+            self._i += 1
+            self.suggested.append(trial_id)
+            return {"x": self._i}
+
+        def on_trial_result(self, trial_id, result):
+            self.resulted.append(trial_id)
+
+        def on_trial_complete(self, trial_id, result=None, error=False):
+            self.completed.append(trial_id)
+
+    def trainable(config):
+        tune.report(score=config["x"])
+
+    searcher = IdSearcher()
+    tune.run(trainable, search_alg=searcher, num_samples=3,
+             metric="score", mode="max")
+    assert set(searcher.completed) == set(searcher.suggested)
+    assert set(searcher.resulted) <= set(searcher.suggested)
+    assert searcher.resulted  # results actually flowed
