@@ -10,16 +10,24 @@ bucketized waterfill.
 
 TPU-resident design measured here (how a raylet colocated with the chip
 would run):
-  * world state (avail/total [N,R], class demand shapes [C,R]) AND the
-    per-class pending queue live on device — world uploaded once by
-    ``prepare_device``, the queue carried as scan state;
-  * the loop is CLOSED on device: tick k solves pending+arrivals_k and
-    carries the unplaced remainder into tick k+1 — only the exogenous
+  * world state (avail/total [N,R], class demand shapes [C,R]), the
+    per-class pending queue AND the inflight-work matrix live on device —
+    world uploaded once by ``prepare_device``, queue + availability +
+    inflight carried as scan state;
+  * the loop is CLOSED on device in STATE, not just queue: tick k's
+    placements subtract capacity that stays subtracted, a geometric
+    completion process (per-class rate rho) releases it back, and the
+    unplaced remainder carries into tick k+1 — only the exogenous
     arrival stream is staged ahead (a real raylet streams it in), never
-    future queue snapshots;
+    future queue or availability snapshots;
   * each tick ships a fixed-size sparse assignment (idx,val pairs) +
     validation bits back; ticks stream through one device program
     (``solve_stream``) so dispatch latency amortizes.
+The same kernel family also runs the live dispatch path: a raylet's
+ClusterTaskManager holds the world device-resident via
+``jax_backend.DeviceRuntimeSolver`` (scheduler_backend=jax, the default),
+shipping dirty-row deltas per tick — bench_runtime.py measures that
+end-to-end path through ``ray_tpu.remote``.
 
 Prints ONE JSON line:
   {"metric": ..., "value": <ms per tick>, "unit": "ms", "vs_baseline": x}
@@ -94,11 +102,15 @@ def main():
 
     ticks = 40
     stream = arrival_stream(rng, counts, ticks)
+    # Per-class geometric completion rates (mean service 2-8 ticks) —
+    # the closed loop evolves availability: placements occupy capacity
+    # until their completions release it.
+    rho = rng.integers(2, 9, size=demand.shape[0]) / 16.0
 
     # Warmup (compile) + correctness: decode tick 0's sparse assignment
     # (queue = the full 1M backlog) and check capacity/count bounds on
     # the host.
-    out = solver.solve_stream(stream)
+    out = solver.solve_stream(stream, rho=rho)
     assert out["ok"].all(), "on-device validation failed"
     alloc0 = solver.expand_sparse(out["idx"][0], out["vals"][0])
     usage = alloc0.T.astype(np.float64) @ demand.astype(np.float64)
@@ -109,12 +121,12 @@ def main():
 
     # Timed: K closed-loop ticks per device program.  Everything a tick
     # needs crosses the boundary inside the timed region: arrivals down,
-    # sparse assignment + validation bits back; the queue state stays
-    # device-resident between ticks.
+    # sparse assignment + validation bits back; queue, availability and
+    # inflight state stay device-resident between ticks.
     reps = 3
     t0 = time.perf_counter()
     for _ in range(reps):
-        out = solver.solve_stream(stream)
+        out = solver.solve_stream(stream, rho=rho)
     elapsed = time.perf_counter() - t0
     assert out["ok"].all()
     ms_per_tick = elapsed / (reps * ticks) * 1000.0

@@ -65,6 +65,8 @@ class ClusterTaskManager:
         if cfg.scheduler_backend == "jax" and self._total_queued() > 1:
             if self._schedule_batched():
                 return
+            # Device path unavailable/invalid this tick — the work was
+            # requeued; fall through to the validated native policy.
         self._schedule_greedy()
 
     def _total_queued(self) -> int:
@@ -137,10 +139,19 @@ class ClusterTaskManager:
                 return
 
     def _schedule_batched(self) -> bool:
-        """Solve all queues in one TPU call (scheduler_backend=jax)."""
+        """Solve all queues in one device call (scheduler_backend=jax).
+
+        The solver session keeps avail/total device-resident between
+        ticks (dirty-row deltas only, ``DeviceRuntimeSolver``); per tick
+        only the per-class counts go down and a validated sparse
+        assignment comes back.  NOTE the within-bucket fill order
+        diverges from the reference's strict min-utilization pick (see
+        jax_backend module docstring) — every grant below is still
+        re-validated against the exact fixed-point vectors.
+        """
         from ray_tpu.scheduler import jax_backend
         if self._jax_solver is None:
-            self._jax_solver = jax_backend.BatchSolver()
+            self._jax_solver = jax_backend.DeviceRuntimeSolver()
         view = self._raylet.cluster_view
         with self._lock:
             work: list = []
@@ -149,8 +160,14 @@ class ClusterTaskManager:
                 q.clear()
         if not work:
             return True
-        assignments = self._jax_solver.assign(
+        assignments = self._jax_solver.solve(
             view, [spec for spec, _ in work])
+        if assignments is None:
+            # Device solve failed — put everything back for greedy.
+            with self._lock:
+                for spec, reply in work:
+                    self._queues[spec.scheduling_class].append((spec, reply))
+            return False
         local_id = self._raylet.node_id
         for (spec, reply), target in zip(work, assignments):
             if target is None:

@@ -29,8 +29,10 @@ class Config:
     scheduler_avoid_tpu_nodes: bool = True
     #: Which backend solves the task->node assignment each tick:
     #: "native" = greedy per-task python/numpy policy (reference parity),
-    #: "jax"    = batched TPU bin-packing kernel (the north star).
-    scheduler_backend: str = "native"
+    #: "jax"    = batched TPU bin-packing kernel (the north star) with
+    #:            device-resident world state and validated native
+    #:            fallback.  Default since round 3.
+    scheduler_backend: str = "jax"
     #: Hybrid policy considers the top-k best nodes and picks randomly among
     #: them (reference: hybrid_scheduling_policy.cc top-k behavior).
     scheduler_top_k_fraction: float = 0.2

@@ -35,9 +35,10 @@ class TaskPoolStrategy:
         return [p[0] for p in pairs], [p[1] for p in pairs]
 
 
-def _accepts_state(fn: Callable) -> bool:
-    """True if fn can take (block, state) — Dataset transforms pass plain
-    1-arg block fns, which must keep working when init_fn is set."""
+import functools
+
+
+def _accepts_state_uncached(fn: Callable) -> bool:
     import inspect
     try:
         sig = inspect.signature(fn)
@@ -46,9 +47,24 @@ def _accepts_state(fn: Callable) -> bool:
     positional = [
         p for p in sig.parameters.values()
         if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
-    has_varargs = any(p.kind == p.VAR_POSITIONAL
-                      for p in sig.parameters.values())
-    return len(positional) >= 2 or has_varargs
+    return len(positional) >= 2
+
+
+_accepts_state_cached = functools.lru_cache(maxsize=256)(
+    _accepts_state_uncached)
+
+
+def _accepts_state(fn: Callable) -> bool:
+    """True if fn declares >=2 positional params, i.e. (block, state) —
+    Dataset transforms pass plain 1-arg block fns, which must keep working
+    when init_fn is set.  A bare *args fn does NOT count: calling it as
+    fn(block, state) would break variadic fns written for one argument.
+    Cached when fn is hashable — inspect.signature is too slow to run
+    once per block; unhashable callable objects fall back uncached."""
+    try:
+        return _accepts_state_cached(fn)
+    except TypeError:
+        return _accepts_state_uncached(fn)
 
 
 class _PoolWorker:

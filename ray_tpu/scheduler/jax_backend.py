@@ -12,7 +12,8 @@ rows, SURVEY.md §3.4) and N the number of nodes.
 
 Node ordering is **bucketized**: instead of a total order by exact score
 (a 10k-element sort per class — 256 sequential sorts per tick), nodes are
-binned into 19 priority buckets and filled in (bucket, node-id) order:
+binned into 19 priority buckets and filled in (bucket, rotated-node-id)
+order:
 
     bucket 0      — below the spread threshold (hybrid policy truncation,
                     ``hybrid_scheduling_policy.cc:100-133``)
@@ -21,6 +22,16 @@ binned into 19 priority buckets and filled in (bucket, node-id) order:
                     (``scheduler_avoid_gpu_nodes`` parity)
     bucket 18     — empty/dead/padded nodes
 
+Within a bucket the fill order is node id **rotated by a per-class
+stride** (class c starts at node ``(c * 977) % N_pad``), so concurrent
+classes don't all pile onto low-id nodes.  NOTE this is a documented
+divergence from the reference's strict min-utilization pick
+(``hybrid_scheduling_policy.cc:114-133``): within one 1/16 utilization
+bucket the reference would still order by exact score; here ties at
+bucket granularity fill round-robin-by-class instead — oracle-matched and
+validated against exact vectors before commit wherever it is consumed
+(``ClusterTaskManager._schedule_batched``, autoscaler bin-pack).
+
 This mirrors the reference's real semantics (it picks among a top-k
 candidate set, not a strict total order) and makes the per-class step
 sort-free: prefix capacities come from a two-level blocked cumsum
@@ -28,14 +39,21 @@ sort-free: prefix capacities come from a two-level blocked cumsum
 VPU.  The fill is still exact water-filling — capacity-consistent within
 the tick because the scan over classes carries the availability matrix.
 
-Two more levels of TPU-residency (used by bench.py):
+Three levels of TPU-residency:
   * ``prepare_device`` uploads avail/total/masks once; per-tick calls ship
     only the [C] counts vector (the queue snapshot), not the [N, R] world.
-  * ``solve_stream`` runs K ticks in ONE device program (scan over ticks),
-    returning a fixed-size sparse encoding of each tick's assignment plus
-    on-device validation flags — amortizing dispatch latency, which
-    dominates when the chip is remote (PCIe on a real v4-8 host, RPC over
-    the dev tunnel).
+  * ``solve_stream`` runs K ticks in ONE device program (scan over ticks)
+    with FULLY closed-loop world state: the pending queue, the evolving
+    availability matrix AND the inflight-work matrix are all scan carries
+    — placements subtract capacity, a geometric completion process
+    (per-class rate ``rho``) releases it back.  Returns a fixed-size
+    sparse encoding of each tick's assignment plus on-device validation
+    flags — amortizing dispatch latency, which dominates when the chip
+    is remote (PCIe on a real v4-8 host, RPC over the dev tunnel).
+  * ``DeviceRuntimeSolver`` is the **runtime dispatch path**: a raylet's
+    ``ClusterTaskManager`` keeps the cluster world state device-resident
+    between scheduling ticks, shipping only dirty-row deltas (nodes whose
+    availability changed) down and one sparse assignment back per tick.
 
 Two solvers behind one contract:
   * ``waterfill`` (default, exact): deterministic bucketized fill —
@@ -47,7 +65,7 @@ Two solvers behind one contract:
 
 The raylet stays authoritative: kernel output is validated against the
 exact fixed-point vectors before commit and falls back to the native
-policy (``ClusterTaskManager._schedule_batched``) — dirty/stale views are
+policy (``ClusterTaskManager._schedule_greedy``) — dirty/stale views are
 tolerated exactly like spillback.
 """
 
@@ -65,6 +83,7 @@ _BIG = 1e9
 _NUM_BUCKETS = 19
 _UTIL_LEVELS = 16
 _GROUP = 128  # node-axis block for the two-level prefix (lane width)
+_ROT_STRIDE = 977  # per-class rotation stride (prime, coprime with N_pad)
 
 
 def _pad_to(x: np.ndarray, shape: Tuple[int, ...]) -> np.ndarray:
@@ -80,14 +99,15 @@ def _round_up(n: int, m: int) -> int:
 # Shared per-class fill (device).
 # ---------------------------------------------------------------------------
 
-def _bucket_fill_step(av, total, d, cnt, is_accel, accel_node, empty,
+def _bucket_fill_step(av, total, d, cnt, is_accel, shift, accel_node, empty,
                       spread_threshold):
     """One class's water-fill against the running availability.
 
     Layout is TPU-native: av/total are [R, N] (resources on the 8-wide
     sublane axis, nodes on the 128-wide lane axis — N is padded to a
     multiple of 128 so every op is tile-aligned) and bucket tensors are
-    [B, N] for the same reason.  Returns (new_av[R,N], take[N]).
+    [B, N] for the same reason.  ``shift`` rotates the within-bucket fill
+    order (see module docstring).  Returns (new_av[R,N], take[N]).
 
     All f32; prefix sums stay exact for integer capacities while the
     running prefix is < 2^24, beyond which the prefix already dwarfs any
@@ -121,16 +141,21 @@ def _bucket_fill_step(av, total, d, cnt, is_accel, accel_node, empty,
                        float(_UTIL_LEVELS + 1), bucket)
     bucket = jnp.where(empty, float(_NUM_BUCKETS - 1), bucket)
     bucket = bucket.astype(jnp.int32)
-    # Prefix capacity in (bucket, node-id) order — sort-free, [B, N].
+    # Prefix capacity in (bucket, rotated node-id) order — sort-free,
+    # [B, N].  The roll puts node ``shift`` first within every bucket;
+    # prefix sums are computed in rolled space and rolled back so the
+    # per-node ``take`` lines up with real node positions.
     onehot = (bucket[None, :] ==
               jnp.arange(_NUM_BUCKETS, dtype=jnp.int32)[:, None])
     cap_oh = jnp.where(onehot, cap[None, :], 0.0)          # [B, N]
-    g = cap_oh.reshape(_NUM_BUCKETS, n_pad // _GROUP, _GROUP)
+    cap_oh_r = jnp.roll(cap_oh, -shift, axis=1)
+    g = cap_oh_r.reshape(_NUM_BUCKETS, n_pad // _GROUP, _GROUP)
     gsum = jnp.sum(g, axis=2)                              # [B, G]
     gprefix = jnp.cumsum(gsum, axis=1) - gsum              # excl. over groups
     within = jnp.cumsum(g, axis=2) - g                     # excl. in group
-    prefix_bn = (within + gprefix[:, :, None]).reshape(
-        _NUM_BUCKETS, n_pad)
+    prefix_bn = jnp.roll(
+        (within + gprefix[:, :, None]).reshape(_NUM_BUCKETS, n_pad),
+        shift, axis=1)
     btotal = jnp.sum(gsum, axis=1)                         # [B]
     bprefix = jnp.cumsum(btotal) - btotal                  # excl. over buckets
     # Select each node's own-bucket entry (masked sum avoids a gather).
@@ -139,6 +164,46 @@ def _bucket_fill_step(av, total, d, cnt, is_accel, accel_node, empty,
     take = jnp.clip(cnt - prefix, 0.0, cap)
     av = av - take[None, :] * d[:, None]
     return av, take
+
+
+def _class_shifts(c_pad: int, n_pad: int):
+    """Per-class within-bucket rotation offsets (device)."""
+    import jax.numpy as jnp
+    return (jnp.arange(c_pad, dtype=jnp.int32) * _ROT_STRIDE) % n_pad
+
+
+def _pack_tick(allocs, counts_k, av_pre, demand, nnz_max):
+    """On-device validation + fixed-size sparse encoding for one tick.
+
+    Returns (packed[2*nnz_max+3], placed_c[C]).  Sparse indices are exact
+    in f32 while C_pad*N_pad < 2^24 (asserted by callers).  Encoding is
+    the gather dual of stream compaction: binary-search the inclusive
+    rank cumsum for the j-th nonzero (TPU scatter at this size is ~2.5x
+    slower than searchsorted+gather).
+    """
+    import jax.numpy as jnp
+
+    flat_n = allocs.shape[0] * allocs.shape[1]
+    usage = jnp.einsum("cn,cr->rn", allocs, demand)
+    ok_cap = jnp.all(usage <= av_pre + 1e-2)
+    placed_c = jnp.sum(allocs, axis=1)                     # [C]
+    ok_cnt = jnp.all(placed_c <= counts_k + 0.5)
+    placed = jnp.sum(placed_c)
+    flat = allocs.reshape(flat_n)
+    ranks = jnp.cumsum((flat > 0).astype(jnp.int32))
+    nnz = ranks[-1]
+    pos = jnp.searchsorted(
+        ranks, jnp.arange(1, nnz_max + 1, dtype=jnp.int32))
+    live = jnp.arange(nnz_max) < nnz
+    posc = jnp.minimum(pos, flat_n - 1)
+    idx = jnp.where(live, posc, flat_n)
+    vals = jnp.where(live, flat[posc], 0.0)
+    ok = ok_cap & ok_cnt & (nnz <= nnz_max)
+    packed = jnp.concatenate([
+        idx.astype(jnp.float32), vals,
+        jnp.stack([placed, ok.astype(jnp.float32),
+                   nnz.astype(jnp.float32)])])
+    return packed, placed_c
 
 
 # ---------------------------------------------------------------------------
@@ -156,14 +221,15 @@ def _jit_waterfill(c_pad: int, n_pad: int, r_pad: int):
         # once to the TPU-native [R, N] layout (see _bucket_fill_step).
         av_t, total_t = avail.T, total.T
         empty = jnp.max(total_t, axis=0) <= 0
+        shifts = _class_shifts(c_pad, n_pad)
 
         def body(av, inputs):
-            d, cnt, is_accel = inputs
-            return _bucket_fill_step(av, total_t, d, cnt, is_accel,
+            d, cnt, is_accel, shift = inputs
+            return _bucket_fill_step(av, total_t, d, cnt, is_accel, shift,
                                      accel_node, empty, spread_threshold)
 
         final_avail, allocs = jax.lax.scan(
-            body, av_t, (demand, counts, accel_class))
+            body, av_t, (demand, counts, accel_class, shifts))
         return allocs, final_avail.T
 
     return jax.jit(solve)
@@ -172,70 +238,108 @@ def _jit_waterfill(c_pad: int, n_pad: int, r_pad: int):
 @functools.lru_cache(maxsize=8)
 def _jit_waterfill_stream(c_pad: int, n_pad: int, r_pad: int,
                           ticks: int, nnz_max: int):
-    """K scheduler ticks in one device program.
+    """K scheduler ticks in one device program, closed-loop in STATE.
 
-    Closed loop, device-resident queue state: the per-class pending-task
-    vector is the scan carry — each tick's queue is
-    ``pending + arrivals_k`` (arrivals are the exogenous input stream),
-    the solve places what fits, and the remainder carries to the next
-    tick: ``pending' = pending + arrivals_k - placed_per_class``.  The
-    availability snapshot resets each tick (steady state: a tick's
-    placements drain within the tick).  Output is ONE packed f32 array
-    [K, 2*nnz_max + 3] — per tick: sparse indices (exact in f32 while
-    C_pad*N_pad < 2^24), sparse values, then (placed, ok, nnz) — so the
-    host needs a single fetch per program.
+    All world state is device-resident scan carry:
+      * ``pending`` [C] — each tick's queue is ``pending + arrivals_k``;
+        the solve places what fits and the remainder carries forward;
+      * ``avail`` [R, N] — placements subtract capacity *across* ticks;
+      * ``inflight`` [C, N] — placed-but-unfinished work; a geometric
+        completion process with per-class rate ``rho`` releases
+        ``ceil(inflight * rho)`` tasks per (class, node) each tick,
+        returning their resources to ``avail`` (ceil guarantees drains
+        finish: any nonzero inflight releases at least one task).
+
+    Output is ONE packed f32 array [K, 2*nnz_max + 3] — per tick: sparse
+    indices (exact in f32 while C_pad*N_pad < 2^24), sparse values, then
+    (placed, ok, nnz) — so the host needs a single fetch per program.
     """
     import jax
     import jax.numpy as jnp
 
     assert c_pad * n_pad < (1 << 24), "sparse idx must stay exact in f32"
 
-    def solve(avail0, total, demand, pending0, arrivals, accel_node,
+    def solve(avail0, total, demand, pending0, arrivals, rho, accel_node,
               accel_class, spread_threshold):
         av0_t, total_t = avail0.T, total.T                 # [R, N]
         empty = jnp.max(total_t, axis=0) <= 0
-        flat_n = c_pad * n_pad
+        shifts = _class_shifts(c_pad, n_pad)
+        inflight0 = jnp.zeros((c_pad, n_pad), jnp.float32)
 
-        def one_tick(pending, arrivals_k):
+        def one_tick(carry, arrivals_k):
+            pending, av, inflight = carry
+            # Completions first: release resources held by finished work.
+            release = jnp.minimum(jnp.ceil(inflight * rho[:, None]),
+                                  inflight)                # [C, N]
+            av = jnp.minimum(
+                av + jnp.einsum("cn,cr->rn", release, demand), total_t)
+            inflight = inflight - release
             counts_k = pending + arrivals_k
-            def body(av, inputs):
-                d, cnt, is_accel = inputs
-                return _bucket_fill_step(av, total_t, d, cnt, is_accel,
-                                         accel_node, empty, spread_threshold)
 
-            _, allocs = jax.lax.scan(
-                body, av0_t, (demand, counts_k, accel_class), unroll=8)
-            # On-device validation: capacity + per-class count bounds.
-            usage = jnp.einsum("cn,cr->rn", allocs, demand)
-            ok_cap = jnp.all(usage <= av0_t + 1e-2)
-            placed_c = jnp.sum(allocs, axis=1)             # [C]
-            ok_cnt = jnp.all(placed_c <= counts_k + 0.5)
-            placed = jnp.sum(placed_c)
+            def body(av_in, inputs):
+                d, cnt, is_accel, shift = inputs
+                return _bucket_fill_step(av_in, total_t, d, cnt, is_accel,
+                                         shift, accel_node, empty,
+                                         spread_threshold)
+
+            av_after, allocs = jax.lax.scan(
+                body, av, (demand, counts_k, accel_class, shifts), unroll=8)
+            packed, placed_c = _pack_tick(allocs, counts_k, av, demand,
+                                          nnz_max)
             pending_next = jnp.maximum(counts_k - placed_c, 0.0)
-            # Fixed-size sparse encoding (class*N + node, value), via the
-            # gather dual of stream compaction: binary-search the inclusive
-            # rank cumsum for the j-th nonzero (TPU scatter at this size is
-            # ~2.5x slower than searchsorted+gather).
-            flat = allocs.reshape(flat_n)
-            ranks = jnp.cumsum((flat > 0).astype(jnp.int32))
-            nnz = ranks[-1]
-            pos = jnp.searchsorted(
-                ranks, jnp.arange(1, nnz_max + 1, dtype=jnp.int32))
-            live = jnp.arange(nnz_max) < nnz
-            posc = jnp.minimum(pos, flat_n - 1)
-            idx = jnp.where(live, posc, flat_n)
-            vals = jnp.where(live, flat[posc], 0.0)
-            ok = ok_cap & ok_cnt & (nnz <= nnz_max)
-            packed = jnp.concatenate([
-                idx.astype(jnp.float32), vals,
-                jnp.stack([placed, ok.astype(jnp.float32),
-                           nnz.astype(jnp.float32)])])
-            return pending_next, packed
+            inflight = inflight + allocs
+            return (pending_next, av_after, inflight), packed
 
-        _, out = jax.lax.scan(one_tick, pending0, arrivals)
+        _, out = jax.lax.scan(one_tick, (pending0, av0_t, inflight0),
+                              arrivals)
         return out
 
     return jax.jit(solve)
+
+
+@functools.lru_cache(maxsize=16)
+def _jit_solve_tick(c_pad: int, n_pad: int, r_pad: int, nnz_max: int):
+    """One runtime scheduling tick against DEVICE-RESIDENT world state.
+
+    Unlike ``_jit_waterfill`` this takes the transposed [R, N] matrices a
+    ``DeviceRuntimeSolver`` keeps on device between ticks — only the [C]
+    counts vector crosses host->device, only the packed sparse assignment
+    comes back (solve_stream-style validation bits included).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    assert c_pad * n_pad < (1 << 24), "sparse idx must stay exact in f32"
+
+    def solve(avail_t, total_t, demand, counts, accel_node, accel_class,
+              spread_threshold):
+        empty = jnp.max(total_t, axis=0) <= 0
+        shifts = _class_shifts(c_pad, n_pad)
+
+        def body(av, inputs):
+            d, cnt, is_accel, shift = inputs
+            return _bucket_fill_step(av, total_t, d, cnt, is_accel, shift,
+                                     accel_node, empty, spread_threshold)
+
+        _, allocs = jax.lax.scan(
+            body, avail_t, (demand, counts, accel_class, shifts))
+        packed, _ = _pack_tick(allocs, counts, avail_t, demand, nnz_max)
+        return packed
+
+    return jax.jit(solve)
+
+
+@functools.lru_cache(maxsize=16)
+def _jit_apply_rows(n_pad: int, r_pad: int, k_pad: int):
+    """Scatter k dirty node rows into the device-resident avail matrix."""
+    import jax
+
+    def apply(avail_t, idx, rows):
+        # avail_t [R, N]; idx [k]; rows [k, R].  Padding duplicates the
+        # last real entry, so duplicate-index writes carry equal values.
+        return avail_t.at[:, idx].set(rows.T)
+
+    return jax.jit(apply, donate_argnums=(0,))
 
 
 @functools.lru_cache(maxsize=16)
@@ -335,7 +439,8 @@ def waterfill_oracle(avail: np.ndarray, total: np.ndarray,
                      demand: np.ndarray, counts: np.ndarray,
                      accel_node: np.ndarray, accel_class: np.ndarray,
                      spread_threshold: float) -> np.ndarray:
-    """Pure-numpy reference of the bucketized waterfill (same semantics).
+    """Pure-numpy reference of the bucketized waterfill (same semantics,
+    including the per-class within-bucket rotation).
 
     Float32 throughout so score/bucket boundaries match the device kernel
     bit-for-bit."""
@@ -343,9 +448,11 @@ def waterfill_oracle(avail: np.ndarray, total: np.ndarray,
     total = total.astype(np.float32)
     C, R = demand.shape
     N = avail.shape[0]
+    n_pad = _round_up(max(N, 8), _GROUP)
     alloc = np.zeros((C, N), dtype=np.int64)
     eps = np.float32(1e-6)
     empty = total.max(axis=1) <= 0
+    node_ids = np.arange(N)
     for c in range(C):
         d = demand[c].astype(np.float32)
         cnt = int(counts[c])
@@ -369,7 +476,12 @@ def waterfill_oracle(avail: np.ndarray, total: np.ndarray,
         accel_avoid = accel_node & (not accel_class[c])
         bucket = bucket_oracle(score.astype(np.float32), accel_avoid, empty,
                                spread_threshold)
-        order = np.argsort(bucket, kind="stable")
+        # Fill order: (bucket, node-id rotated by the class stride) — the
+        # padded nodes carry zero capacity so only the real nodes'
+        # relative rolled order matters.
+        shift = (c * _ROT_STRIDE) % n_pad
+        rot_key = (node_ids - shift) % n_pad
+        order = np.lexsort((rot_key, bucket))
         remaining = cnt
         for n in order:
             if remaining <= 0:
@@ -380,6 +492,42 @@ def waterfill_oracle(avail: np.ndarray, total: np.ndarray,
                 avail[n] -= take * d
                 remaining -= take
     return alloc
+
+
+def stream_oracle(avail: np.ndarray, total: np.ndarray, demand: np.ndarray,
+                  arrivals: np.ndarray, rho: np.ndarray,
+                  accel_node: np.ndarray, accel_class: np.ndarray,
+                  spread_threshold: float,
+                  pending0: Optional[np.ndarray] = None) -> List[np.ndarray]:
+    """Numpy replay of the closed-loop tick stream (same release model as
+    ``_jit_waterfill_stream``): returns each tick's dense alloc[C, N].
+
+    Exact vs the device when all quantities are dyadic rationals (integer
+    demands/counts, rho a multiple of 2^-k) under f32."""
+    C, R = demand.shape
+    N = avail.shape[0]
+    avail = avail.astype(np.float32).copy()
+    total = total.astype(np.float32)
+    demand = demand.astype(np.float32)
+    rho = np.broadcast_to(np.asarray(rho, dtype=np.float32), (C,))
+    pending = (np.zeros(C, dtype=np.float32) if pending0 is None
+               else pending0.astype(np.float32))
+    inflight = np.zeros((C, N), dtype=np.float32)
+    out = []
+    for k in range(arrivals.shape[0]):
+        release = np.minimum(np.ceil(inflight * rho[:, None]), inflight)
+        avail = np.minimum(
+            avail + np.einsum("cn,cr->nr", release, demand), total)
+        inflight = inflight - release
+        queue_k = pending + arrivals[k]
+        alloc = waterfill_oracle(avail, total, demand, queue_k,
+                                 accel_node, accel_class, spread_threshold)
+        af = alloc.astype(np.float32)
+        avail = avail - np.einsum("cn,cr->nr", af, demand)
+        inflight = inflight + af
+        pending = np.maximum(queue_k - af.sum(axis=1), 0.0)
+        out.append(alloc)
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -459,18 +607,21 @@ class BatchSolver:
 
     def solve_stream(self, arrivals: np.ndarray,
                      pending0: Optional[np.ndarray] = None,
-                     nnz_max: int = 32768) -> Dict[str, np.ndarray]:
+                     nnz_max: int = 32768,
+                     rho: float | np.ndarray = 0.0) -> Dict[str, np.ndarray]:
         """Run K closed-loop ticks on device.
 
         arrivals is [K, C]: the exogenous per-tick task arrivals per
-        scheduling class.  The pending queue is device-resident scan
-        state: each tick solves ``pending + arrivals_k`` and carries the
-        unplaced remainder forward.  Returns sparse assignments +
+        scheduling class.  The pending queue, the availability matrix and
+        the inflight-work matrix are all device-resident scan state: each
+        tick releases completed work (per-class geometric rate ``rho``),
+        solves ``pending + arrivals_k`` against the EVOLVING availability
+        and carries the unplaced remainder forward.  ``rho=0`` disables
+        completions (pure capacity drain).  Returns sparse assignments +
         validation per tick: ``idx`` [K, nnz_max] in the PADDED flat
         space (class*N_pad + node; decode with ``expand_sparse``, which
         knows this solver's padding), ``vals`` [K, nnz_max],
         ``placed`` [K], ``ok`` [K], ``nnz`` [K]."""
-        import jax
         assert self._device_state is not None, "call prepare_device first"
         dev = self._device_state
         C, N, R = dev["shape"]
@@ -481,8 +632,11 @@ class BatchSolver:
         fn = _jit_waterfill_stream(c_pad, n_pad, r_pad, K, nnz_max)
         arr = _pad_to(arrivals.astype(np.float32), (K, c_pad))
         pen = _pad_to(pending0.astype(np.float32), (c_pad,))
+        rho_vec = _pad_to(
+            np.broadcast_to(np.asarray(rho, dtype=np.float32), (C,)).copy(),
+            (c_pad,))
         packed = np.asarray(fn(
-            dev["avail"], dev["total"], dev["demand"], pen, arr,
+            dev["avail"], dev["total"], dev["demand"], pen, arr, rho_vec,
             dev["accel_node"], dev["accel_class"], dev["thr"]))
         return {
             "idx": np.rint(packed[:, :nnz_max]).astype(np.int64),
@@ -518,7 +672,7 @@ class BatchSolver:
             spread_threshold = get_config().scheduler_spread_threshold
         return accel_node, accel_class, spread_threshold
 
-    # -- spec interface (used by ClusterTaskManager) ---------------------
+    # -- spec interface (kept for the autoscaler + as a dense fallback) ---
     def assign(self, view, specs: Sequence) -> List:
         """Per-spec node targets (None = infeasible/unassigned)."""
         from ray_tpu.scheduler.policy import SchedulingType
@@ -565,3 +719,232 @@ class BatchSolver:
                     view, specs[i].resources, specs[i].scheduling_options,
                     local_node_id=None)
         return targets
+
+
+class DeviceRuntimeSolver:
+    """Device-resident scheduling session for the RUNTIME dispatch path.
+
+    This is what ``ClusterTaskManager._schedule_batched`` runs
+    (``scheduler_backend=jax``, the default): the cluster world state
+    lives on device between scheduling ticks —
+
+      * full upload only on structural change (node joined/left, new
+        resource column, capacity growth), detected via the view's
+        version counter;
+      * otherwise only DIRTY node rows (availability changed by local
+        grants/releases or usage broadcasts since the last tick) are
+        scattered in via ``_jit_apply_rows``;
+      * per tick, only the [C] counts vector goes down and one packed
+        sparse assignment (with solve_stream-style on-device validation
+        bits) comes back.
+
+    The solver never mutates the device availability with its own
+    placements: the host view stays authoritative (``view.subtract`` on
+    commit marks rows dirty, which re-syncs them next tick) — stale
+    output is validated before commit and falls back exactly like
+    spillback.  On ANY failure (overflow, invalid output, device error)
+    ``solve`` returns None and the caller runs the native greedy path.
+    """
+
+    _NNZ_BUCKETS = (256, 2048, 16384, 131072)
+
+    def __init__(self):
+        self._state: Optional[dict] = None
+        # scheduling_class -> demand row; rows are append-only.
+        self._class_rows: Dict[int, int] = {}
+        self._class_reqs: List = []
+        self._demand_host: Optional[np.ndarray] = None   # [c_cap, r_pad]
+        self._accel_host: Optional[np.ndarray] = None    # [c_cap]
+        self._demand_dev = None
+        self._accel_dev = None
+        self.stats = {"ticks": 0, "full_syncs": 0, "row_deltas": 0,
+                      "fallbacks": 0}
+        # Probe once: without jax the device path is permanently off —
+        # a failed import is NOT cached in sys.modules, so retrying it
+        # every scheduling tick would rescan sys.path on the hot path.
+        import importlib.util
+        self._jax_ok = importlib.util.find_spec("jax") is not None
+
+    # -- public ----------------------------------------------------------
+    def solve(self, view, specs: Sequence) -> Optional[List]:
+        """Per-spec node targets, or None if the device path could not
+        produce a valid assignment (caller must fall back to greedy)."""
+        from ray_tpu.scheduler.policy import SchedulingType
+        groups: Dict[int, List[int]] = {}
+        fallback: List[int] = []
+        for i, spec in enumerate(specs):
+            opts = spec.scheduling_options
+            if opts.scheduling_type is SchedulingType.HYBRID:
+                groups.setdefault(spec.scheduling_class, []).append(i)
+            else:
+                fallback.append(i)
+        targets: List = [None] * len(specs)
+        if groups:
+            if not self._jax_ok:
+                self.stats["fallbacks"] += 1
+                return None
+            try:
+                if not self._solve_groups(view, specs, groups, targets):
+                    self.stats["fallbacks"] += 1
+                    return None
+            except Exception:
+                # The session may hold a donated-away or half-synced
+                # device buffer, and the view's dirty set was already
+                # drained: force a full resync next tick.
+                self._state = None
+                self.stats["fallbacks"] += 1
+                return None
+        if fallback:
+            from ray_tpu.scheduler import policy as policy_mod
+            for i in fallback:
+                targets[i] = policy_mod.schedule(
+                    view, specs[i].resources, specs[i].scheduling_options,
+                    local_node_id=None)
+        return targets
+
+    # -- internals -------------------------------------------------------
+    def _solve_groups(self, view, specs, groups, targets) -> bool:
+        self.stats["ticks"] += 1
+        ver, dirty_idx, dirty_rows = view.drain_dirty()
+        st = self._state
+        if (st is None or ver != st["version"]
+                or view.num_nodes() > st["n_pad"]
+                or view.num_columns() > st["r_pad"]):
+            self._full_sync(view)
+            st = self._state
+        elif dirty_idx:
+            self._apply_deltas(dirty_idx, dirty_rows)
+        if st is None or not st["node_ids"]:
+            return False
+        # Register any new scheduling classes (rare: classes are interned
+        # resource shapes).  A class demanding an unknown resource column
+        # forces the column into the view (version bump -> full resync).
+        for cls, members in groups.items():
+            if cls not in self._class_rows:
+                req = specs[members[0]].resources
+                if any(name not in st["columns"] for name in req.names()):
+                    view.demand_matrix([req])   # creates columns
+                    self._full_sync(view)
+                    st = self._state
+                self._register_class(cls, req, st)
+        c_cap = self._demand_host.shape[0]
+        counts = np.zeros(c_cap, dtype=np.float32)
+        for cls, members in groups.items():
+            counts[self._class_rows[cls]] = len(members)
+        total_q = int(counts.sum())
+        nnz_bound = min(total_q, len(groups) * len(st["node_ids"]))
+        nnz_max = next((b for b in self._NNZ_BUCKETS if b >= nnz_bound),
+                       None)
+        if nnz_max is None:
+            return False
+        cfg = get_config()
+        fn = _jit_solve_tick(c_cap, st["n_pad"], st["r_pad"], nnz_max)
+        packed = np.asarray(fn(
+            st["avail_t"], st["total_t"], self._demand_dev, counts,
+            st["accel_node"], self._accel_dev,
+            np.float32(cfg.scheduler_spread_threshold)))
+        ok = packed[2 * nnz_max + 1] > 0.5
+        if not ok:
+            return False
+        # Decode the sparse assignment and expand per-spec targets.
+        idx = np.rint(packed[:nnz_max]).astype(np.int64)
+        vals = packed[nnz_max:2 * nnz_max]
+        n_pad = st["n_pad"]
+        alloc = np.zeros((c_cap, n_pad), dtype=np.int64)
+        live = idx < c_cap * n_pad
+        alloc.reshape(-1)[idx[live]] = np.rint(vals[live]).astype(np.int64)
+        node_ids = st["node_ids"]
+        n_real = len(node_ids)
+        for cls, members in groups.items():
+            row = alloc[self._class_rows[cls]]
+            k = 0
+            for n in range(n_real):
+                for _ in range(int(row[n])):
+                    if k < len(members):
+                        targets[members[k]] = node_ids[n]
+                        k += 1
+        return True
+
+    def _full_sync(self, view):
+        import jax
+        self.stats["full_syncs"] += 1
+        ver, node_ids, total, avail, columns = view.snapshot_versioned()
+        N, R = total.shape
+        prev = self._state
+        # Keep padded dims monotone to avoid recompiles on node churn.
+        n_pad = _round_up(max(N, 8), _GROUP)
+        r_pad = _round_up(max(R, 1), 8)
+        if prev is not None:
+            n_pad = max(n_pad, prev["n_pad"])
+            r_pad = max(r_pad, prev["r_pad"])
+        accel_node = np.zeros(N, dtype=bool)
+        for col in ACCELERATOR_COLUMNS:
+            if col < total.shape[1]:
+                accel_node |= total[:, col] > 0
+        self._state = {
+            "version": ver, "node_ids": node_ids, "columns": columns,
+            "n_pad": n_pad, "r_pad": r_pad,
+            "avail_t": jax.device_put(
+                _pad_to(avail.astype(np.float32), (n_pad, r_pad)).T.copy()),
+            "total_t": jax.device_put(
+                _pad_to(total.astype(np.float32), (n_pad, r_pad)).T.copy()),
+            "accel_node": jax.device_put(_pad_to(accel_node, (n_pad,))),
+        }
+        # Rebuild the demand matrix against the (possibly wider) column
+        # mapping.
+        self._rebuild_demand(columns, r_pad)
+
+    def _rebuild_demand(self, columns: Dict[str, int], r_pad: int):
+        import jax
+        c_cap = max(8, _round_up(max(len(self._class_reqs), 1), 8))
+        demand = np.zeros((c_cap, r_pad), dtype=np.float32)
+        accel = np.zeros(c_cap, dtype=bool)
+        for row, req in enumerate(self._class_reqs):
+            for name, v in req.to_dict().items():
+                col = columns.get(name)
+                if col is not None:
+                    demand[row, col] = v
+            accel[row] = req.uses_accelerator()
+        self._demand_host, self._accel_host = demand, accel
+        self._demand_dev = jax.device_put(demand)
+        self._accel_dev = jax.device_put(accel)
+
+    def _register_class(self, cls: int, req, st: dict):
+        import jax
+        row = len(self._class_reqs)
+        self._class_rows[cls] = row
+        self._class_reqs.append(req)
+        if row >= self._demand_host.shape[0]:
+            self._rebuild_demand(st["columns"], st["r_pad"])
+            return
+        for name, v in req.to_dict().items():
+            col = st["columns"].get(name)
+            if col is not None:
+                self._demand_host[row, col] = v
+        self._accel_host[row] = req.uses_accelerator()
+        # Class registration is rare; re-uploading the (small) demand
+        # matrix wholesale is simpler than a device scatter.
+        self._demand_dev = jax.device_put(self._demand_host)
+        self._accel_dev = jax.device_put(self._accel_host)
+
+    def _apply_deltas(self, dirty_idx: List[int], dirty_rows: np.ndarray):
+        import jax
+        st = self._state
+        self.stats["row_deltas"] += len(dirty_idx)
+        n_pad, r_pad = st["n_pad"], st["r_pad"]
+        if len(dirty_idx) > n_pad // 2:
+            # Cheaper to re-upload than to scatter half the matrix.
+            avail = np.asarray(st["avail_t"]).T.copy()
+            avail[dirty_idx, :dirty_rows.shape[1]] = dirty_rows
+            st["avail_t"] = jax.device_put(avail.T.copy())
+            return
+        k_pad = 1
+        while k_pad < len(dirty_idx):
+            k_pad *= 2
+        idx = np.full(k_pad, dirty_idx[-1], dtype=np.int32)
+        idx[:len(dirty_idx)] = dirty_idx
+        rows = np.zeros((k_pad, r_pad), dtype=np.float32)
+        rows[:, :dirty_rows.shape[1]] = dirty_rows[-1]
+        rows[:len(dirty_idx), :dirty_rows.shape[1]] = dirty_rows
+        fn = _jit_apply_rows(n_pad, r_pad, k_pad)
+        st["avail_t"] = fn(st["avail_t"], idx, rows)

@@ -144,7 +144,10 @@ class ClusterResourceView:
         self._columns: Dict[str, int] = dict(_PREDEFINED_INDEX)
         self._total = np.zeros((0, NUM_PREDEFINED), dtype=np.float32)
         self._avail = np.zeros((0, NUM_PREDEFINED), dtype=np.float32)
-        self.version = 0  # bumped on topology/resource change
+        self.version = 0  # bumped on structural change (nodes/columns)
+        # Row indices whose availability changed since the last
+        # drain_dirty() — the delta feed for the device-resident solver.
+        self._dirty: set = set()
 
     # ---- column management ---------------------------------------------
     def _column(self, name: str) -> int:
@@ -155,6 +158,7 @@ class ClusterResourceView:
             pad = np.zeros((self._total.shape[0], 1), dtype=np.float32)
             self._total = np.concatenate([self._total, pad], axis=1)
             self._avail = np.concatenate([self._avail, pad.copy()], axis=1)
+            self.version += 1
         return idx
 
     @property
@@ -194,6 +198,10 @@ class ClusterResourceView:
             for nid, i in list(self._node_index.items()):
                 if i > idx:
                     self._node_index[nid] = i - 1
+            # Remap dirty row indices past the removed row (stale indices
+            # would make drain_dirty read out of bounds).
+            self._dirty = {i - 1 if i > idx else i
+                           for i in self._dirty if i != idx}
             self.version += 1
 
     def update_node(self, node_id, resources: NodeResources):
@@ -211,6 +219,8 @@ class ClusterResourceView:
                 self._total[idx, self._columns[name]] = v / FP_SCALE
             for name, v in resources.available.items():
                 self._avail[idx, self._columns[name]] = v / FP_SCALE
+            # Totals changed: structural for the device mirror.
+            self.version += 1
 
     def update_available(self, node_id, available: Dict[str, float]):
         """Apply a resource-usage broadcast for one node."""
@@ -224,6 +234,7 @@ class ClusterResourceView:
             for name, v in available.items():
                 if name in self._columns:
                     self._avail[idx, self._columns[name]] = v
+            self._dirty.add(idx)
 
     # ---- scheduling-side mutation (dirty local view) --------------------
     def subtract(self, node_id, req: ResourceRequest) -> bool:
@@ -234,6 +245,7 @@ class ClusterResourceView:
             idx = self._node_index[node_id]
             for name, v in req.quantized().items():
                 self._avail[idx, self._columns[name]] -= v / FP_SCALE
+            self._dirty.add(idx)
             return True
 
     def add_back(self, node_id, req: ResourceRequest):
@@ -248,6 +260,7 @@ class ClusterResourceView:
                 self._avail[idx, col] = min(
                     self._total[idx, col],
                     self._avail[idx, col] + v / FP_SCALE)
+            self._dirty.add(idx)
 
     # ---- dense snapshot (the device ABI) --------------------------------
     def snapshot(self):
@@ -256,6 +269,29 @@ class ClusterResourceView:
         with self._lock:
             return (list(self._node_ids), self._total.copy(),
                     self._avail.copy(), dict(self._columns))
+
+    def snapshot_versioned(self):
+        """snapshot() plus the structural version, read atomically —
+        the full-upload path of the device-resident solver."""
+        with self._lock:
+            return (self.version, list(self._node_ids), self._total.copy(),
+                    self._avail.copy(), dict(self._columns))
+
+    def drain_dirty(self):
+        """Atomically take (version, dirty row indices, their current
+        avail rows) and clear the dirty set.  Rows re-dirtied by
+        concurrent mutations after this call are picked up next drain —
+        values are always read fresh, so deltas never go backwards."""
+        with self._lock:
+            if not self._dirty:
+                return self.version, [], None
+            idx = sorted(self._dirty)
+            self._dirty.clear()
+            return self.version, idx, self._avail[idx, :].copy()
+
+    def num_columns(self) -> int:
+        with self._lock:
+            return len(self._columns)
 
     def demand_matrix(self, requests: List[ResourceRequest]) -> np.ndarray:
         """Pack demands into [C, R] aligned with this view's columns."""

@@ -120,6 +120,7 @@ class Deployment:
     def delete(self) -> None:
         controller = _get_controller()
         ray_tpu.get(controller.delete_deployment.remote(self.name))
+        _evict_router(self.name)
 
     def get_handle(self) -> DeploymentHandle:
         controller = _get_controller()
@@ -214,12 +215,19 @@ def list_deployments() -> Dict[str, Deployment]:
             for name in ray_tpu.get(controller.list_deployments.remote())}
 
 
-def delete(name: str) -> None:
-    controller = _get_controller()
-    ray_tpu.get(controller.delete_deployment.remote(name))
+def _evict_router(name: str) -> None:
+    """Stop and drop the cached per-process Router for a deployment so a
+    later ``get_handle()`` never reuses a stale replica set or the old
+    ``max_concurrent_queries``."""
     router = _handle_routers.pop(name, None)
     if router is not None:
         router.stop()
+
+
+def delete(name: str) -> None:
+    controller = _get_controller()
+    ray_tpu.get(controller.delete_deployment.remote(name))
+    _evict_router(name)
 
 
 def shutdown() -> None:

@@ -97,7 +97,10 @@ def _get_queue(self_obj, fn, max_batch_size, batch_wait_timeout_s):
             registry = self_obj.__dict__.setdefault(_INSTANCE_ATTR, {})
             key = fn.__qualname__
         else:
-            registry, key = _FN_QUEUES, fn.__qualname__
+            # Keyed by (module, qualname): two same-named functions in
+            # different modules must not share one queue (or the second
+            # function's requests would be executed by the first).
+            registry, key = _FN_QUEUES, (fn.__module__, fn.__qualname__)
         queue = registry.get(key)
         if queue is None:
             queue = _BatchQueue(fn, max_batch_size, batch_wait_timeout_s)

@@ -108,16 +108,24 @@ class HTTPProxyActor:
                         router.stop()
 
     def _long_poll_loop(self):
+        # Retry transient failures with backoff — a single hiccup must not
+        # freeze the route table; exit only on stop or controller death.
+        from ray_tpu.serve.router import controller_alive
+        backoff = 0.05
         while not self._stopped.is_set():
             try:
                 version = ray_tpu.get(
                     self._controller.listen_for_change.remote(
                         self._version, 5.0))
+                backoff = 0.05
                 if version != self._version:
                     self._version = version
                     self._refresh_routes()
             except Exception:
-                return  # controller gone
+                if self._stopped.is_set() or not controller_alive():
+                    return
+                self._stopped.wait(backoff)
+                backoff = min(backoff * 2, 2.0)
 
     def _router_for(self, name: str):
         from ray_tpu.serve.router import Router

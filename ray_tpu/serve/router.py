@@ -17,6 +17,16 @@ from typing import Dict, List, Optional
 import ray_tpu
 
 
+def controller_alive() -> bool:
+    """Whether the serve controller actor is still registered."""
+    from ray_tpu.serve.controller import CONTROLLER_NAME
+    try:
+        ray_tpu.get_actor(CONTROLLER_NAME)
+        return True
+    except Exception:
+        return False
+
+
 class Router:
     def __init__(self, controller, deployment_name: str,
                  max_concurrent_queries: int = 100):
@@ -57,18 +67,26 @@ class Router:
         self._stopped.set()
 
     def _long_poll_loop(self):
+        # A transient listen_for_change failure must not pin a stale
+        # replica set forever: retry with backoff and exit only when the
+        # router is stopped or the controller is confirmed gone.
+        backoff = 0.05
         while not self._stopped.is_set():
             try:
                 version = ray_tpu.get(
                     self._controller.listen_for_change.remote(
                         self._version, 5.0))
+                backoff = 0.05
                 if self._stopped.is_set():
                     return
                 if version != self._version:
                     self._version = version
                     self._refresh()
             except Exception:
-                return  # controller gone — router is dead
+                if self._stopped.is_set() or not controller_alive():
+                    return
+                self._stopped.wait(backoff)
+                backoff = min(backoff * 2, 2.0)
 
     # ---- request path ---------------------------------------------------
     def assign_request(self, method_name: str, args, kwargs):

@@ -6,7 +6,8 @@ import numpy as np
 import pytest
 
 import ray_tpu
-from ray_tpu.scheduler.jax_backend import BatchSolver, waterfill_oracle
+from ray_tpu.scheduler.jax_backend import (BatchSolver, DeviceRuntimeSolver,
+                                           stream_oracle, waterfill_oracle)
 
 
 def random_problem(rng, C=12, N=40, R=4):
@@ -67,28 +68,49 @@ class TestWaterfillKernel:
 
 
 class TestTickStream:
-    def test_stream_matches_closed_loop_oracle(self):
+    def test_stream_matches_evolving_state_oracle(self):
+        """The closed loop carries availability + inflight across ticks:
+        placements occupy capacity until the completion process (rate
+        rho) releases it.  Replay the whole loop in numpy and demand
+        exact per-tick equality (all quantities dyadic -> f32-exact)."""
         rng = np.random.default_rng(3)
         solver = BatchSolver(mode="waterfill")
         avail, total, demand, counts, an, ac = random_problem(rng)
         solver.prepare_device(avail, total, demand, accel_node=an,
                               accel_class=ac, spread_threshold=0.5)
-        K = 4
+        K = 6
         arrivals = np.stack([np.roll(counts, k) for k in range(K)])
-        out = solver.solve_stream(arrivals, nnz_max=512)
+        rho = rng.integers(1, 9, size=demand.shape[0]) / 16.0  # dyadic
+        out = solver.solve_stream(arrivals, nnz_max=512, rho=rho)
         assert out["ok"].all()
-        # Host-side replay of the closed loop: queue_k = pending + arrivals,
-        # pending' = queue_k - placed.
-        pending = np.zeros_like(counts)
+        want_ticks = stream_oracle(avail, total, demand, arrivals, rho,
+                                   an, ac, spread_threshold=0.5)
         for k in range(K):
-            queue_k = pending + arrivals[k]
             alloc = solver.expand_sparse(out["idx"][k], out["vals"][k])
-            want = waterfill_oracle(avail, total, demand, queue_k, an, ac,
-                                    spread_threshold=0.5)
-            np.testing.assert_array_equal(alloc, want, err_msg=f"tick {k}")
-            assert int(out["nnz"][k]) == int((want > 0).sum())
-            assert int(out["placed"][k]) == int(want.sum())
-            pending = queue_k - want.sum(axis=1)
+            np.testing.assert_array_equal(alloc, want_ticks[k],
+                                          err_msg=f"tick {k}")
+            assert int(out["nnz"][k]) == int((want_ticks[k] > 0).sum())
+            assert int(out["placed"][k]) == int(want_ticks[k].sum())
+
+    def test_stream_availability_actually_evolves(self):
+        """With rho=0 (no completions) capacity drains monotonically: a
+        saturating arrival stream places less and less until nothing
+        fits — impossible under the old reset-each-tick semantics."""
+        solver = BatchSolver(mode="waterfill")
+        avail = total = np.full((8, 1), 4.0, dtype=np.float32)  # 32 slots
+        demand = np.ones((1, 1), dtype=np.float32)
+        solver.prepare_device(avail, total, demand)
+        arrivals = np.full((4, 1), 20, dtype=np.int64)
+        out = solver.solve_stream(arrivals, nnz_max=64, rho=0.0)
+        assert out["ok"].all()
+        placed = out["placed"].astype(int).tolist()
+        assert placed[0] == 20 and placed[1] == 12  # 32-slot drain
+        assert placed[2] == 0 and placed[3] == 0
+        # And with completions the steady state keeps placing.
+        out2 = solver.solve_stream(np.full((6, 1), 8, dtype=np.int64),
+                                   nnz_max=64, rho=0.5)
+        assert out2["ok"].all()
+        assert out2["placed"][-1] > 0
 
     def test_stream_overflow_flagged(self):
         # nnz_max smaller than the true nonzero count must trip ok=False.
@@ -127,7 +149,94 @@ class TestSinkhornKernel:
             assert (alloc.sum(axis=1) <= counts).all()
 
 
+class TestDeviceRuntimeSolver:
+    """The device-resident session the runtime dispatch path runs on."""
+
+    class _Spec:
+        def __init__(self, cpu, cls):
+            from ray_tpu.scheduler.policy import SchedulingOptions
+            from ray_tpu.scheduler.resources import ResourceRequest
+            self.resources = ResourceRequest({"CPU": cpu})
+            self.scheduling_options = SchedulingOptions.hybrid()
+            self.scheduling_class = cls
+
+    def _view(self, n=4, cpu=4.0):
+        from ray_tpu.scheduler.resources import (ClusterResourceView,
+                                                 NodeResources)
+        view = ClusterResourceView()
+        for i in range(n):
+            view.add_node(f"node{i}",
+                          NodeResources({"CPU": cpu, "memory": 8.0}))
+        return view
+
+    def test_solve_then_delta_sync(self):
+        view = self._view()
+        solver = DeviceRuntimeSolver()
+        specs = [self._Spec(1.0, 9101) for _ in range(8)]
+        targets = solver.solve(view, specs)
+        assert targets is not None and all(t is not None for t in targets)
+        assert solver.stats["full_syncs"] == 1
+        # Commit grants on the host view -> dirty rows -> the next tick
+        # ships row deltas instead of re-uploading the world.
+        for t, s in zip(targets, specs):
+            assert view.subtract(t, s.resources)
+        targets2 = solver.solve(
+            view, [self._Spec(1.0, 9101) for _ in range(4)])
+        assert targets2 is not None and all(t is not None for t in targets2)
+        assert solver.stats["full_syncs"] == 1   # no structural change
+        assert solver.stats["row_deltas"] >= 1
+        assert solver.stats["fallbacks"] == 0
+
+    def test_structural_change_forces_full_sync(self):
+        from ray_tpu.scheduler.resources import NodeResources
+        view = self._view(n=2)
+        solver = DeviceRuntimeSolver()
+        assert solver.solve(view, [self._Spec(1.0, 9102)]) is not None
+        view.add_node("late", NodeResources({"CPU": 4.0}))
+        t2 = solver.solve(view, [self._Spec(1.0, 9102) for _ in range(9)])
+        assert t2 is not None and all(t is not None for t in t2)
+        assert solver.stats["full_syncs"] == 2
+        assert "late" in t2  # the new node is schedulable
+
+    def test_respects_capacity_and_reports_infeasible(self):
+        view = self._view(n=2, cpu=2.0)
+        solver = DeviceRuntimeSolver()
+        specs = [self._Spec(1.0, 9103) for _ in range(10)]
+        targets = solver.solve(view, specs)
+        assert targets is not None
+        placed = [t for t in targets if t is not None]
+        assert len(placed) == 4          # 2 nodes x 2 CPU
+        from collections import Counter
+        assert max(Counter(placed).values()) <= 2
+
+
 class TestJaxBackendEndToEnd:
+    def test_jax_is_the_default_backend_and_on_dispatch_path(self):
+        """scheduler_backend defaults to jax since round 3; burst
+        submissions run the device-resident session, not the dense
+        per-call path, and never fall back."""
+        from ray_tpu._private.cluster import Cluster
+        cluster = Cluster(initialize_head=True,
+                          head_node_args=dict(num_cpus=4))
+        ray_tpu.init(_cluster=cluster)
+        try:
+            from ray_tpu._private.config import get_config
+            assert get_config().scheduler_backend == "jax"
+
+            @ray_tpu.remote
+            def f(i):
+                return i + 1
+
+            for _ in range(3):
+                refs = [f.remote(i) for i in range(40)]
+                assert ray_tpu.get(refs) == list(range(1, 41))
+            solver = cluster.head_node.cluster_task_manager._jax_solver
+            assert solver is not None, "device session never engaged"
+            assert solver.stats["ticks"] >= 1
+            assert solver.stats["fallbacks"] == 0
+        finally:
+            ray_tpu.shutdown()
+
     def test_tasks_run_under_jax_backend(self):
         ray_tpu.init(num_cpus=4,
                      _system_config={"scheduler_backend": "jax"})
