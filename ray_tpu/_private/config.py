@@ -75,6 +75,11 @@ class Config:
     max_lineage_bytes: int = 1024 * 1024 * 1024
 
     # ------ worker pool ------
+    #: "thread" = executor threads in the node process (default; one
+    #: process per host owns the TPU chips); "process" = real OS worker
+    #: processes spawned via worker_main and driven over the framed-RPC
+    #: wire (reference StartWorkerProcess parity, worker_pool.h:428).
+    worker_process_mode: str = "thread"
     #: Soft cap of idle workers kept alive per node (ray_config_def.h:129).
     num_workers_soft_limit: int = 64
     #: Seconds an idle worker thread lingers before exit.

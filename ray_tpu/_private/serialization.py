@@ -55,6 +55,13 @@ class SerializedObject:
             out.write(b)
         return out.getvalue()
 
+    def __reduce__(self):
+        # Cross-process wire path (task specs carry inline args as
+        # SerializedObject): flatten to one blob — memoryview buffers are
+        # not themselves picklable.  Contained refs are not re-captured;
+        # the owner registered them at submission time.
+        return (SerializedObject.from_bytes, (self.to_bytes(),))
+
     @classmethod
     def from_bytes(cls, blob: bytes) -> "SerializedObject":
         hlen = int.from_bytes(blob[:8], "little")

@@ -1,0 +1,163 @@
+"""Standalone worker process: ``python -m ray_tpu._private.worker_main``.
+
+Parity: reference worker processes started by the raylet's pool
+(``src/ray/raylet/worker_pool.h:428`` StartWorkerProcess spawns
+``python/ray/_private/workers/default_worker.py``, which registers back
+over the raylet socket and then serves ``CoreWorkerService.PushTask``,
+``core_worker.proto:353``).
+
+Protocol here (framed RPC, ray_tpu.rpc):
+  1. start an RpcServer on an ephemeral port serving push/stop;
+  2. connect to the raylet host service and ``register_worker`` with
+     (worker_id, port) — the handshake the pool's ProcessWorker waits on;
+  3. each ``push`` request executes one task: args arrive inline
+     (serialized blobs) or as object ids fetched from the raylet host via
+     ``get_object``; function blobs are fetched from the GCS KV via
+     ``kv_get`` and cached; serialized return values ride back in the
+     reply (the host stores them with owner semantics).
+
+Scope (v1): tasks and actors execute here; calling the ray_tpu API
+*from inside* a process-mode task (nested .remote) is not yet wired —
+that needs the full CoreWorker in the child, which is the thread-mode
+default's job.  Process mode exists to put real OS-process isolation
+and a real wire under the lease/execute path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+import sys
+import threading
+import traceback
+from typing import Dict, Optional
+
+from ray_tpu import exceptions
+from ray_tpu._private.serialization import (
+    SerializedObject, deserialize, loads_function, serialize)
+from ray_tpu.rpc import RpcClient, RpcServer
+
+
+class _WorkerRuntime:
+    def __init__(self, host: str, port: int, worker_id: str):
+        self.worker_id = worker_id
+        self.node_client = RpcClient((host, port))
+        self.server = RpcServer(name=f"worker-{worker_id[:8]}")
+        self.server.register_async("push", self._handle_push)
+        self.server.register("ping", lambda _p: "pong")
+        self.server.register("stop", self._handle_stop)
+        self._fn_cache: Dict[bytes, object] = {}
+        self.actor_instance = None
+        self._sema: Optional[threading.Semaphore] = None
+        self._order_lock = threading.Lock()
+        self._stop_event = threading.Event()
+
+    def run(self):
+        self.node_client.call("register_worker", {
+            "worker_id": self.worker_id,
+            "port": self.server.address[1],
+            "pid": os.getpid(),
+        })
+        self._stop_event.wait()
+        self.server.stop()
+
+    # ---- execution -----------------------------------------------------
+    def _handle_stop(self, _payload):
+        self._stop_event.set()
+        return True
+
+    def _handle_push(self, payload, reply):
+        kind = payload["kind"]
+        if kind == "actor_task" and self._sema is not None:
+            self._sema.acquire()
+            try:
+                reply(self._execute(payload))
+            finally:
+                self._sema.release()
+        else:
+            reply(self._execute(payload))
+
+    def _execute(self, payload) -> dict:
+        try:
+            args, kwargs = self._resolve_args(payload["args"])
+            kind = payload["kind"]
+            if kind == "create_actor":
+                cls = self._load_function(payload["function_key"])
+                self.actor_instance = cls(*args, **kwargs)
+                n = max(1, int(payload.get("max_concurrency", 1)))
+                self._sema = threading.Semaphore(n)
+                return {"error": None, "returns": []}
+            if kind == "actor_task":
+                if self.actor_instance is None:
+                    raise exceptions.RayTpuError("actor not initialized")
+                method = getattr(self.actor_instance,
+                                 payload["actor_method_name"])
+                result = method(*args, **kwargs)
+            else:
+                fn = self._load_function(payload["function_key"])
+                result = fn(*args, **kwargs)
+            return {"error": None,
+                    "returns": self._pack_returns(payload, result)}
+        except Exception as e:  # noqa: BLE001 — user errors cross the wire
+            err = exceptions.TaskError(
+                e, task_desc=f"{payload.get('function_name', '?')}"
+                             f"[process-worker]")
+            try:
+                blob = pickle.dumps(err)
+            except Exception:
+                blob = pickle.dumps(exceptions.RayTpuError(
+                    "".join(traceback.format_exception(e))))
+            return {"error": blob, "returns": []}
+
+    def _resolve_args(self, packed):
+        from ray_tpu._private.executor import _split_args
+        flat = []
+        for kind, data in packed:
+            if kind == "inline":
+                flat.append(deserialize(SerializedObject.from_bytes(data)))
+            else:
+                blob = self.node_client.call("get_object", data, timeout=30.0)
+                if blob is None:
+                    raise exceptions.ObjectLostError(
+                        data.hex(), "arg not available on host node")
+                flat.append(deserialize(SerializedObject.from_bytes(blob)))
+        return _split_args(flat)
+
+    def _pack_returns(self, payload, result):
+        num = payload["num_returns"]
+        if num == 0:
+            return []
+        values = [result] if num == 1 else list(result)
+        if len(values) != num:
+            raise ValueError(
+                f"task returned {len(values)} values, expected {num}")
+        out = []
+        for oid_bin, value in zip(payload["return_ids"], values):
+            out.append((oid_bin, serialize(value).to_bytes()))
+        return out
+
+    def _load_function(self, key: bytes):
+        fn = self._fn_cache.get(key)
+        if fn is None:
+            blob = self.node_client.call("kv_get", key, timeout=30.0)
+            if blob is None:
+                raise KeyError(f"function blob missing for {key!r}")
+            fn = loads_function(blob)
+            self._fn_cache[key] = fn
+        return fn
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, required=True)
+    parser.add_argument("--worker-id", required=True)
+    args = parser.parse_args(argv)
+    runtime = _WorkerRuntime(args.host, args.port, args.worker_id)
+    runtime.run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

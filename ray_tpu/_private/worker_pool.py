@@ -5,17 +5,30 @@ pre-startable workers, ``PopWorker`` (worker_pool.h:338) /
 ``PushWorker`` return, ``PrestartWorkers`` (:350), idle soft-cap with
 eviction (ray_config_def.h:129), dedicated workers for actors.
 
-TPU-first deviation: workers are *threads in the node's process*, not
-subprocesses.  One process per host owns the TPU chips (XLA requires single
-ownership), so Python-level parallelism comes from threads — jax compiled
-computations release the GIL, and framework logic is IO-bound.  The pool
-keeps the reference's lease lifecycle so the scheduler and transport layers
-are identical to a multi-process deployment.
+Two worker modes behind one lease lifecycle
+(``worker_process_mode`` config):
+
+* ``thread`` (default) — workers are threads in the node's process.
+  One process per host owns the TPU chips (XLA requires single
+  ownership), so Python-level parallelism comes from threads — jax
+  compiled computations release the GIL, and framework logic is
+  IO-bound.
+* ``process`` — workers are real OS processes
+  (``python -m ray_tpu._private.worker_main``), spawned like the
+  reference's ``StartWorkerProcess`` (worker_pool.h:428): the child
+  registers back over a framed-RPC socket (``WorkerHostService``) and
+  tasks are pushed to its own RPC server (``CoreWorkerService.PushTask``
+  parity, core_worker.proto:353) — every task and object crosses a real
+  process boundary.
+
+The scheduler and transport layers are identical in both modes.
 """
 
 from __future__ import annotations
 
 import queue
+import subprocess
+import sys
 import threading
 import traceback
 from typing import Callable, Dict, List, Optional
@@ -23,7 +36,7 @@ from typing import Callable, Dict, List, Optional
 from ray_tpu import exceptions
 from ray_tpu._private import worker_context
 from ray_tpu._private.config import get_config
-from ray_tpu._private.ids import WorkerID
+from ray_tpu._private.ids import ObjectID, WorkerID
 
 
 class WorkerState:
@@ -151,10 +164,265 @@ class Worker:
             self.node.on_actor_worker_exit(self.actor_id, self.worker_id)
 
 
+class WorkerHostService:
+    """Raylet-side RPC service that process-mode workers talk to:
+    registration handshake, object reads for task args, and function-blob
+    fetches from the GCS KV (reference: the raylet socket workers register
+    on + plasma UDS + GCS function table, collapsed into one surface)."""
+
+    def __init__(self, node):
+        from ray_tpu.rpc import RpcServer
+        self._node = node
+        self._lock = threading.Lock()
+        self._ports: Dict[str, int] = {}
+        self._events: Dict[str, threading.Event] = {}
+        self.server = RpcServer(
+            name=f"workerhost-{node.node_id.hex()[:6]}")
+        self.server.register("register_worker", self._register_worker)
+        self.server.register("get_object", self._get_object)
+        self.server.register("kv_get", self._kv_get)
+
+    @property
+    def port(self) -> int:
+        return self.server.address[1]
+
+    def wait_for_worker(self, worker_id_hex: str,
+                        timeout: float) -> Optional[int]:
+        with self._lock:
+            ev = self._events.setdefault(worker_id_hex, threading.Event())
+        if not ev.wait(timeout=timeout):
+            return None
+        with self._lock:
+            return self._ports.get(worker_id_hex)
+
+    def _register_worker(self, payload) -> bool:
+        wid = payload["worker_id"]
+        with self._lock:
+            self._ports[wid] = payload["port"]
+            ev = self._events.setdefault(wid, threading.Event())
+        ev.set()
+        return True
+
+    def _get_object(self, oid_bin: bytes) -> Optional[bytes]:
+        from ray_tpu._private.serialization import SerializedObject
+        oid = ObjectID(oid_bin)
+        serialized = self._node.object_store.get_serialized(oid)
+        if serialized is not None:
+            return serialized.to_bytes()
+        core = self._node.core_worker
+        if core is not None:
+            e = core.memory_store.get_entry(oid)
+            if e is not None and e.sealed and e.error is None and \
+                    isinstance(e.data, SerializedObject):
+                return e.data.to_bytes()
+        return None
+
+    def _kv_get(self, key: bytes) -> Optional[bytes]:
+        return self._node.cluster.gcs.kv.get(key)
+
+    def stop(self):
+        self.server.stop()
+
+
+class ProcessWorker:
+    """A worker living in its own OS process; same interface as Worker.
+
+    Host side of the lease lifecycle: spawns the child (StartWorkerProcess
+    parity), waits for its registration on the WorkerHostService, then
+    pushes tasks over the child's RPC server and stores the returned
+    serialized values with owner semantics."""
+
+    def __init__(self, pool: "WorkerPool", node):
+        self.worker_id = WorkerID.from_random()
+        self.node = node
+        self.node_id = node.node_id
+        self._pool = pool
+        self.state = WorkerState.IDLE
+        self.actor_id = None
+        self.actor_instance = None      # lives in the child process
+        self._max_concurrency = 1
+        self._killed = threading.Event()
+        self._queue: "queue.Queue" = queue.Queue()
+        self._client = None
+        host = pool.host_service()
+        import os
+        import ray_tpu
+        env = dict(os.environ)
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(ray_tpu.__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        self._proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_main",
+             "--host", "127.0.0.1", "--port", str(host.port),
+             "--worker-id", self.worker_id.hex()],
+            env=env)
+        self._pump = threading.Thread(
+            target=self._pump_loop, daemon=True,
+            name=f"ray_tpu::pworker::{self.worker_id.hex()[:8]}")
+        self._pump.start()
+
+    # ---- Worker interface ----------------------------------------------
+    def push_task(self, spec, on_done: Callable):
+        self._queue.put(("task", spec, on_done))
+
+    def assign_actor(self, creation_spec, on_done: Callable):
+        self._queue.put(("create_actor", creation_spec, on_done))
+
+    def submit_actor_task(self, spec, on_done: Callable):
+        self._queue.put(("actor_task", spec, on_done))
+
+    def kill_actor(self):
+        self.stop()
+
+    def stop(self):
+        self._killed.set()
+        self._queue.put(("exit", None, None))
+
+    # ---- pump ----------------------------------------------------------
+    def _pump_loop(self):
+        from ray_tpu.rpc import RpcClient
+        port = self._pool.host_service().wait_for_worker(
+            self.worker_id.hex(), timeout=30.0)
+        if port is None:
+            self._fail_until_exit("worker process failed to register")
+            return
+        self._client = RpcClient(("127.0.0.1", port))
+        while not self._killed.is_set():
+            try:
+                kind, spec, on_done = self._queue.get(timeout=1.0)
+            except queue.Empty:
+                continue
+            if kind == "exit":
+                break
+            if kind == "actor_task" and self._max_concurrency > 1:
+                # Out-of-order queue parity: up to max_concurrency calls
+                # in flight; replies handled on the client reader.
+                fut = self._client.call_future(
+                    "push", self._build_payload(kind, spec))
+                fut.add_done_callback(
+                    lambda f, s=spec, cb=on_done, k=kind:
+                    self._on_reply_future(f, s, cb, k))
+                continue
+            self._roundtrip(kind, spec, on_done)
+        self._on_exit()
+
+    def _roundtrip(self, kind, spec, on_done):
+        try:
+            reply = self._client.call("push",
+                                      self._build_payload(kind, spec),
+                                      timeout=None)
+        except Exception as e:
+            on_done(exceptions.RayTpuError(
+                f"worker process died: {e}"))
+            self._killed.set()
+            return
+        self._handle_reply(reply, spec, on_done, kind)
+
+    def _on_reply_future(self, fut, spec, on_done, kind):
+        err = fut.exception()
+        if err is not None:
+            on_done(exceptions.RayTpuError(f"worker process died: {err}"))
+            self._killed.set()
+            return
+        self._handle_reply(fut.result(), spec, on_done, kind)
+
+    def _handle_reply(self, reply, spec, on_done, kind):
+        import pickle
+        err_blob = reply.get("error")
+        if err_blob is not None:
+            try:
+                err = pickle.loads(err_blob)
+            except Exception:
+                err = exceptions.RayTpuError("undecodable worker error")
+            on_done(err)
+            return
+        self._store_returns(reply["returns"])
+        if kind == "create_actor":
+            self.state = WorkerState.ACTOR
+            self.actor_id = spec.actor_id
+            self._max_concurrency = max(1, spec.max_concurrency)
+        on_done(None)
+
+    def _build_payload(self, kind, spec) -> dict:
+        from ray_tpu._private.function_manager import _KV_PREFIX
+        args = []
+        for a in spec.args:
+            if a.is_inline:
+                args.append(("inline", a.value.to_bytes()))
+            else:
+                args.append(("ref", a.object_id.binary()))
+        fn_key = None
+        if spec.function_id is not None:
+            fn_key = _KV_PREFIX + spec.function_id.binary()
+        return {
+            "kind": kind,
+            "function_key": fn_key,
+            "function_name": spec.function_name,
+            "actor_method_name": spec.actor_method_name,
+            "num_returns": spec.num_returns,
+            "return_ids": [oid.binary() for oid in spec.return_ids],
+            "max_concurrency": spec.max_concurrency,
+            "args": args,
+        }
+
+    def _store_returns(self, returns):
+        from ray_tpu._private.object_store import InPlasmaMarker
+        from ray_tpu._private.serialization import SerializedObject
+        cfg = get_config()
+        core = self.node.core_worker
+        for oid_bin, blob in returns:
+            oid = ObjectID(oid_bin)
+            serialized = SerializedObject.from_bytes(blob)
+            if core is not None and \
+                    serialized.total_bytes <= cfg.max_direct_call_object_size:
+                core.memory_store.put(oid, serialized)
+            else:
+                self.node.object_store.put(oid, serialized)
+                self.node.cluster.object_directory.add_location(
+                    oid, self.node_id)
+                if core is not None:
+                    core.memory_store.put(oid, InPlasmaMarker(self.node_id))
+
+    def _fail_until_exit(self, reason: str):
+        while not self._killed.is_set():
+            try:
+                kind, _spec, on_done = self._queue.get(timeout=1.0)
+            except queue.Empty:
+                continue
+            if kind == "exit":
+                break
+            if on_done is not None:
+                on_done(exceptions.RayTpuError(reason))
+        self._on_exit()
+
+    def _on_exit(self):
+        was_actor = self.state == WorkerState.ACTOR
+        self.state = WorkerState.DEAD
+        if self._client is not None:
+            try:
+                self._client.call("stop", None, timeout=2.0)
+            except Exception:
+                pass
+            self._client.close()
+        try:
+            self._proc.terminate()
+            self._proc.wait(timeout=5.0)
+        except Exception:
+            try:
+                self._proc.kill()
+            except Exception:
+                pass
+        self._pool.on_worker_exit(self)
+        if was_actor and self.actor_id is not None:
+            self.node.on_actor_worker_exit(self.actor_id, self.worker_id)
+
+
 class WorkerPool:
     def __init__(self, node):
         self._node = node
-        self._lock = threading.Lock()
+        # RLock: pop_worker holds it while constructing a ProcessWorker,
+        # whose __init__ re-enters via host_service().
+        self._lock = threading.RLock()
         self._idle: List[Worker] = []
         self._leased: Dict[WorkerID, Worker] = {}
         self._actors: Dict[WorkerID, Worker] = {}
@@ -162,13 +430,26 @@ class WorkerPool:
         cfg = get_config()
         self._max_workers = cfg.maximum_startup_concurrency
         self._soft_limit = cfg.num_workers_soft_limit
+        self._process_mode = cfg.worker_process_mode == "process"
+        self._host_service: Optional[WorkerHostService] = None
+
+    def host_service(self) -> WorkerHostService:
+        with self._lock:
+            if self._host_service is None:
+                self._host_service = WorkerHostService(self._node)
+            return self._host_service
+
+    def _new_worker(self):
+        if self._process_mode:
+            return ProcessWorker(self, self._node)
+        return Worker(self, self._node)
 
     def prestart_workers(self, n: int):
         with self._lock:
             for _ in range(n):
                 if len(self._all) >= self._max_workers:
                     break
-                w = Worker(self, self._node)
+                w = self._new_worker()
                 self._all[w.worker_id] = w
                 self._idle.append(w)
 
@@ -183,7 +464,7 @@ class WorkerPool:
                     self._leased[w.worker_id] = w
                     return w
             if len(self._all) < self._max_workers:
-                w = Worker(self, self._node)
+                w = self._new_worker()
                 self._all[w.worker_id] = w
                 w.state = WorkerState.LEASED
                 self._leased[w.worker_id] = w
@@ -229,5 +510,8 @@ class WorkerPool:
     def shutdown(self):
         with self._lock:
             workers = list(self._all.values())
+            host, self._host_service = self._host_service, None
         for w in workers:
             w.stop()
+        if host is not None:
+            host.stop()

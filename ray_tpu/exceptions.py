@@ -34,6 +34,12 @@ class TaskError(RayTpuError):
         super().__init__(
             f"Task {task_desc} failed:\n{self.traceback_str}")
 
+    def __reduce__(self):
+        # Default Exception pickling would re-run __init__ with the
+        # formatted message as ``cause``; preserve the real fields so the
+        # error survives the process-worker / multi-host wire.
+        return (TaskError, (self.cause, self.task_desc, self.traceback_str))
+
     def as_instanceof_cause(self) -> BaseException:
         """Return an exception that is an instance of the cause's class so
         ``except UserError`` works across the task boundary."""

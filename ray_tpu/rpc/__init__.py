@@ -1,0 +1,16 @@
+"""Wire transport: length-prefixed framed RPC over TCP.
+
+TPU-native equivalent of the reference's gRPC substrate
+(``src/ray/rpc/grpc_server.h`` GrpcServer, ``src/ray/rpc/client_call.h``
+ClientCall): a small framed protocol carrying the same service surfaces
+(NodeManagerService lease protocol, CoreWorkerService PushTask, object
+transfer) between OS processes.  The in-process method-call transport
+remains the fast path for same-process clusters; this layer slots in
+front of the identical ``Raylet``/``GcsServer`` surfaces for real
+multi-process / multi-host deployments.
+"""
+
+from ray_tpu.rpc.client import RpcClient, RpcError
+from ray_tpu.rpc.server import RpcServer
+
+__all__ = ["RpcClient", "RpcServer", "RpcError"]

@@ -1,0 +1,128 @@
+"""RPC client: one persistent connection, concurrent in-flight calls.
+
+Reference analogue: ``src/ray/rpc/client_call.h`` (``ClientCall`` — each
+call carries a tag; replies are matched back on the io context) and the
+per-peer client pools (``core_worker_client_pool.h``).  Calls are
+correlated by ``msg_id``; a background reader resolves each reply into
+its waiting future, so any number of threads can call concurrently over
+the one socket.
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import threading
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ray_tpu.rpc import wire
+
+
+class RpcError(Exception):
+    """Remote handler raised (payload = remote traceback) or the
+    connection failed."""
+
+
+class RpcClient:
+    def __init__(self, address: Tuple[str, int],
+                 connect_timeout: float = 10.0):
+        self.address = tuple(address)
+        self._connect_timeout = connect_timeout
+        self._lock = threading.Lock()          # guards sock + pending
+        self._write_lock = threading.Lock()
+        self._sock = None
+        self._pending: Dict[int, Future] = {}
+        self._ids = itertools.count(1)
+        self._closed = False
+
+    # ---- public --------------------------------------------------------
+    def call(self, method: str, payload: Any = None,
+             timeout: Optional[float] = 60.0) -> Any:
+        return self.call_future(method, payload).result(timeout=timeout)
+
+    def call_future(self, method: str, payload: Any = None) -> Future:
+        fut: Future = Future()
+        msg_id = next(self._ids)
+        try:
+            sock = self._ensure_connected()
+            with self._lock:
+                self._pending[msg_id] = fut
+            wire.send_msg(sock, (msg_id, method, payload),
+                          lock=self._write_lock)
+        except Exception as e:
+            with self._lock:
+                self._pending.pop(msg_id, None)
+            fut.set_exception(RpcError(f"send to {self.address} failed: {e}"))
+        return fut
+
+    def call_async(self, method: str, payload: Any,
+                   callback: Callable[[Any, Optional[Exception]], None]):
+        fut = self.call_future(method, payload)
+
+        def on_done(f: Future):
+            err = f.exception()
+            callback(None if err else f.result(), err)
+
+        fut.add_done_callback(on_done)
+
+    def close(self):
+        self._closed = True
+        with self._lock:
+            sock, self._sock = self._sock, None
+        if sock is not None:
+            # shutdown() wakes the reader thread blocked in recv (close
+            # alone leaves the file description pinned by the syscall).
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def is_connected(self) -> bool:
+        with self._lock:
+            return self._sock is not None
+
+    # ---- internals -----------------------------------------------------
+    def _ensure_connected(self):
+        with self._lock:
+            if self._closed:
+                raise RpcError("client closed")
+            if self._sock is not None:
+                return self._sock
+            sock = wire.connect(self.address, timeout=self._connect_timeout)
+            self._sock = sock
+        threading.Thread(target=self._reader_loop, args=(sock,),
+                         daemon=True,
+                         name=f"ray_tpu::rpc::client::{self.address}").start()
+        return sock
+
+    def _reader_loop(self, sock):
+        try:
+            while True:
+                msg_id, ok, payload = wire.recv_msg(sock)
+                with self._lock:
+                    fut = self._pending.pop(msg_id, None)
+                if fut is None:
+                    continue
+                if ok:
+                    fut.set_result(payload)
+                else:
+                    fut.set_exception(RpcError(str(payload)))
+        except (wire.ConnectionClosed, OSError, EOFError) as e:
+            with self._lock:
+                if self._sock is sock:
+                    self._sock = None   # reconnect on next call
+                pending, self._pending = self._pending, {}
+            for fut in pending.values():
+                if not fut.done():
+                    fut.set_exception(
+                        RpcError(f"connection to {self.address} lost: {e}"))
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass

@@ -1,0 +1,155 @@
+"""Threaded RPC server: the process-boundary front of a service surface.
+
+Reference analogue: ``src/ray/rpc/grpc_server.h`` — a ``GrpcServer``
+binds a port and dispatches each inbound call to a registered handler on
+an io-context thread.  Here: one acceptor thread, one reader thread per
+connection, and each request runs on its own dispatch thread so a
+blocking handler (e.g. a worker lease waiting for dependencies) never
+stalls pipelined requests on the same connection.
+
+Handlers are ``name -> callable(payload) -> reply``.  A handler may
+instead accept ``(payload, reply_cb)`` by registering with
+``register_async`` — the reply is sent whenever ``reply_cb(result)``
+fires, which maps 1:1 onto the runtime's callback-style surfaces
+(``Raylet.request_worker_lease(spec, reply)``).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import traceback
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ray_tpu.rpc import wire
+
+
+def _shutdown_close(sock: socket.socket):
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+class RpcServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 name: str = "rpc"):
+        self._handlers: Dict[str, Tuple[Callable, bool]] = {}
+        self._name = name
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(128)
+        self.address: Tuple[str, int] = self._sock.getsockname()
+        self._stopped = threading.Event()
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"ray_tpu::rpc::{name}::accept")
+        self._accept_thread.start()
+
+    # ---- registry ------------------------------------------------------
+    def register(self, method: str, handler: Callable[[Any], Any]):
+        """Sync handler: return value becomes the reply."""
+        self._handlers[method] = (handler, False)
+
+    def register_async(self, method: str,
+                       handler: Callable[[Any, Callable], None]):
+        """Callback handler: handler(payload, reply_cb); the reply is sent
+        when reply_cb(result) is invoked (once)."""
+        self._handlers[method] = (handler, True)
+
+    def register_instance(self, obj, methods):
+        """Expose the listed bound methods of ``obj`` as sync handlers."""
+        for m in methods:
+            self.register(m, getattr(obj, m))
+
+    # ---- lifecycle -----------------------------------------------------
+    def stop(self):
+        self._stopped.set()
+        # shutdown() before close(): a close alone does not tear the
+        # connection down while another thread is blocked in recv on the
+        # same fd (the in-flight syscall pins the file description, so
+        # the FIN is never sent and both peers hang).
+        _shutdown_close(self._sock)
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            _shutdown_close(c)
+
+    # ---- loops ---------------------------------------------------------
+    def _accept_loop(self):
+        while not self._stopped.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conns_lock:
+                self._conns.add(conn)
+            threading.Thread(
+                target=self._reader_loop, args=(conn,), daemon=True,
+                name=f"ray_tpu::rpc::{self._name}::conn").start()
+
+    def _reader_loop(self, conn: socket.socket):
+        write_lock = threading.Lock()
+        try:
+            while not self._stopped.is_set():
+                try:
+                    msg_id, method, payload = wire.recv_msg(conn)
+                except (wire.ConnectionClosed, OSError, EOFError):
+                    return
+                threading.Thread(
+                    target=self._dispatch,
+                    args=(conn, write_lock, msg_id, method, payload),
+                    daemon=True,
+                    name=f"ray_tpu::rpc::{self._name}::call").start()
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, conn, write_lock, msg_id, method, payload):
+        entry = self._handlers.get(method)
+        if entry is None:
+            self._reply(conn, write_lock, msg_id, False,
+                        f"no such method: {method}")
+            return
+        handler, is_async = entry
+        if is_async:
+            replied = threading.Event()
+
+            def reply_cb(result):
+                if not replied.is_set():
+                    replied.set()
+                    self._reply(conn, write_lock, msg_id, True, result)
+
+            try:
+                handler(payload, reply_cb)
+            except Exception:
+                if not replied.is_set():
+                    replied.set()
+                    self._reply(conn, write_lock, msg_id, False,
+                                traceback.format_exc())
+            return
+        try:
+            result = handler(payload)
+        except Exception:
+            self._reply(conn, write_lock, msg_id, False,
+                        traceback.format_exc())
+            return
+        self._reply(conn, write_lock, msg_id, True, result)
+
+    def _reply(self, conn, write_lock, msg_id, ok, payload):
+        try:
+            wire.send_msg(conn, (msg_id, ok, payload), lock=write_lock)
+        except (OSError, wire.ConnectionClosed):
+            pass  # peer gone; nothing to tell it
