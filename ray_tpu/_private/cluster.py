@@ -31,28 +31,41 @@ class Cluster:
         self._raylets: List[Raylet] = []
         self.head_node: Optional[Raylet] = None
         self.core_worker = None
+        self.head_service = None          # wire front, started on demand
+        self._remote_procs: List = []     # spawned NodeHost OS processes
         self.gcs.subscribe_node_death(self._on_node_death)
         if initialize_head:
             self.head_node = self.add_node(**(head_node_args or {}))
 
     # ---- membership -----------------------------------------------------
+    @staticmethod
+    def _assemble_totals(num_cpus=None, num_tpus=0.0, num_gpus=0.0,
+                         memory=None, object_store_memory=None,
+                         resources=None) -> Dict[str, float]:
+        """One resource-dict builder for both in-process and remote
+        nodes, so their defaults can never drift apart."""
+        import os
+        total: Dict[str, float] = {}
+        total["CPU"] = float(num_cpus) if num_cpus is not None \
+            else float(os.cpu_count() or 1)
+        if num_tpus:
+            total["TPU"] = float(num_tpus)
+        if num_gpus:
+            total["GPU"] = float(num_gpus)
+        total["memory"] = memory if memory is not None else 4 * 1024**3
+        total["object_store_memory"] = float(
+            object_store_memory or get_config().object_store_memory)
+        total.update(resources or {})
+        return total
+
     def add_node(self, num_cpus: Optional[float] = None,
                  num_tpus: float = 0, num_gpus: float = 0,
                  memory: Optional[float] = None,
                  object_store_memory: Optional[int] = None,
                  resources: Optional[Dict[str, float]] = None,
                  node_name: str = "", labels: Optional[Dict] = None) -> Raylet:
-        import os
-        total: Dict[str, float] = {}
-        total["CPU"] = num_cpus if num_cpus is not None else (os.cpu_count() or 1)
-        if num_tpus:
-            total["TPU"] = num_tpus
-        if num_gpus:
-            total["GPU"] = num_gpus
-        total["memory"] = memory if memory is not None else 4 * 1024**3
-        total["object_store_memory"] = float(
-            object_store_memory or get_config().object_store_memory)
-        total.update(resources or {})
+        total = self._assemble_totals(num_cpus, num_tpus, num_gpus, memory,
+                                      object_store_memory, resources)
         raylet = Raylet(self, total, node_name=node_name, labels=labels,
                         object_store_memory=object_store_memory)
         raylet.core_worker = self.core_worker
@@ -60,6 +73,78 @@ class Cluster:
             self._raylets.append(raylet)
         self.gcs.register_raylet(raylet)
         return raylet
+
+    def adopt_raylet(self, raylet):
+        """Register an externally-constructed raylet (a RemoteNodeProxy
+        mirroring a NodeHost OS process) into the membership — the
+        head-side half of NodeInfoGcsService.RegisterNode."""
+        with self._lock:
+            self._raylets.append(raylet)
+        self.gcs.register_raylet(raylet)
+
+    def start_head_service(self):
+        """Start (once) the wire front that NodeHost processes join."""
+        if self.head_service is None:
+            from ray_tpu._private.head_service import HeadService
+            self.head_service = HeadService(self)
+        return self.head_service.address
+
+    def add_remote_node(self, num_cpus: float = 1, num_tpus: float = 0,
+                        num_gpus: float = 0,
+                        memory: Optional[float] = None,
+                        object_store_memory: Optional[int] = None,
+                        resources: Optional[Dict[str, float]] = None,
+                        node_name: str = "",
+                        timeout: float = 30.0) -> "RemoteNodeHandle":
+        """Spawn a worker-host OS process (``python -m
+        ray_tpu._private.node_host``) and wait for it to register over
+        TCP.  Reference: ``Cluster.add_node`` backed by a real raylet
+        process instead of an in-process one.  The spawned process is
+        matched by a one-shot registration token, so duplicate
+        node_names cannot bind the handle to the wrong node."""
+        import json
+        import os
+        import subprocess
+        import sys
+        import time
+        import uuid
+
+        import ray_tpu
+        host, port = self.start_head_service()
+        total = self._assemble_totals(num_cpus, num_tpus, num_gpus, memory,
+                                      object_store_memory, resources)
+        name = node_name or f"remote-{uuid.uuid4().hex[:8]}"
+        reg_token = uuid.uuid4().hex
+        env = dict(os.environ)
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(ray_tpu.__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.node_host",
+             "--head", f"{host}:{port}",
+             "--resources", json.dumps(total),
+             "--name", name,
+             "--reg-token", reg_token,
+             "--system-config", get_config().to_json()],
+            env=env)
+        deadline = time.monotonic() + timeout
+        node_id = None
+        while time.monotonic() < deadline:
+            node_id = self.head_service.node_id_for_token(reg_token)
+            if node_id is not None:
+                break
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"node_host process exited with {proc.returncode} "
+                    "before registering")
+            time.sleep(0.02)
+        if node_id is None:
+            proc.kill()
+            raise TimeoutError("remote node failed to register in time")
+        handle = RemoteNodeHandle(self, proc, node_id, name)
+        with self._lock:
+            self._remote_procs.append(handle)
+        return handle
 
     def remove_node(self, raylet: Raylet, graceful: bool = True):
         with self._lock:
@@ -100,7 +185,20 @@ class Cluster:
     def shutdown(self):
         for r in self.raylets():
             r.shutdown()
+        with self._lock:
+            handles, self._remote_procs = self._remote_procs, []
+        for h in handles:
+            h.terminate()
+        if self.head_service is not None:
+            self.head_service.stop()
+            self.head_service = None
         self.gcs.shutdown()
+
+    def proxy_for(self, node_id: NodeID):
+        """The RemoteNodeProxy currently mirroring ``node_id`` (None for
+        in-process raylets)."""
+        raylet = self.gcs.raylet(node_id)
+        return raylet if getattr(raylet, "is_remote_proxy", False) else None
 
     def wait_for_nodes(self, count: int, timeout: float = 10.0) -> bool:
         import time
@@ -110,3 +208,37 @@ class Cluster:
                 return True
             time.sleep(0.01)
         return False
+
+
+class RemoteNodeHandle:
+    """Driver-side handle on a spawned NodeHost OS process."""
+
+    def __init__(self, cluster: Cluster, proc, node_id: NodeID, name: str):
+        self.cluster = cluster
+        self.proc = proc
+        self.node_id = node_id
+        self.node_name = name
+
+    @property
+    def proxy(self):
+        return self.cluster.proxy_for(self.node_id)
+
+    def kill(self):
+        """Hard kill the OS process: no dereg, no more heartbeats — the
+        GCS declares the node dead after num_heartbeats_timeout misses
+        (NodeKillerActor chaos parity, but with a real process)."""
+        try:
+            self.proc.kill()
+            self.proc.wait(timeout=10.0)
+        except Exception:
+            pass
+
+    def terminate(self):
+        """Graceful stop: ask the node to shut down, then reap it."""
+        proxy = self.proxy
+        if proxy is not None:
+            proxy.shutdown()
+        try:
+            self.proc.wait(timeout=5.0)
+        except Exception:
+            self.kill()

@@ -138,6 +138,7 @@ class CoreWorker:
         object_id = ref.object_id()
         deadline = None if timeout is None else time.monotonic() + timeout
         recovery_attempted = False
+        nowhere_streak = 0
         while True:
             value, found = self._try_get_local(object_id)
             if found:
@@ -173,8 +174,18 @@ class CoreWorker:
                 if not recovery_attempted and self.recover_object(object_id):
                     recovery_attempted = True
                     continue
-                if recovery_attempted and not self._is_pending(object_id):
-                    time.sleep(0.01)
+                # Unrecoverable: allow a few rechecks (a producing task
+                # may seal between store reads), then surface the loss
+                # instead of spinning until the deadline.
+                nowhere_streak += 1
+                if nowhere_streak >= 5:
+                    raise exceptions.ObjectLostError(
+                        object_id,
+                        "all copies lost and lineage reconstruction "
+                        "unavailable")
+                time.sleep(0.01)
+            else:
+                nowhere_streak = 0
             if deadline is not None and time.monotonic() >= deadline:
                 raise exceptions.GetTimeoutError(
                     f"Get timed out for {object_id}")
@@ -266,8 +277,25 @@ class CoreWorker:
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None,
              fetch_local: bool = True) -> Tuple[List, List]:
+        """Event-driven wait: readiness signals are the owner memory
+        store sealing (small returns, errors, plasma markers) and the
+        directory gaining a location (big returns on any node) — each
+        unready ref registers one wakeup hook per source, and the loop
+        sleeps on an Event instead of polling (reference: memory-store
+        GetAsync + object directory subscription under ``Wait``)."""
         deadline = None if timeout is None else time.monotonic() + timeout
         refs = list(refs)
+        wake = threading.Event()
+        hooked: set = set()
+
+        def hook(object_id: ObjectID):
+            if object_id in hooked:
+                return
+            hooked.add(object_id)
+            self.memory_store.get_async(object_id, lambda _e: wake.set())
+            self.cluster.object_directory.subscribe_location(
+                object_id, lambda _n: wake.set())
+
         while True:
             ready, not_ready = [], []
             for ref in refs:
@@ -277,10 +305,17 @@ class CoreWorker:
                     not_ready.append(ref)
             if len(ready) >= num_returns or \
                     (deadline is not None and time.monotonic() >= deadline):
-                ready = ready[:max(num_returns, len(ready))] \
-                    if len(ready) >= num_returns else ready
                 return ready, not_ready
-            time.sleep(0.002)
+            for ref in not_ready:
+                hook(ref.object_id())
+            remaining = None if deadline is None \
+                else max(0.0, deadline - time.monotonic())
+            # Coarse fallback for readiness sources with no hook (e.g. a
+            # store state mutated without a directory event): 200 ms, not
+            # a hot poll.
+            wake.wait(timeout=0.2 if remaining is None
+                      else min(remaining, 0.2))
+            wake.clear()
 
     def _is_ready(self, object_id: ObjectID) -> bool:
         entry = self.memory_store.get_entry(object_id)

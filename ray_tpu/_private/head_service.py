@@ -1,0 +1,442 @@
+"""Head-side wire service: the process boundary in front of the cluster.
+
+Parity: reference ``src/ray/gcs/gcs_server/gcs_server.h:182-237`` — the
+head's service surface (NodeInfoGcsService RegisterNode/UnregisterNode,
+heartbeats, InternalKV, the object directory that owners answer location
+queries from) — plus the head half of the lease protocol
+(``node_manager.proto:300-357``): the GCS and driver-side submitters talk
+to a remote raylet exactly as they talk to an in-process one, through a
+``RemoteNodeProxy`` that forwards every Raylet surface over the node's
+framed-RPC connection.
+
+Topology is hub-and-spoke v1: worker-host processes (``node_host.py``)
+connect to this one server; peer object fetches relay through the head
+(the reference pulls peer-to-peer over ObjectManagerService — that is the
+next refinement, not a different protocol).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from ray_tpu import exceptions
+from ray_tpu._private.ids import NodeID, ObjectID, WorkerID
+from ray_tpu._private.serialization import SerializedObject
+from ray_tpu.rpc import RpcClient, RpcServer
+from ray_tpu.scheduler.resources import NodeResources
+
+
+def _ignore(_result, _err):
+    pass
+
+
+class _RemoteWorkerHandle:
+    """Head-side stand-in for a leased worker living in a NodeHost
+    process.  Duck-types the thread ``Worker`` surface the submitters and
+    the GCS actor manager use: push_task / assign_actor /
+    submit_actor_task / kill_actor, each forwarded over the node's wire
+    with the lease token (CoreWorkerService.PushTask parity — the raylet
+    is off the data path, but hub-and-spoke v1 routes through the node's
+    host server rather than a per-worker port)."""
+
+    def __init__(self, proxy: "RemoteNodeProxy", token: bytes):
+        self.worker_id = WorkerID(token)
+        self.node_id = proxy.node_id
+        self.state = "LEASED"
+        self._proxy = proxy
+
+    def _push(self, method: str, spec, on_done):
+        import pickle
+
+        def on_reply(result, err):
+            if err is not None:
+                on_done(exceptions.WorkerCrashedError(
+                    f"worker host connection lost: {err}"))
+                return
+            blob = result.get("error")
+            if blob is None:
+                on_done(None)
+                return
+            try:
+                on_done(pickle.loads(blob))
+            except Exception:
+                on_done(exceptions.RayTpuError("undecodable worker error"))
+
+        self._proxy.client.call_async(
+            method, {"worker_token": self.worker_id.binary(), "spec": spec},
+            on_reply)
+
+    def push_task(self, spec, on_done):
+        self._push("push_task", spec, on_done)
+
+    def assign_actor(self, creation_spec, on_done):
+        def wrap(err):
+            if err is None:
+                self.state = "ACTOR"
+            on_done(err)
+
+        self._push("assign_actor", creation_spec, wrap)
+
+    def submit_actor_task(self, spec, on_done):
+        self._push("push_actor_task", spec, on_done)
+
+    def kill_actor(self):
+        self._proxy.client.call_async(
+            "return_worker",
+            {"worker_token": self.worker_id.binary(), "disconnect": True},
+            _ignore)
+
+    def stop(self):
+        self.kill_actor()
+
+
+class _ProxyObjectStore:
+    """The sliver of NodeObjectStore the head touches on a remote node:
+    serialized reads for pulls, deletes for the free path.  ``get``
+    returns None — entry metadata (size) stays node-local, so the
+    locality lease policy falls back to presence-in-directory, which is
+    the signal that matters."""
+
+    def __init__(self, proxy: "RemoteNodeProxy"):
+        self._proxy = proxy
+
+    def get(self, object_id: ObjectID):
+        return None
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return False
+
+    def get_serialized(self, object_id: ObjectID
+                       ) -> Optional[SerializedObject]:
+        try:
+            blob = self._proxy.client.call(
+                "fetch_object", {"object_id": object_id.binary()},
+                timeout=60.0)
+        except Exception:
+            return None
+        return None if blob is None else SerializedObject.from_bytes(blob)
+
+    def delete(self, object_id: ObjectID):
+        self._proxy.client.call_async(
+            "delete_object", {"object_id": object_id.binary()}, _ignore)
+
+
+class RemoteNodeProxy:
+    """Duck-types ``Raylet`` on the head for one NodeHost process.
+
+    Every surface the GCS (register/poll/broadcast/PG-2PC), the driver
+    submitters (lease/return), and the object plane (serialized reads,
+    deletes) call on an in-process Raylet is forwarded over the node's
+    RpcClient; neither side's runtime code knows the wire exists."""
+
+    def __init__(self, node_id: NodeID, node_name: str,
+                 resources: Dict[str, float], labels: Dict,
+                 address):
+        self.node_id = node_id
+        self.node_name = node_name
+        self.local_resources = NodeResources(resources, labels=labels)
+        self.client = RpcClient(tuple(address))
+        self.object_store = _ProxyObjectStore(self)
+        self.is_remote_proxy = True
+        self._last_report = {
+            "available": dict(resources),
+            "total": dict(resources),
+            "load": {"queued": 0, "dispatch": 0},
+        }
+
+    # ---- GCS-facing (register / resource sync) -------------------------
+    def node_info(self) -> dict:
+        return {
+            "node_id": self.node_id.hex(),
+            "node_name": self.node_name,
+            "alive": True,
+            "remote": True,
+            "resources": self.local_resources.to_float_dict("total"),
+            "labels": dict(self.local_resources.labels),
+        }
+
+    def get_resource_report(self) -> dict:
+        """Non-blocking: return the last report and refresh it
+        asynchronously — the GCS poll loop must never block on a peer's
+        wire (ray_syncer polls on a dedicated thread for the same
+        reason)."""
+
+        def on_reply(result, err):
+            if err is None and isinstance(result, dict):
+                self._last_report = result
+
+        self.client.call_async("get_resource_report", None, on_reply)
+        return self._last_report
+
+    def update_resource_usage(self, batch: dict):
+        self.client.call_async("update_resource_usage", batch, _ignore)
+
+    # ---- lease protocol ------------------------------------------------
+    def request_worker_lease(self, spec, reply):
+        def on_reply(result, err):
+            if err is not None:
+                reply({"rejected": True,
+                       "reason": f"node connection lost: {err}"})
+                return
+            token = result.pop("worker_token", None)
+            if token is not None:
+                result["worker"] = _RemoteWorkerHandle(self, token)
+                result["raylet"] = self
+            reply(result)
+
+        self.client.call_async("request_worker_lease", spec, on_reply)
+
+    def return_worker(self, worker, disconnect: bool = False):
+        self.client.call_async(
+            "return_worker",
+            {"worker_token": worker.worker_id.binary(),
+             "disconnect": disconnect},
+            _ignore)
+
+    # ---- placement-group 2PC (node_manager.proto:319-330) --------------
+    def prepare_bundle_resources(self, pg_id, idx: int, req) -> bool:
+        try:
+            return bool(self.client.call(
+                "prepare_bundle",
+                {"pg_id": pg_id, "index": idx, "request": req},
+                timeout=30.0))
+        except Exception:
+            return False
+
+    def commit_bundle_resources(self, pg_id, idx: int, req):
+        self.client.call(
+            "commit_bundle",
+            {"pg_id": pg_id, "index": idx, "request": req}, timeout=30.0)
+
+    def cancel_resource_reserve(self, pg_id, idx: int):
+        self.client.call_async(
+            "cancel_bundle", {"pg_id": pg_id, "index": idx}, _ignore)
+
+    # ---- lifecycle -----------------------------------------------------
+    def shutdown(self):
+        try:
+            self.client.call("stop", None, timeout=5.0)
+        except Exception:
+            pass
+        self.client.close()
+
+    def kill(self):
+        """Head-side bookkeeping only — hard node death is the process
+        dying; heartbeat timeout does the declaring."""
+        self.client.close()
+
+    def debug_string(self) -> str:
+        return f"RemoteNodeProxy {self.node_name} ({self.node_id.hex()[:8]})"
+
+
+class HeadService:
+    """RPC server on the head exposing the GCS + owner surfaces that
+    ``node_host.py`` forwards to: registration, heartbeats, KV reads,
+    the object directory, inline return delivery, and hub-relayed object
+    fetches."""
+
+    def __init__(self, cluster):
+        self._cluster = cluster
+        self._lock = threading.Lock()
+        self._proxies: Dict[NodeID, RemoteNodeProxy] = {}
+        self._reg_tokens: Dict[str, NodeID] = {}
+        self.server = RpcServer(name="head")
+        s = self.server
+        s.register("register_node", self._handle_register_node)
+        s.register("unregister_node", self._handle_unregister_node)
+        s.register("heartbeat", self._handle_heartbeat)
+        s.register("actor_worker_died", self._handle_actor_worker_died)
+        s.register("kv_get", self._handle_kv_get)
+        s.register("fetch_object", self._handle_fetch_object)
+        s.register("fetch_value", self._handle_fetch_value)
+        s.register("put_inline", self._handle_put_inline)
+        s.register("add_location", self._handle_add_location)
+        s.register("get_locations", self._handle_get_locations)
+        s.register_async("wait_object", self._handle_wait_object)
+        s.register("ping", lambda _p: "pong")
+        cluster.gcs.subscribe_node_death(self._on_node_death)
+
+    @property
+    def address(self):
+        return self.server.address
+
+    # ---- membership ----------------------------------------------------
+    def _handle_register_node(self, payload) -> bool:
+        node_id = NodeID(payload["node_id"])
+        proxy = RemoteNodeProxy(
+            node_id, payload.get("node_name", ""),
+            payload["resources"], payload.get("labels") or {},
+            (payload.get("host", "127.0.0.1"), payload["port"]))
+        with self._lock:
+            self._proxies[node_id] = proxy
+            token = payload.get("reg_token")
+            if token:
+                self._reg_tokens[token] = node_id
+        self._cluster.adopt_raylet(proxy)
+        return True
+
+    def node_id_for_token(self, reg_token: str) -> Optional[NodeID]:
+        """Resolve a spawner's one-shot registration token to the node
+        id the spawned process registered with."""
+        with self._lock:
+            return self._reg_tokens.get(reg_token)
+
+    def _handle_unregister_node(self, payload) -> bool:
+        node_id = NodeID(payload["node_id"])
+        self._cluster.gcs.unregister_raylet(node_id)
+        self._drop_proxy(node_id)
+        return True
+
+    def _handle_heartbeat(self, payload) -> bool:
+        self._cluster.gcs.heartbeat_manager.heartbeat(
+            NodeID(payload["node_id"]))
+        return True
+
+    def _handle_actor_worker_died(self, payload) -> bool:
+        self._cluster.gcs.actor_manager.on_actor_worker_died(
+            payload["actor_id"], payload["reason"])
+        return True
+
+    def _on_node_death(self, node_id: NodeID):
+        self._drop_proxy(node_id)
+
+    def _drop_proxy(self, node_id: NodeID):
+        with self._lock:
+            proxy = self._proxies.pop(node_id, None)
+        if proxy is not None:
+            proxy.client.close()
+
+    # ---- KV ------------------------------------------------------------
+    def _handle_kv_get(self, key: bytes) -> Optional[bytes]:
+        return self._cluster.gcs.kv.get(key)
+
+    # ---- object plane --------------------------------------------------
+    def _owner_inline_blob(self, oid: ObjectID) -> Optional[bytes]:
+        """Small returns/puts live in the owner's memory store and are
+        never directory-registered; serve them straight from it."""
+        core = self._cluster.core_worker
+        if core is None:
+            return None
+        entry = core.memory_store.get_entry(oid)
+        if entry is not None and entry.sealed and entry.error is None and \
+                isinstance(entry.data, SerializedObject):
+            return entry.data.to_bytes()
+        return None
+
+    def _handle_fetch_object(self, payload) -> Optional[bytes]:
+        oid = ObjectID(payload["object_id"])
+        head = self._cluster.head_node
+        if head is not None:
+            serialized = head.object_store.get_serialized(oid)
+            if serialized is not None:
+                return serialized.to_bytes()
+        blob = self._owner_inline_blob(oid)
+        if blob is not None:
+            return blob
+        # Hub relay: the bytes live on some other registered node.
+        head_id = head.node_id if head is not None else None
+        for node_id in self._cluster.object_directory.get_locations(oid):
+            if node_id == head_id:
+                continue
+            raylet = self._cluster.gcs.raylet(node_id)
+            if raylet is None:
+                continue
+            serialized = raylet.object_store.get_serialized(oid)
+            if serialized is not None:
+                return serialized.to_bytes()
+        return None
+
+    def _handle_fetch_value(self, payload):
+        """Executor-facing fetch: like ``fetch_object`` but propagates
+        error entries (a failed upstream task's return must raise in the
+        downstream executor, not read as 'missing').  Returns
+        ("ok", bytes) | ("error", pickled exception) | None."""
+        import pickle
+
+        oid = ObjectID(payload["object_id"])
+        core = self._cluster.core_worker
+        if core is not None:
+            entry = core.memory_store.get_entry(oid)
+            if entry is not None and entry.sealed and \
+                    entry.error is not None:
+                try:
+                    return ("error", pickle.dumps(entry.error))
+                except Exception:
+                    return ("error", pickle.dumps(
+                        exceptions.RayTpuError(str(entry.error))))
+        blob = self._handle_fetch_object(payload)
+        return None if blob is None else ("ok", blob)
+
+    def _handle_put_inline(self, payload) -> bool:
+        core = self._cluster.core_worker
+        if core is None:
+            return False
+        core.memory_store.put(
+            ObjectID(payload["object_id"]),
+            SerializedObject.from_bytes(payload["blob"]))
+        return True
+
+    def _handle_add_location(self, payload) -> bool:
+        self._cluster.object_directory.add_location(
+            ObjectID(payload["object_id"]), NodeID(payload["node_id"]))
+        return True
+
+    def _handle_get_locations(self, payload):
+        oid = ObjectID(payload["object_id"])
+        locs = {n.binary()
+                for n in self._cluster.object_directory.get_locations(oid)}
+        if self._owner_inline_blob(oid) is not None and \
+                self._cluster.head_node is not None:
+            locs.add(self._cluster.head_node.node_id.binary())
+        return list(locs)
+
+    def _handle_wait_object(self, payload, reply):
+        """Block (server-side, event-driven) until the object has a
+        location or the owner's memory store seals it; reply with a node
+        id to fetch from, or None on timeout.  Replaces the spoke-side
+        20 ms location poll."""
+        oid = ObjectID(payload["object_id"])
+        timeout = float(payload.get("timeout", 30.0))
+        head = self._cluster.head_node
+        directory = self._cluster.object_directory
+        done = threading.Event()
+        state: Dict = {}
+
+        def finish(node_bin):
+            if done.is_set():
+                return
+            done.set()
+            timer = state.get("timer")
+            if timer is not None:
+                timer.cancel()
+            directory.unsubscribe_location(oid, on_location)
+            reply(node_bin)
+
+        def on_location(node_id):
+            finish(node_id.binary() if node_id is not None else None)
+
+        if self._owner_inline_blob(oid) is not None and head is not None:
+            finish(head.node_id.binary())
+            return
+        directory.subscribe_location(oid, on_location)
+        core = self._cluster.core_worker
+        if core is not None and head is not None:
+            core.memory_store.get_async(
+                oid, lambda _entry: finish(head.node_id.binary()))
+        if not done.is_set():
+            timer = threading.Timer(timeout, lambda: finish(None))
+            timer.daemon = True
+            state["timer"] = timer
+            timer.start()
+            if done.is_set():
+                timer.cancel()
+
+    # ---- lifecycle -----------------------------------------------------
+    def stop(self):
+        with self._lock:
+            proxies = list(self._proxies.values())
+            self._proxies.clear()
+        for p in proxies:
+            p.client.close()
+        self.server.stop()

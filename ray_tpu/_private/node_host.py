@@ -21,7 +21,6 @@ submitters, so neither side's runtime code knows the wire exists.
 from __future__ import annotations
 
 import threading
-import time
 from typing import Callable, Dict, Optional
 
 from ray_tpu import exceptions
@@ -138,20 +137,22 @@ class _RemoteDirectory:
         return {NodeID(b) for b in locs}
 
     def subscribe_location(self, object_id: ObjectID, cb: Callable):
-        """Poll the head until a location appears (the in-process
-        directory fires a callback; over the wire we poll — bounded)."""
+        """One async ``wait_object`` call: the head blocks event-driven
+        (directory subscription + owner memory-store future) and replies
+        with a location, or None on timeout — which flows back through
+        the pull path as a failed pull instead of a silent hang."""
 
-        def poll():
-            deadline = time.monotonic() + 30.0
-            while time.monotonic() < deadline and not self._host.stopped:
-                locs = self.get_locations(object_id)
-                if locs:
-                    cb(next(iter(locs)))
-                    return
-                time.sleep(0.02)
+        def on_done(result, err):
+            if self._host.stopped:
+                return
+            if err is not None or result is None:
+                cb(None)     # timed out / head gone -> failed pull
+            else:
+                cb(NodeID(result))
 
-        threading.Thread(target=poll, daemon=True,
-                         name="ray_tpu::nodehost::locpoll").start()
+        self._host.client.call_async(
+            "wait_object",
+            {"object_id": object_id.binary(), "timeout": 30.0}, on_done)
 
     def on_node_death(self, node_id):
         return []
@@ -178,16 +179,43 @@ class _RemoteCoreWorker:
         self.task_manager = _NeverPending()
 
     def get_for_executor(self, object_id: ObjectID, node):
-        entry = node.object_store.get(object_id)
-        if entry is not None:
-            from ray_tpu._private.object_store import entry_value
-            return entry_value(entry)
-        blob = self._host.client.call(
-            "fetch_object", {"object_id": object_id.binary()}, timeout=60.0)
-        if blob is None:
-            raise exceptions.ObjectLostError(object_id, "arg fetch failed")
+        """Executor-side arg wait (GetAndPinArgsForExecutor parity).
+
+        A granted lease may be used for ANY queued task of its
+        scheduling class (direct_task_transport.cc:157 worker reuse), so
+        an arg can legitimately not exist yet when the task arrives —
+        the executor must block until the owner produces it.  Loop:
+        local store -> owner fetch (errors propagate) -> event-driven
+        ``wait_object`` on the head, bounded by a deadline.
+        """
+        import pickle
+        import time
+
+        from ray_tpu._private.object_store import entry_value
         from ray_tpu._private.serialization import deserialize
-        return deserialize(SerializedObject.from_bytes(blob))
+
+        deadline = time.monotonic() + 60.0
+        while True:
+            entry = node.object_store.get(object_id)
+            if entry is not None:
+                return entry_value(entry)
+            result = self._host.client.call(
+                "fetch_value", {"object_id": object_id.binary()},
+                timeout=60.0)
+            if result is not None:
+                kind, blob = result
+                if kind == "error":
+                    raise pickle.loads(blob)
+                return deserialize(SerializedObject.from_bytes(blob))
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise exceptions.ObjectLostError(
+                    object_id, "arg fetch timed out")
+            self._host.client.call(
+                "wait_object",
+                {"object_id": object_id.binary(),
+                 "timeout": min(remaining, 10.0)},
+                timeout=remaining + 10.0)
 
     def put_return_value(self, object_id: ObjectID, value, node) -> int:
         from ray_tpu._private.config import get_config
@@ -260,7 +288,7 @@ class NodeHost:
     """One worker-host process: local Raylet + RPC server + head link."""
 
     def __init__(self, head_address, resources: Dict[str, float],
-                 node_name: str = ""):
+                 node_name: str = "", reg_token: str = ""):
         from ray_tpu._private.raylet import Raylet
         self.stopped = False
         self.client = RpcClient(tuple(head_address))
@@ -298,7 +326,9 @@ class NodeHost:
             "node_name": self.raylet.node_name,
             "resources": self.raylet.local_resources.to_float_dict("total"),
             "labels": dict(self.raylet.local_resources.labels),
+            "host": self.server.address[0],
             "port": self.server.address[1],
+            "reg_token": reg_token,
         }, timeout=30.0)
 
     # ---- lease / execute ----------------------------------------------
@@ -358,15 +388,15 @@ class NodeHost:
 
     def _handle_return_worker(self, payload) -> bool:
         token = payload["worker_token"]
+        disconnect = payload.get("disconnect", False)
         with self._workers_lock:
             worker = self._workers.pop(token, None)
         if worker is not None:
-            if worker.state == "ACTOR":
+            if worker.state == "ACTOR" and not disconnect:
                 # Dedicated actor workers keep their lease token alive.
                 with self._workers_lock:
                     self._workers[token] = worker
-            self.raylet.return_worker(
-                worker, disconnect=payload.get("disconnect", False))
+            self.raylet.return_worker(worker, disconnect=disconnect)
         return True
 
     # ---- resources / objects ------------------------------------------
@@ -416,3 +446,35 @@ class NodeHost:
             pass
         self.server.stop()
         self.client.close()
+
+
+def main(argv=None):
+    """``python -m ray_tpu._private.node_host --head HOST:PORT`` — the
+    daemon entry (reference: ``src/ray/raylet/main.cc``)."""
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(prog="ray_tpu.node_host")
+    parser.add_argument("--head", required=True,
+                        help="head service address, host:port")
+    parser.add_argument("--resources", default="{}",
+                        help="JSON dict of total resources")
+    parser.add_argument("--name", default="", help="node name")
+    parser.add_argument("--reg-token", default="",
+                        help="one-shot token the spawner matches the "
+                             "registration against")
+    parser.add_argument("--system-config", default="",
+                        help="JSON config propagated from the head "
+                             "(RayConfig::initialize parity)")
+    args = parser.parse_args(argv)
+    if args.system_config:
+        from ray_tpu._private.config import initialize_config
+        initialize_config(json.loads(args.system_config))
+    host, _, port = args.head.rpartition(":")
+    node = NodeHost((host, int(port)), json.loads(args.resources),
+                    node_name=args.name, reg_token=args.reg_token)
+    node.wait()
+
+
+if __name__ == "__main__":
+    main()

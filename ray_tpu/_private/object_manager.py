@@ -48,6 +48,9 @@ class ObjectDirectory:
     def remove_object(self, object_id: ObjectID):
         with self._lock:
             self._locations.pop(object_id, None)
+            # A freed object can never gain a location; drop its waiters
+            # (wait() wakeup hooks would otherwise accumulate forever).
+            self._subscribers.pop(object_id, None)
 
     def get_locations(self, object_id: ObjectID) -> Set[NodeID]:
         with self._lock:
@@ -63,6 +66,20 @@ class ObjectDirectory:
                 self._subscribers.setdefault(object_id, []).append(cb)
                 return
         cb(node)
+
+    def unsubscribe_location(self, object_id: ObjectID, cb: Callable):
+        """Deregister a pending location subscription (no-op if it
+        already fired or was never registered)."""
+        with self._lock:
+            subs = self._subscribers.get(object_id)
+            if subs is None:
+                return
+            try:
+                subs.remove(cb)
+            except ValueError:
+                return
+            if not subs:
+                del self._subscribers[object_id]
 
     def on_node_death(self, node_id: NodeID) -> List[ObjectID]:
         """Remove all locations on a dead node; returns objects that lost

@@ -71,6 +71,8 @@ class GcsPlacementGroupManager:
     def __init__(self, gcs):
         self._gcs = gcs
         self._lock = threading.RLock()
+        # State-change wakeups for wait_ready (no polling).
+        self._state_cond = threading.Condition(self._lock)
         self._groups: Dict[PlacementGroupID, GcsPlacementGroup] = {}
         self._named: Dict[str, PlacementGroupID] = {}
         self._pending: List[PlacementGroupID] = []
@@ -99,6 +101,7 @@ class GcsPlacementGroupManager:
             if pg is None:
                 return
             pg.state = PlacementGroupState.REMOVED
+            self._state_cond.notify_all()
             if pg.name:
                 self._named.pop(pg.name, None)
             if pg_id in self._pending:
@@ -126,16 +129,18 @@ class GcsPlacementGroupManager:
 
     def wait_ready(self, pg_id: PlacementGroupID, timeout: Optional[float]) -> bool:
         deadline = None if timeout is None else time.monotonic() + timeout
-        while True:
-            with self._lock:
+        with self._state_cond:
+            while True:
                 pg = self._groups.get(pg_id)
                 if pg is not None and pg.state == PlacementGroupState.CREATED:
                     return True
                 if pg is None or pg.state == PlacementGroupState.REMOVED:
                     return False
-            if deadline is not None and time.monotonic() >= deadline:
-                return False
-            time.sleep(0.005)
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._state_cond.wait(timeout=remaining)
 
     # ---- scheduling (ScheduleUnplacedBundles) ---------------------------
     def _schedule_pending(self):
@@ -191,6 +196,7 @@ class GcsPlacementGroupManager:
         with self._lock:
             pg.bundle_nodes.update(placement)
             pg.state = PlacementGroupState.CREATED
+            self._state_cond.notify_all()
             self._gcs.storage.placement_group_table.put(pg.pg_id, pg.info())
             callbacks = self._ready_callbacks.pop(pg.pg_id, [])
         for cb in callbacks:
@@ -210,6 +216,7 @@ class GcsPlacementGroupManager:
                     for i in lost:
                         del pg.bundle_nodes[i]
                     pg.state = PlacementGroupState.RESCHEDULING
+                    self._state_cond.notify_all()
                     affected.append(pg.pg_id)
             for pg_id in affected:
                 if pg_id not in self._pending:

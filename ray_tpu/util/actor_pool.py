@@ -1,19 +1,26 @@
-"""ActorPool — schedule work over a fixed pool of actors.
+"""ActorPool — fan work out over a fixed set of actors.
 
-Parity: reference ``python/ray/util/actor_pool.py`` (``ActorPool.submit``,
-``get_next``, ``get_next_unordered``, ``map``, ``map_unordered``,
-``has_next``, ``has_free``, ``push``, ``pop_idle``).
+Same public surface as the reference's ``python/ray/util/actor_pool.py``
+(``submit`` / ``get_next`` / ``get_next_unordered`` / ``map`` /
+``map_unordered`` / ``has_next`` / ``has_free`` / ``push`` /
+``pop_idle``), re-implemented around a ticketed in-flight table: every
+submission takes a monotonically increasing ticket, ordered retrieval
+walks the ticket sequence, unordered retrieval races the in-flight refs
+with ``wait``.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Iterable, List
+from collections import deque
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 import ray_tpu
+from ray_tpu import exceptions
+from ray_tpu._private.object_ref import ObjectRef
 
 
 class ActorPool:
-    """Operate on a fixed pool of actors, distributing tasks to free ones.
+    """Distribute ``fn(actor, value)`` calls across idle pool actors.
 
     >>> @ray_tpu.remote
     ... class W:
@@ -24,68 +31,85 @@ class ActorPool:
     """
 
     def __init__(self, actors: Iterable[Any]):
-        self._idle_actors: List[Any] = list(actors)
-        # ref -> actor for in-flight work, plus submission-order indexing.
-        self._future_to_actor = {}
-        self._index_to_future = {}
-        self._next_task_index = 0
-        self._next_return_index = 0
-        self._pending_submits: List[tuple] = []
+        self._free: List[Any] = list(actors)
+        self._backlog: deque = deque()          # (fn, value) with no actor
+        self._inflight: Dict[ObjectRef, Tuple[int, Any]] = {}
+        self._ticket_refs: Dict[int, ObjectRef] = {}
+        self._ticket_seq = 0                    # next ticket to hand out
+        self._emit_cursor = 0                   # next ticket get_next emits
 
     # ---- submission -----------------------------------------------------
     def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
-        """Apply ``fn(actor, value)`` on an idle actor (queues if none)."""
-        if self._idle_actors:
-            actor = self._idle_actors.pop()
-            future = fn(actor, value)
-            self._future_to_actor[future] = actor
-            self._index_to_future[self._next_task_index] = future
-            self._next_task_index += 1
-        else:
-            self._pending_submits.append((fn, value))
+        """Launch ``fn(actor, value)`` on a free actor, or queue it."""
+        if not self._free:
+            self._backlog.append((fn, value))
+            return
+        actor = self._free.pop()
+        ref = fn(actor, value)
+        self._inflight[ref] = (self._ticket_seq, actor)
+        self._ticket_refs[self._ticket_seq] = ref
+        self._ticket_seq += 1
 
     def has_next(self) -> bool:
-        return bool(self._future_to_actor) or bool(self._pending_submits)
+        return bool(self._inflight) or bool(self._backlog)
 
     def has_free(self) -> bool:
-        return bool(self._idle_actors) and not self._pending_submits
+        return bool(self._free) and not self._backlog
 
     # ---- retrieval ------------------------------------------------------
-    def get_next(self, timeout: float = None) -> Any:
-        """Next result in submission order."""
+    def get_next(self, timeout: Optional[float] = None) -> Any:
+        """Result of the oldest outstanding submission (ticket order).
+
+        A timeout leaves the pool untouched (the submission stays
+        retrievable); a task error recycles the actor before re-raising,
+        so one failed task never wedges the pool."""
         if not self.has_next():
-            raise StopIteration("No more results to get")
-        if self._next_return_index >= self._next_task_index or \
-                self._next_return_index not in self._index_to_future:
-            raise ValueError("It is not allowed to call get_next() after "
-                             "get_next_unordered()")
-        future = self._index_to_future.pop(self._next_return_index)
-        self._next_return_index += 1
-        result = ray_tpu.get(future, timeout=timeout)
-        self._return_actor(self._future_to_actor.pop(future))
+            raise StopIteration("ActorPool has no outstanding work")
+        ref = self._ticket_refs.get(self._emit_cursor)
+        if ref is None:
+            raise ValueError(
+                "ordered get_next() cannot follow get_next_unordered(): "
+                "the ticket sequence has a hole")
+        try:
+            result = ray_tpu.get(ref, timeout=timeout)
+        except exceptions.GetTimeoutError:
+            raise            # nothing consumed; caller may retry
+        except Exception:
+            self._consume(self._emit_cursor, ref)
+            raise
+        self._consume(self._emit_cursor, ref)
         return result
 
-    def get_next_unordered(self, timeout: float = None) -> Any:
-        """Next result to become ready, regardless of submission order."""
+    def get_next_unordered(self, timeout: Optional[float] = None) -> Any:
+        """Whichever outstanding result lands first."""
         if not self.has_next():
-            raise StopIteration("No more results to get")
-        ready, _ = ray_tpu.wait(
-            list(self._future_to_actor), num_returns=1, timeout=timeout)
-        if not ready:
-            raise TimeoutError("Timed out waiting for result")
-        future = ready[0]
-        for i, f in list(self._index_to_future.items()):
-            if f is future or f == future:
-                del self._index_to_future[i]
-                break
-        result = ray_tpu.get(future)
-        self._return_actor(self._future_to_actor.pop(future))
-        return result
+            raise StopIteration("ActorPool has no outstanding work")
+        done, _rest = ray_tpu.wait(list(self._inflight), num_returns=1,
+                                   timeout=timeout)
+        if not done:
+            raise TimeoutError(
+                f"no ActorPool result became ready within {timeout}s")
+        ref = done[0]
+        ticket, _actor = self._inflight[ref]
+        try:
+            return ray_tpu.get(ref)
+        finally:
+            self._consume(ticket, ref)
 
-    def _return_actor(self, actor) -> None:
-        self._idle_actors.append(actor)
-        if self._pending_submits:
-            self.submit(*self._pending_submits.pop(0))
+    def _consume(self, ticket: int, ref: ObjectRef) -> None:
+        """Retire a finished submission: drop its ticket, advance the
+        ordered cursor past it, and recycle its actor."""
+        self._ticket_refs.pop(ticket, None)
+        if ticket == self._emit_cursor:
+            self._emit_cursor += 1
+        self._recycle(ref)
+
+    def _recycle(self, ref: ObjectRef) -> None:
+        """Free the actor behind a finished ref and drain the backlog."""
+        _ticket, actor = self._inflight.pop(ref)
+        self._free.append(actor)
+        if self._backlog:
+            self.submit(*self._backlog.popleft())
 
     # ---- bulk maps ------------------------------------------------------
     def map(self, fn: Callable[[Any, Any], Any], values: Iterable[Any]):
@@ -101,16 +125,18 @@ class ActorPool:
         while self.has_next():
             yield self.get_next_unordered()
 
-    # ---- pool management ------------------------------------------------
+    # ---- pool membership ------------------------------------------------
     def push(self, actor) -> None:
-        """Add an idle actor to the pool."""
-        busy = set(self._future_to_actor.values())
-        if actor in self._idle_actors or actor in busy:
-            raise ValueError("Actor already belongs to current ActorPool")
-        self._return_actor(actor)
+        """Grow the pool with one more (idle) actor."""
+        if actor in self._free or \
+                any(actor is a for _t, a in self._inflight.values()):
+            raise ValueError("actor is already a member of this ActorPool")
+        self._free.append(actor)
+        if self._backlog:
+            self.submit(*self._backlog.popleft())
 
     def pop_idle(self):
-        """Remove and return an idle actor, or None if none are idle."""
+        """Detach one idle actor from the pool (None if all are busy)."""
         if self.has_free():
-            return self._idle_actors.pop()
+            return self._free.pop()
         return None

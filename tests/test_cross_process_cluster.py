@@ -1,0 +1,186 @@
+"""Cross-process cluster: head process + NodeHost OS processes over TCP.
+
+The round-3 gap this closes: ``node_host.py`` had no head to join.  Now
+``Cluster.add_remote_node`` spawns ``python -m
+ray_tpu._private.node_host`` and the head mirrors it as a
+``RemoteNodeProxy`` — the lease protocol of the reference's
+``node_manager.proto:300-357`` runs end-to-end over the framed wire.
+
+Reference test models: ``python/ray/tests/test_multi_node.py`` (real
+raylet processes), ``test_component_failures*.py`` (kill a raylet
+process, assert recovery).
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.worker import global_worker
+
+# Children are separate OS processes: keep their startup light (no jax
+# import / kernel compile) and their failure detection fast.
+_WIRE_CONFIG = {
+    "scheduler_backend": "native",
+    "raylet_heartbeat_period_milliseconds": 50,
+    "num_heartbeats_timeout": 20,
+    "gcs_resource_broadcast_period_milliseconds": 50,
+}
+
+
+@pytest.fixture
+def wire_cluster():
+    ray_tpu.init(num_cpus=2, _system_config=dict(_WIRE_CONFIG))
+    cluster = global_worker().cluster
+    yield cluster
+    ray_tpu.shutdown()
+
+
+def _wait_until(pred, timeout=15.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+class TestCrossProcessCluster:
+    def test_task_runs_in_remote_os_process(self, wire_cluster):
+        handle = wire_cluster.add_remote_node(
+            num_cpus=2, resources={"spoke": 4.0})
+
+        @ray_tpu.remote(resources={"spoke": 1.0})
+        def where(x):
+            return os.getpid(), x * 2
+
+        pid, doubled = ray_tpu.get(where.remote(21), timeout=30)
+        assert doubled == 42
+        assert pid == handle.proc.pid, \
+            "task did not run inside the NodeHost OS process"
+
+    def test_big_object_pulled_back_over_wire(self, wire_cluster):
+        wire_cluster.add_remote_node(num_cpus=2, resources={"spoke": 4.0})
+
+        @ray_tpu.remote(resources={"spoke": 1.0})
+        def make(n):
+            return np.arange(n, dtype=np.float64)
+
+        n = (12 * 1024 * 1024) // 8          # 12 MiB payload
+        ref = make.remote(n)
+        arr = ray_tpu.get(ref, timeout=60)
+        assert arr.shape == (n,)
+        assert arr[0] == 0 and arr[-1] == n - 1
+
+        # And push a >=10 MB argument the other way: driver -> remote.
+        big = np.ones(n, dtype=np.float64)
+
+        @ray_tpu.remote(resources={"spoke": 1.0})
+        def consume(a):
+            return float(a.sum()), os.getpid()
+
+        total, pid = ray_tpu.get(consume.remote(big), timeout=60)
+        assert total == float(n)
+        assert pid != os.getpid()
+
+    def test_remote_ref_arg_chains(self, wire_cluster):
+        """A remote task's return feeds another remote task (the arg is a
+        ref whose bytes live on the spoke / in the owner's store)."""
+        wire_cluster.add_remote_node(num_cpus=2, resources={"spoke": 4.0})
+
+        @ray_tpu.remote(resources={"spoke": 1.0})
+        def step(x):
+            return x + 1
+
+        ref = step.remote(0)
+        for _ in range(4):
+            ref = step.remote(ref)
+        assert ray_tpu.get(ref, timeout=60) == 5
+
+    def test_actor_on_remote_node(self, wire_cluster):
+        handle = wire_cluster.add_remote_node(
+            num_cpus=2, resources={"spoke": 4.0})
+
+        @ray_tpu.remote(resources={"spoke": 1.0})
+        class Counter:
+            def __init__(self, start):
+                self.n = start
+
+            def add(self, k):
+                self.n += k
+                return self.n
+
+            def host_pid(self):
+                return os.getpid()
+
+        c = Counter.remote(100)
+        assert ray_tpu.get([c.add.remote(1) for _ in range(5)],
+                           timeout=30) == [101, 102, 103, 104, 105]
+        assert ray_tpu.get(c.host_pid.remote(), timeout=30) == \
+            handle.proc.pid
+
+    def test_kill_process_death_detection_and_actor_restart(
+            self, wire_cluster):
+        """Hard-kill the NodeHost OS process: heartbeat timeout declares
+        the node dead and the GCS restarts the actor elsewhere — the
+        full failure path over a real process boundary."""
+        handle = wire_cluster.add_remote_node(
+            num_cpus=2, resources={"spoke": 4.0})
+        gcs = wire_cluster.gcs
+
+        @ray_tpu.remote(max_restarts=2)
+        class Phoenix:
+            def __init__(self):
+                self.pid = os.getpid()
+
+            def where(self):
+                return self.pid
+
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy)
+        # Soft affinity: prefer the remote node while it lives, fall back
+        # to survivors on restart (strict affinity to a dead node is
+        # correctly infeasible-forever).
+        p = Phoenix.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+            handle.node_id, soft=True)).remote()
+        assert ray_tpu.get(p.where.remote(), timeout=30) == handle.proc.pid
+
+        handle.kill()
+        assert _wait_until(
+            lambda: not gcs.node_manager.is_alive(handle.node_id),
+            timeout=20.0), "heartbeat timeout never declared the node dead"
+
+        # The actor must come back on a surviving node (the head).
+        def restarted():
+            actor = gcs.actor_manager.get_actor(p._actor_id)
+            return actor is not None and actor.state == "ALIVE"
+
+        assert _wait_until(restarted, timeout=20.0), \
+            "actor was not restarted after node death"
+        assert ray_tpu.get(p.where.remote(), timeout=30) == os.getpid()
+
+    def test_two_remote_nodes_and_graceful_remove(self, wire_cluster):
+        h1 = wire_cluster.add_remote_node(num_cpus=1, resources={"a": 1.0})
+        h2 = wire_cluster.add_remote_node(num_cpus=1, resources={"b": 1.0})
+
+        @ray_tpu.remote(resources={"a": 1.0})
+        def on_a():
+            return os.getpid()
+
+        @ray_tpu.remote(resources={"b": 1.0})
+        def on_b():
+            return os.getpid()
+
+        pa, pb = ray_tpu.get([on_a.remote(), on_b.remote()], timeout=60)
+        assert pa == h1.proc.pid
+        assert pb == h2.proc.pid
+        assert pa != pb
+
+        h2.terminate()
+        assert _wait_until(
+            lambda: not wire_cluster.gcs.node_manager.is_alive(h2.node_id),
+            timeout=10.0)
+        # Node 1 still works after its peer left.
+        assert ray_tpu.get(on_a.remote(), timeout=30) == h1.proc.pid

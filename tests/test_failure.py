@@ -99,3 +99,34 @@ def test_task_failure_exhausts_retries(ray_start_regular):
 
     with pytest.raises(RuntimeError, match="always fails"):
         ray_tpu.get(flaky.remote())
+
+
+def test_unrecoverable_loss_raises_object_lost(ray_start_cluster):
+    """A get() on an object whose every copy is gone and whose lineage
+    cannot reproduce it must raise ObjectLostError promptly — not spin
+    until the timeout (r3 verdict: silent abandonment on the pull path)."""
+    cluster = ray_start_cluster(num_cpus=1)
+    producer_node = cluster.add_node(num_cpus=1, resources={"prod": 1})
+    assert cluster.wait_for_nodes(2)
+
+    @ray_tpu.remote(resources={"prod": 0.1}, num_cpus=0, max_retries=0)
+    class Holder:
+        def make(self):
+            return np.ones(2_000_000, dtype=np.float32)  # node store
+
+    h = Holder.remote()
+    # Actor-task returns are NOT lineage-reconstructable, so losing the
+    # only copy is unrecoverable by design.  Wait for readiness WITHOUT
+    # fetching (a driver-side get would pull a surviving copy to the
+    # head), then drop the node holding the only copy.
+    ref = h.make.remote()
+    ready, _ = ray_tpu.wait([ref], timeout=10)
+    assert ready
+    cluster.remove_node(producer_node)
+    time.sleep(0.3)
+
+    t0 = time.monotonic()
+    with pytest.raises(ray_tpu.exceptions.ObjectLostError):
+        ray_tpu.get(ref, timeout=20)
+    assert time.monotonic() - t0 < 10, \
+        "loss should surface promptly, not burn the whole timeout"
