@@ -116,8 +116,10 @@ class Cluster:
         name = node_name or f"remote-{uuid.uuid4().hex[:8]}"
         reg_token = uuid.uuid4().hex
         env = dict(os.environ)
+        # Directory CONTAINING the ray_tpu package (…/ray_tpu/__init__.py
+        # -> two dirnames up), so the child can import it from any cwd.
         pkg_root = os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(ray_tpu.__file__))))
+            os.path.abspath(ray_tpu.__file__)))
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.node_host",

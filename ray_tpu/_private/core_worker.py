@@ -286,36 +286,47 @@ class CoreWorker:
         deadline = None if timeout is None else time.monotonic() + timeout
         refs = list(refs)
         wake = threading.Event()
-        hooked: set = set()
+        hooked: Dict[ObjectID, Tuple] = {}
 
         def hook(object_id: ObjectID):
             if object_id in hooked:
                 return
-            hooked.add(object_id)
-            self.memory_store.get_async(object_id, lambda _e: wake.set())
+            mem_cb = lambda _e: wake.set()      # noqa: E731
+            dir_cb = lambda _n: wake.set()      # noqa: E731
+            hooked[object_id] = (mem_cb, dir_cb)
+            self.memory_store.get_async(object_id, mem_cb)
             self.cluster.object_directory.subscribe_location(
-                object_id, lambda _n: wake.set())
+                object_id, dir_cb)
 
-        while True:
-            ready, not_ready = [], []
-            for ref in refs:
-                if self._is_ready(ref.object_id()):
-                    ready.append(ref)
-                else:
-                    not_ready.append(ref)
-            if len(ready) >= num_returns or \
-                    (deadline is not None and time.monotonic() >= deadline):
-                return ready, not_ready
-            for ref in not_ready:
-                hook(ref.object_id())
-            remaining = None if deadline is None \
-                else max(0.0, deadline - time.monotonic())
-            # Coarse fallback for readiness sources with no hook (e.g. a
-            # store state mutated without a directory event): 200 ms, not
-            # a hot poll.
-            wake.wait(timeout=0.2 if remaining is None
-                      else min(remaining, 0.2))
-            wake.clear()
+        try:
+            while True:
+                ready, not_ready = [], []
+                for ref in refs:
+                    if self._is_ready(ref.object_id()):
+                        ready.append(ref)
+                    else:
+                        not_ready.append(ref)
+                if len(ready) >= num_returns or \
+                        (deadline is not None and
+                         time.monotonic() >= deadline):
+                    return ready, not_ready
+                for ref in not_ready:
+                    hook(ref.object_id())
+                remaining = None if deadline is None \
+                    else max(0.0, deadline - time.monotonic())
+                # Coarse fallback for readiness sources with no hook
+                # (e.g. a store state mutated without a directory
+                # event): 200 ms, not a hot poll.
+                wake.wait(timeout=0.2 if remaining is None
+                          else min(remaining, 0.2))
+                wake.clear()
+        finally:
+            # Deregister every hook this call planted — repeated waits
+            # on a slow task must not accrete dead closures.
+            for object_id, (mem_cb, dir_cb) in hooked.items():
+                self.memory_store.cancel_get_async(object_id, mem_cb)
+                self.cluster.object_directory.unsubscribe_location(
+                    object_id, dir_cb)
 
     def _is_ready(self, object_id: ObjectID) -> bool:
         entry = self.memory_store.get_entry(object_id)

@@ -411,6 +411,10 @@ class HeadService:
             if timer is not None:
                 timer.cancel()
             directory.unsubscribe_location(oid, on_location)
+            mem_cb = state.get("mem_cb")
+            core = self._cluster.core_worker
+            if mem_cb is not None and core is not None:
+                core.memory_store.cancel_get_async(oid, mem_cb)
             reply(node_bin)
 
         def on_location(node_id):
@@ -422,8 +426,9 @@ class HeadService:
         directory.subscribe_location(oid, on_location)
         core = self._cluster.core_worker
         if core is not None and head is not None:
-            core.memory_store.get_async(
-                oid, lambda _entry: finish(head.node_id.binary()))
+            mem_cb = lambda _entry: finish(head.node_id.binary())  # noqa: E731
+            state["mem_cb"] = mem_cb
+            core.memory_store.get_async(oid, mem_cb)
         if not done.is_set():
             timer = threading.Timer(timeout, lambda: finish(None))
             timer.daemon = True

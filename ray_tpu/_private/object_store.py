@@ -126,6 +126,21 @@ class MemoryStore:
                 return
         cb(e)
 
+    def cancel_get_async(self, object_id: ObjectID,
+                         cb: Callable[[_Entry], None]):
+        """Deregister a pending get_async callback (no-op if it already
+        fired) — callers that stop waiting must not leak closures."""
+        with self._lock:
+            cbs = self._get_callbacks.get(object_id)
+            if cbs is None:
+                return
+            try:
+                cbs.remove(cb)
+            except ValueError:
+                return
+            if not cbs:
+                del self._get_callbacks[object_id]
+
     def delete(self, object_id: ObjectID):
         with self._lock:
             self._entries.pop(object_id, None)

@@ -248,8 +248,10 @@ class ProcessWorker:
         import os
         import ray_tpu
         env = dict(os.environ)
+        # Directory CONTAINING the ray_tpu package (…/ray_tpu/__init__.py
+        # -> two dirnames up), so the child can import it from any cwd.
         pkg_root = os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(ray_tpu.__file__))))
+            os.path.abspath(ray_tpu.__file__)))
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
         self._proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_main",

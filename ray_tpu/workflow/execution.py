@@ -1,0 +1,323 @@
+"""Workflow execution: DAG build, checkpointed step tasks, recovery.
+
+Parity: reference ``python/ray/workflow/step_executor.py`` (steps run as
+tasks, outputs checkpointed before downstream consumption, continuation
+steps — a step returning another step — recorded so recovery never
+re-runs a finished step) and ``recovery.py`` (resume walks the durable
+step log instead of user code).
+
+Design: every step is persisted (function, args with ``StepRef``
+placeholders, dep list) BEFORE execution, so the durable log alone can
+finish the workflow after a crash.  Step execution itself is idempotent:
+if the output checkpoint exists the step is skipped — which is the whole
+recovery story.  Top-level DAG fan-out runs as parallel ``ray_tpu``
+tasks ordered by upstream refs; continuations execute inline in the
+parent step's task.
+"""
+
+from __future__ import annotations
+
+import itertools
+import pickle
+import threading
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+import ray_tpu
+from ray_tpu.workflow.storage import (
+    WorkflowStatus, WorkflowStorage, default_base)
+
+
+class StepRef:
+    """Placeholder for an upstream step's output inside persisted args."""
+
+    __slots__ = ("step_id",)
+
+    def __init__(self, step_id: str):
+        self.step_id = step_id
+
+    def __repr__(self):
+        return f"StepRef({self.step_id})"
+
+
+class StepNode:
+    """One node of a workflow DAG (unexecuted)."""
+
+    def __init__(self, fn, args: tuple, kwargs: dict, name: str = "",
+                 max_retries: int = 0):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.name = name or getattr(fn, "__name__", "step")
+        self.max_retries = max_retries
+        self.step_id: Optional[str] = None   # assigned at persist time
+
+    # ---- public (reference Workflow.run / run_async) --------------------
+    def run(self, workflow_id: Optional[str] = None) -> Any:
+        return ray_tpu.get(self.run_async(workflow_id))
+
+    def run_async(self, workflow_id: Optional[str] = None):
+        workflow_id = workflow_id or f"workflow-{uuid.uuid4().hex[:12]}"
+        storage = WorkflowStorage(workflow_id)
+        _persist_dag(storage, self)
+        storage.save_workflow(self.step_id, WorkflowStatus.RUNNING)
+        return _launch(storage, self.step_id, final=True)
+
+
+def _collect_deps(obj, deps: List["StepNode"]):
+    """Recursively swap StepNodes for StepRefs in an args structure,
+    collecting the dependency nodes (top-level containers only — a node
+    hidden inside an arbitrary object is not discoverable)."""
+    if isinstance(obj, StepNode):
+        deps.append(obj)
+        return StepRef(obj.step_id)
+    if isinstance(obj, list):
+        return [_collect_deps(x, deps) for x in obj]
+    if isinstance(obj, tuple):
+        return tuple(_collect_deps(x, deps) for x in obj)
+    if isinstance(obj, dict):
+        return {k: _collect_deps(v, deps) for k, v in obj.items()}
+    return obj
+
+
+def _persist_dag(storage: WorkflowStorage, entry: StepNode,
+                 id_prefix: str = ""):
+    """Assign stable step ids (postorder, name + counter) and write every
+    step's function/args/deps to storage."""
+    counter = itertools.count()
+    ordered: List[StepNode] = []
+
+    def visit(node: StepNode):
+        if node.step_id is not None:
+            return
+        node.step_id = f"{id_prefix}{next(counter):04d}-{node.name}"
+        for a in _iter_nodes(node.args) + _iter_nodes(node.kwargs):
+            visit(a)
+        ordered.append(node)
+
+    visit(entry)
+    for node in ordered:
+        deps: List[StepNode] = []
+        swapped_args = _collect_deps(node.args, deps)
+        swapped_kwargs = _collect_deps(node.kwargs, deps)
+        blob = pickle.dumps((swapped_args, swapped_kwargs), protocol=5)
+        storage.save_step(node.step_id, node.fn, blob, node.name,
+                          sorted({d.step_id for d in deps}),
+                          max_retries=node.max_retries)
+
+
+def _iter_nodes(obj) -> List[StepNode]:
+    out: List[StepNode] = []
+
+    def walk(x):
+        if isinstance(x, StepNode):
+            out.append(x)
+        elif isinstance(x, (list, tuple)):
+            for y in x:
+                walk(y)
+        elif isinstance(x, dict):
+            for y in x.values():
+                walk(y)
+
+    walk(obj)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Execution
+# ---------------------------------------------------------------------------
+
+@ray_tpu.remote
+def _step_task(base: str, workflow_id: str, step_id: str, final: bool,
+               *_ordering_deps):
+    """One checkpointed step as a framework task.  ``_ordering_deps`` are
+    upstream step-task refs — consumed only for scheduling order; the
+    actual values come from the durable output checkpoints."""
+    storage = WorkflowStorage(workflow_id, base)
+    try:
+        value = _run_step(storage, step_id)
+    except Exception:
+        storage.set_status(WorkflowStatus.RESUMABLE)
+        raise
+    if final:
+        storage.set_status(WorkflowStatus.SUCCESSFUL)
+    return value
+
+
+def _launch(storage: WorkflowStorage, entry_step: str, final: bool):
+    """Submit the DAG rooted at ``entry_step`` as parallel tasks in
+    dependency order; returns the entry step's ref."""
+    refs: Dict[str, Any] = {}
+
+    def submit(step_id: str):
+        if step_id in refs:
+            return refs[step_id]
+        meta = storage.step_meta(step_id) or {}
+        dep_refs = [submit(d) for d in meta.get("deps", [])]
+        refs[step_id] = _step_task.remote(
+            storage.base, storage.workflow_id, step_id,
+            final and step_id == entry_step, *dep_refs)
+        return refs[step_id]
+
+    return submit(entry_step)
+
+
+def _resolve(storage: WorkflowStorage, obj):
+    if isinstance(obj, StepRef):
+        return _run_step(storage, obj.step_id)
+    if isinstance(obj, list):
+        return [_resolve(storage, x) for x in obj]
+    if isinstance(obj, tuple):
+        return tuple(_resolve(storage, x) for x in obj)
+    if isinstance(obj, dict):
+        return {k: _resolve(storage, v) for k, v in obj.items()}
+    return obj
+
+
+def _run_step(storage: WorkflowStorage, step_id: str) -> Any:
+    """Idempotent recursive step execution from the durable log — THE
+    recovery primitive.  Output checkpoint present -> done.  A recorded
+    continuation is resumed instead of re-running the parent's body."""
+    if storage.has_output(step_id):
+        return storage.load_output(step_id)
+    meta = storage.step_meta(step_id) or {}
+    cont = meta.get("continuation")
+    if cont is not None:
+        value = _run_step(storage, cont)
+        storage.save_output(step_id, value)
+        return value
+    fn = storage.load_step_fn(step_id)
+    args, kwargs = pickle.loads(storage.load_step_args(step_id))
+    args = _resolve(storage, args)
+    kwargs = _resolve(storage, kwargs)
+    storage.update_step_meta(step_id, state="RUNNING")
+    retries = int(meta.get("max_retries", 0))
+    attempt = 0
+    while True:
+        try:
+            value = fn(*args, **kwargs)
+            break
+        except Exception:
+            attempt += 1
+            if attempt > retries:
+                storage.update_step_meta(step_id, state="FAILED")
+                raise
+    if isinstance(value, StepNode):
+        # Continuation: persist its sub-DAG under this step's namespace,
+        # record the pointer BEFORE running it (so recovery resumes the
+        # continuation instead of re-running this step's body), then
+        # execute it inline.
+        _persist_dag(storage, value, id_prefix=f"{step_id}.")
+        storage.update_step_meta(step_id, continuation=value.step_id)
+        value = _run_step(storage, value.step_id)
+    storage.save_output(step_id, value)
+    return value
+
+
+# ---------------------------------------------------------------------------
+# Recovery
+# ---------------------------------------------------------------------------
+
+def resume_workflow(workflow_id: str, base: Optional[str] = None):
+    """Resume a crashed/failed workflow from its durable log; returns a
+    ref on the final result.  Finished steps are served from their
+    checkpoints — only the missing suffix of the DAG re-executes."""
+    storage = WorkflowStorage(workflow_id, base or default_base())
+    meta = storage.load_workflow()
+    if meta is None:
+        raise ValueError(f"No workflow record for {workflow_id!r}")
+    storage.set_status(WorkflowStatus.RUNNING)
+    return _launch(storage, meta["entry_step"], final=True)
+
+
+# ---------------------------------------------------------------------------
+# Virtual actors (durable actors)
+# ---------------------------------------------------------------------------
+
+class VirtualActorClass:
+    """Parity: reference ``virtual_actor_class.py`` — a class whose
+    instances live in workflow storage: state is checkpointed after every
+    non-readonly method, so the actor survives any process death."""
+
+    def __init__(self, cls):
+        self._cls = cls
+
+    def get_or_create(self, actor_id: str, *args, **kwargs) -> "VirtualActor":
+        storage = WorkflowStorage(actor_id)
+        if not storage.has_actor(actor_id):
+            instance = self._cls(*args, **kwargs)
+            storage.save_actor_class(actor_id, self._cls)
+            storage.save_actor_state(actor_id, _actor_state(instance), 0)
+            storage.save_workflow("", WorkflowStatus.RUNNING)
+        return VirtualActor(actor_id, storage)
+
+
+class VirtualActor:
+    """Handle on a durable actor; method calls run through
+    ``handle.<method>.run(...)`` / ``.run_async(...)``."""
+
+    _locks: Dict[str, threading.Lock] = {}
+    _locks_guard = threading.Lock()
+
+    def __init__(self, actor_id: str, storage: WorkflowStorage):
+        self._actor_id = actor_id
+        self._storage = storage
+        self._cls = storage.load_actor_class(actor_id)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if not callable(getattr(self._cls, name, None)):
+            raise AttributeError(
+                f"virtual actor {self._actor_id!r} has no method {name!r}")
+        return _VirtualMethod(self, name)
+
+    def _lock(self) -> threading.Lock:
+        with VirtualActor._locks_guard:
+            return VirtualActor._locks.setdefault(
+                self._actor_id, threading.Lock())
+
+    def _call(self, method: str, args, kwargs, readonly: bool) -> Any:
+        with self._lock():
+            state, seq = self._storage.load_actor_state(self._actor_id)
+            instance = object.__new__(self._cls)
+            _restore_state(instance, state)
+            result = getattr(instance, method)(*args, **kwargs)
+            if not readonly:
+                self._storage.save_actor_state(
+                    self._actor_id, _actor_state(instance), seq + 1)
+            return result
+
+
+class _VirtualMethod:
+    def __init__(self, actor: VirtualActor, name: str):
+        self._actor = actor
+        self._name = name
+        self._readonly = getattr(
+            getattr(actor._cls, name), "_workflow_readonly", False)
+
+    def run(self, *args, **kwargs) -> Any:
+        return self._actor._call(self._name, args, kwargs, self._readonly)
+
+    def run_async(self, *args, **kwargs):
+        @ray_tpu.remote
+        def _invoke(actor_id, name, a, kw, ro, base):
+            storage = WorkflowStorage(actor_id, base)
+            return VirtualActor(actor_id, storage)._call(name, a, kw, ro)
+
+        return _invoke.remote(self._actor._actor_id, self._name, args,
+                              kwargs, self._readonly,
+                              self._actor._storage.base)
+
+
+def _actor_state(instance) -> Any:
+    if hasattr(instance, "__getstate__"):
+        return instance.__getstate__()
+    return dict(instance.__dict__)
+
+
+def _restore_state(instance, state):
+    if hasattr(instance, "__setstate__"):
+        instance.__setstate__(state)
+    else:
+        instance.__dict__.update(state)
