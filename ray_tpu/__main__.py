@@ -1,0 +1,6 @@
+"""``python -m ray_tpu <command>`` — the CLI entry
+(reference: the installed ``ray`` console script)."""
+
+from ray_tpu.scripts.cli import main
+
+raise SystemExit(main())

@@ -82,11 +82,11 @@ class Cluster:
             self._raylets.append(raylet)
         self.gcs.register_raylet(raylet)
 
-    def start_head_service(self):
+    def start_head_service(self, port: int = 0):
         """Start (once) the wire front that NodeHost processes join."""
         if self.head_service is None:
             from ray_tpu._private.head_service import HeadService
-            self.head_service = HeadService(self)
+            self.head_service = HeadService(self, port=port)
         return self.head_service.address
 
     def add_remote_node(self, num_cpus: float = 1, num_tpus: float = 0,
@@ -109,18 +109,15 @@ class Cluster:
         import time
         import uuid
 
-        import ray_tpu
+        from ray_tpu._private.runtime_env import framework_import_root
         host, port = self.start_head_service()
         total = self._assemble_totals(num_cpus, num_tpus, num_gpus, memory,
                                       object_store_memory, resources)
         name = node_name or f"remote-{uuid.uuid4().hex[:8]}"
         reg_token = uuid.uuid4().hex
         env = dict(os.environ)
-        # Directory CONTAINING the ray_tpu package (…/ray_tpu/__init__.py
-        # -> two dirnames up), so the child can import it from any cwd.
-        pkg_root = os.path.dirname(os.path.dirname(
-            os.path.abspath(ray_tpu.__file__)))
-        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        env["PYTHONPATH"] = framework_import_root() + os.pathsep + \
+            env.get("PYTHONPATH", "")
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.node_host",
              "--head", f"{host}:{port}",

@@ -73,16 +73,17 @@ def execute_task(spec: TaskSpec, node, core_worker, actor_instance=None):
     t0 = time.monotonic()
     try:
         args, kwargs = _split_args(resolve_args(spec, node, core_worker))
-        if spec.task_type == TaskType.ACTOR_CREATION_TASK:
-            fn = core_worker.function_manager.load(spec.function_id)
-            instance = fn(*args, **kwargs)
-            return True, instance
-        elif spec.task_type == TaskType.ACTOR_TASK:
-            method = getattr(actor_instance, spec.actor_method_name)
-            result = method(*args, **kwargs)
-        else:
-            fn = core_worker.function_manager.load(spec.function_id)
-            result = fn(*args, **kwargs)
+        with _applied_runtime_env(spec, node):
+            if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                fn = core_worker.function_manager.load(spec.function_id)
+                instance = fn(*args, **kwargs)
+                return True, instance
+            elif spec.task_type == TaskType.ACTOR_TASK:
+                method = getattr(actor_instance, spec.actor_method_name)
+                result = method(*args, **kwargs)
+            else:
+                fn = core_worker.function_manager.load(spec.function_id)
+                result = fn(*args, **kwargs)
         store_returns(spec, result, node, core_worker)
         return True, None
     except Exception as e:  # noqa: BLE001 — user exceptions cross the boundary
@@ -91,6 +92,30 @@ def execute_task(spec: TaskSpec, node, core_worker, actor_instance=None):
     finally:
         worker_context.set_context(prev)
         core_worker.record_task_metric(spec, time.monotonic() - t0)
+
+
+_env_ctx_cache: dict = {}
+_env_ctx_lock = threading.Lock()
+
+
+def _applied_runtime_env(spec: TaskSpec, node):
+    """Thread-mode runtime-env application around the task body (process
+    workers get the env injected at spawn instead).  Materialized
+    contexts are cached per env hash (uri_cache.py parity)."""
+    import contextlib
+
+    from ray_tpu._private import runtime_env as runtime_env_mod
+    renv = spec.runtime_env
+    if not renv:
+        return contextlib.nullcontext()
+    h = renv.get("_hash") or runtime_env_mod.env_hash(renv)
+    with _env_ctx_lock:
+        env_ctx = _env_ctx_cache.get(h)
+    if env_ctx is None:
+        env_ctx = runtime_env_mod.materialize(renv, node.cluster.gcs.kv)
+        with _env_ctx_lock:
+            _env_ctx_cache[h] = env_ctx
+    return runtime_env_mod.applied(env_ctx)
 
 
 class _KwMark:

@@ -1,0 +1,126 @@
+"""Head daemon: ``python -m ray_tpu._private.head_main`` — the
+operator-facing cluster entry.
+
+Parity: reference head startup (``python/ray/_private/node.py:1064``
+``start_head_processes``: GCS + raylet + monitor + job machinery in one
+bring-up, driven by ``ray start --head``, ``scripts.py``).  Here one
+process hosts the GCS, the head raylet, the wire service worker-hosts
+join, and the JobManager; the CLI talks to all of it over the framed
+RPC.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import threading
+
+
+DEFAULT_ADDRESS_FILE = "/tmp/ray_tpu/head_address"
+
+
+def register_operator_handlers(cluster, job_manager):
+    """Expose job + status surfaces on the head's RPC server (reference:
+    the dashboard job REST head + ``ray status``'s GCS queries)."""
+    from dataclasses import asdict
+
+    from ray_tpu._private import runtime_env as runtime_env_mod
+
+    server = cluster.head_service.server
+
+    def handle_submit(payload):
+        runtime_env = dict(payload.get("runtime_env") or {})
+        zip_blob = payload.get("working_dir_zip")
+        if zip_blob:
+            # Client-side packaged working_dir: store into the KV and
+            # reference it by URI (packaging.py upload parity).
+            import hashlib
+            digest = hashlib.sha256(zip_blob).hexdigest()[:20]
+            cluster.gcs.kv.put(runtime_env_mod._PKG_PREFIX + digest.encode(),
+                               zip_blob, overwrite=False)
+            runtime_env["working_dir"] = f"pkg://{digest}"
+        return job_manager.submit_job(
+            payload["entrypoint"], runtime_env=runtime_env or None,
+            submission_id=payload.get("submission_id"),
+            metadata=payload.get("metadata"))
+
+    def handle_cluster_status(_payload):
+        nodes = []
+        for node_id, info in \
+                cluster.gcs.node_manager.get_all_node_info().items():
+            nodes.append({"node_id": node_id.hex(),
+                          "name": info.get("node_name", ""),
+                          "state": info.get("state"),
+                          "resources": info.get("resources", {})})
+        view = cluster.gcs.resource_manager.view
+        return {
+            "nodes": nodes,
+            "total": view.total_cluster_resources(),
+            "available": view.available_cluster_resources(),
+            "jobs": [asdict(j) for j in job_manager.list_jobs()],
+        }
+
+    server.register("submit_job", handle_submit)
+    server.register("job_status", job_manager.get_job_status)
+    server.register("job_info",
+                    lambda sid: (lambda i: None if i is None else asdict(i))(
+                        job_manager.get_job_info(sid)))
+    server.register("job_logs", job_manager.get_job_logs)
+    server.register("list_jobs",
+                    lambda _p: [asdict(j) for j in job_manager.list_jobs()])
+    server.register("stop_job", job_manager.stop_job)
+    server.register("cluster_status", handle_cluster_status)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="ray_tpu.head")
+    parser.add_argument("--port", type=int, default=0,
+                        help="wire-service port (0 = ephemeral)")
+    parser.add_argument("--num-cpus", type=float, default=None)
+    parser.add_argument("--num-tpus", type=float, default=None)
+    parser.add_argument("--resources", default="{}",
+                        help="JSON dict of extra head resources")
+    parser.add_argument("--address-file", default=DEFAULT_ADDRESS_FILE,
+                        help="where to write host:port for the CLI")
+    parser.add_argument("--system-config", default="")
+    args = parser.parse_args(argv)
+
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.job_submission import JobManager
+
+    system_config = json.loads(args.system_config) \
+        if args.system_config else None
+    ray_tpu.init(num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+                 resources=json.loads(args.resources),
+                 _system_config=system_config)
+    cluster = global_worker().cluster
+    host, port = cluster.start_head_service(port=args.port)
+    job_manager = JobManager(cluster)
+    register_operator_handlers(cluster, job_manager)
+
+    stop = threading.Event()
+    cluster.head_service.server.register(
+        "shutdown_head", lambda _p: (stop.set(), True)[1])
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_a: stop.set())
+
+    os.makedirs(os.path.dirname(args.address_file), exist_ok=True)
+    with open(args.address_file, "w") as f:
+        f.write(f"{host}:{port}")
+    print(f"ray_tpu head listening on {host}:{port} "
+          f"(address file: {args.address_file})", flush=True)
+    stop.wait()
+    job_manager.shutdown()
+    ray_tpu.shutdown()
+    try:
+        os.unlink(args.address_file)
+    except OSError:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -236,12 +236,12 @@ class HeadService:
     the object directory, inline return delivery, and hub-relayed object
     fetches."""
 
-    def __init__(self, cluster):
+    def __init__(self, cluster, port: int = 0):
         self._cluster = cluster
         self._lock = threading.Lock()
         self._proxies: Dict[NodeID, RemoteNodeProxy] = {}
         self._reg_tokens: Dict[str, NodeID] = {}
-        self.server = RpcServer(name="head")
+        self.server = RpcServer(port=port, name="head")
         s = self.server
         s.register("register_node", self._handle_register_node)
         s.register("unregister_node", self._handle_unregister_node)

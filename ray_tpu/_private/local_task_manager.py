@@ -129,7 +129,8 @@ class LocalTaskManager:
                 if not self._dispatch_queue:
                     return
                 spec, reply = self._dispatch_queue[0]
-                worker = self._raylet.worker_pool.pop_worker()
+                worker = self._raylet.worker_pool.pop_worker(
+                    runtime_env=spec.runtime_env)
                 if worker is None:
                     return  # no worker slot; retried when one frees up
                 self._dispatch_queue.popleft()

@@ -1,0 +1,248 @@
+"""Per-task/actor runtime environments.
+
+Parity: reference ``python/ray/_private/runtime_env/`` — validation
+(``validation.py``), working-dir/py-modules packaging into the GCS KV
+(``packaging.py``: zip + content-hash URI), and materialization on the
+executing node (``working_dir.py``, ``py_modules.py``; driven by the
+raylet's AgentManager ``GetOrCreateRuntimeEnv``,
+``src/ray/raylet/agent_manager.h:49``).  The worker pool keys workers by
+the env's stable hash (``src/ray/raylet/worker_pool.h:428``).
+
+Supported fields: ``env_vars`` (dict), ``working_dir`` (local directory,
+packaged + materialized), ``py_modules`` (list of local dirs, packaged +
+put on the import path).  ``pip``/``conda`` are validated but rejected —
+this image has no network egress; environments must be pre-baked.
+
+Isolation depends on the worker mode: ``process`` workers get env vars /
+cwd / import path injected at spawn (full isolation); ``thread`` workers
+apply env vars around the task body under a global lock and extend
+``sys.path`` (an approximation — use process mode for real isolation).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import io
+import json
+import os
+import threading
+import zipfile
+from typing import Dict, List, Optional
+
+_PKG_PREFIX = b"pkg:"
+_EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+
+class RuntimeEnvError(ValueError):
+    pass
+
+
+def validate(spec: dict) -> dict:
+    """Normalize field types; reject the unsupported."""
+    out = {}
+    for key, value in (spec or {}).items():
+        if key == "env_vars":
+            if not isinstance(value, dict) or not all(
+                    isinstance(k, str) and isinstance(v, str)
+                    for k, v in value.items()):
+                raise RuntimeEnvError("env_vars must be Dict[str, str]")
+            out["env_vars"] = dict(value)
+        elif key in ("working_dir", "py_modules"):
+            out[key] = value
+        elif key in ("pip", "conda"):
+            raise RuntimeEnvError(
+                f"runtime_env[{key!r}] is not supported: no network egress; "
+                "bake dependencies into the image")
+        else:
+            raise RuntimeEnvError(f"Unknown runtime_env field {key!r}")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Packaging (packaging.py parity: zip -> content-hash URI in the GCS KV)
+# ---------------------------------------------------------------------------
+
+def _zip_dir(path: str) -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, dirs, files in os.walk(path):
+            dirs[:] = [d for d in dirs if d not in _EXCLUDE_DIRS]
+            for fname in sorted(files):
+                full = os.path.join(root, fname)
+                zf.write(full, os.path.relpath(full, path))
+    return buf.getvalue()
+
+
+def _dir_signature(path: str) -> str:
+    """Cheap content fingerprint (relpath, size, mtime of every file) —
+    walking metadata costs microseconds where re-zipping costs the full
+    compression; lets hot submission loops skip repackaging."""
+    entries = []
+    for root, dirs, files in os.walk(path):
+        dirs[:] = [d for d in dirs if d not in _EXCLUDE_DIRS]
+        for fname in sorted(files):
+            full = os.path.join(root, fname)
+            try:
+                st = os.stat(full)
+            except OSError:
+                continue
+            entries.append((os.path.relpath(full, path),
+                            st.st_size, st.st_mtime_ns))
+    return hashlib.sha256(repr(entries).encode()).hexdigest()
+
+
+_package_cache: Dict[tuple, str] = {}
+_package_cache_lock = threading.Lock()
+
+
+def package_dir(path: str, kv) -> str:
+    """Zip a local directory into the GCS KV; returns its content URI.
+    Repeat submissions of an unchanged directory hit a signature cache
+    instead of re-zipping (reference packaging.py caches per-URI)."""
+    if not os.path.isdir(path):
+        raise RuntimeEnvError(f"not a directory: {path!r}")
+    key = (os.path.abspath(path), _dir_signature(path), id(kv))
+    with _package_cache_lock:
+        cached = _package_cache.get(key)
+    if cached is not None:
+        return cached
+    blob = _zip_dir(path)
+    digest = hashlib.sha256(blob).hexdigest()[:20]
+    uri = f"pkg://{digest}"
+    kv.put(_PKG_PREFIX + digest.encode(), blob, overwrite=False)
+    with _package_cache_lock:
+        _package_cache[key] = uri
+    return uri
+
+
+def framework_import_root() -> str:
+    """Directory CONTAINING the ray_tpu package — prepend to a child
+    process's PYTHONPATH so it can ``import ray_tpu`` from any cwd.
+    The single definition for every process-spawn site."""
+    import ray_tpu
+    return os.path.dirname(os.path.dirname(
+        os.path.abspath(ray_tpu.__file__)))
+
+
+def normalize(spec: Optional[dict], kv) -> Optional[dict]:
+    """Validate + package local paths into URIs + stamp the stable hash
+    the worker pool keys on.  Call once at submission time."""
+    if not spec:
+        return None
+    out = validate(spec)
+    wd = out.get("working_dir")
+    if wd and not str(wd).startswith("pkg://"):
+        out["working_dir"] = package_dir(wd, kv)
+    mods = out.get("py_modules")
+    if mods:
+        out["py_modules"] = [
+            m if str(m).startswith("pkg://") else package_dir(m, kv)
+            for m in mods]
+    out["_hash"] = env_hash(out)
+    return out
+
+
+def env_hash(spec: Optional[dict]) -> str:
+    if not spec:
+        return ""
+    canon = {k: v for k, v in spec.items() if k != "_hash"}
+    return hashlib.sha256(
+        json.dumps(canon, sort_keys=True).encode()).hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# Materialization (working_dir.py / py_modules.py parity)
+# ---------------------------------------------------------------------------
+
+class RuntimeEnvContext:
+    """A materialized environment: what a worker needs at exec time."""
+
+    def __init__(self, env_vars: Dict[str, str], cwd: Optional[str],
+                 import_paths: List[str]):
+        self.env_vars = env_vars
+        self.cwd = cwd
+        self.import_paths = import_paths
+
+    def spawn_env(self, base: Optional[dict] = None) -> Dict[str, str]:
+        """Env dict for a process-mode worker spawn."""
+        env = dict(base if base is not None else os.environ)
+        env.update(self.env_vars)
+        if self.import_paths:
+            extra = os.pathsep.join(self.import_paths)
+            env["PYTHONPATH"] = extra + os.pathsep + env.get("PYTHONPATH", "")
+        if self.cwd:
+            env["RAY_TPU_WORKER_CWD"] = self.cwd
+        return env
+
+
+def _extract_uri(uri: str, kv, dest_root: str) -> str:
+    digest = uri[len("pkg://"):]
+    dest = os.path.join(dest_root, digest)
+    marker = os.path.join(dest, ".materialized")
+    if os.path.exists(marker):
+        return dest
+    blob = kv.get(_PKG_PREFIX + digest.encode())
+    if blob is None:
+        raise RuntimeEnvError(f"package {uri} not found in GCS KV")
+    os.makedirs(dest, exist_ok=True)
+    with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+        zf.extractall(dest)
+    open(marker, "w").close()
+    return dest
+
+
+def materialize(spec: Optional[dict], kv,
+                dest_root: Optional[str] = None) -> RuntimeEnvContext:
+    """Download + extract the env's packages on this node; idempotent
+    per content hash (uri_cache.py parity)."""
+    if not spec:
+        return RuntimeEnvContext({}, None, [])
+    from ray_tpu._private.config import get_config
+    dest_root = dest_root or os.path.join(get_config().temp_dir,
+                                          "runtime_env")
+    cwd = None
+    import_paths: List[str] = []
+    wd = spec.get("working_dir")
+    if wd:
+        cwd = _extract_uri(wd, kv, dest_root)
+        import_paths.append(cwd)
+    for uri in spec.get("py_modules") or []:
+        import_paths.append(_extract_uri(uri, kv, dest_root))
+    return RuntimeEnvContext(dict(spec.get("env_vars") or {}), cwd,
+                             import_paths)
+
+
+# ---------------------------------------------------------------------------
+# Thread-mode application (approximation; process mode is the real path)
+# ---------------------------------------------------------------------------
+
+_env_lock = threading.Lock()
+
+
+@contextlib.contextmanager
+def applied(ctx: RuntimeEnvContext):
+    """Apply env vars (global, locked) and import paths around a task
+    body in a thread-mode worker."""
+    import sys
+    if not ctx.env_vars and not ctx.import_paths:
+        yield
+        return
+    with _env_lock:
+        saved = {k: os.environ.get(k) for k in ctx.env_vars}
+        os.environ.update(ctx.env_vars)
+        added = [p for p in ctx.import_paths if p not in sys.path]
+        sys.path[:0] = added
+        try:
+            yield
+        finally:
+            for p in added:
+                try:
+                    sys.path.remove(p)
+                except ValueError:
+                    pass
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v

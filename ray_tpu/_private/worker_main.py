@@ -154,6 +154,12 @@ def main(argv=None) -> int:
     parser.add_argument("--port", type=int, required=True)
     parser.add_argument("--worker-id", required=True)
     args = parser.parse_args(argv)
+    # Runtime-env working_dir: the spawner materialized it and points us
+    # at it (reference: worker started inside its env's directory).
+    cwd = os.environ.get("RAY_TPU_WORKER_CWD")
+    if cwd:
+        os.chdir(cwd)
+        sys.path.insert(0, cwd)
     runtime = _WorkerRuntime(args.host, args.port, args.worker_id)
     runtime.run()
     return 0

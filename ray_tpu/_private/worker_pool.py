@@ -49,6 +49,9 @@ class WorkerState:
 class Worker:
     """One executor thread; may become dedicated to an actor."""
 
+    runtime_env_hash = ""   # thread workers are universal: the env is
+                            # applied per task in the executor
+
     def __init__(self, pool: "WorkerPool", node):
         self.worker_id = WorkerID.from_random()
         self.node = node
@@ -232,7 +235,7 @@ class ProcessWorker:
     pushes tasks over the child's RPC server and stores the returned
     serialized values with owner semantics."""
 
-    def __init__(self, pool: "WorkerPool", node):
+    def __init__(self, pool: "WorkerPool", node, runtime_env=None):
         self.worker_id = WorkerID.from_random()
         self.node = node
         self.node_id = node.node_id
@@ -246,13 +249,18 @@ class ProcessWorker:
         self._client = None
         host = pool.host_service()
         import os
-        import ray_tpu
+        from ray_tpu._private import runtime_env as runtime_env_mod
+        self.runtime_env_hash = (runtime_env or {}).get("_hash", "")
         env = dict(os.environ)
-        # Directory CONTAINING the ray_tpu package (…/ray_tpu/__init__.py
-        # -> two dirnames up), so the child can import it from any cwd.
-        pkg_root = os.path.dirname(os.path.dirname(
-            os.path.abspath(ray_tpu.__file__)))
-        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        if runtime_env:
+            # Materialize working_dir/py_modules host-side, inject env
+            # vars + import paths + cwd at spawn (worker_pool.h:428:
+            # workers are started FOR an env and keyed by its hash).
+            ctx = runtime_env_mod.materialize(
+                runtime_env, node.cluster.gcs.kv)
+            env = ctx.spawn_env(env)
+        env["PYTHONPATH"] = runtime_env_mod.framework_import_root() + \
+            os.pathsep + env.get("PYTHONPATH", "")
         self._proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_main",
              "--host", "127.0.0.1", "--port", str(host.port),
@@ -441,9 +449,9 @@ class WorkerPool:
                 self._host_service = WorkerHostService(self._node)
             return self._host_service
 
-    def _new_worker(self):
+    def _new_worker(self, runtime_env=None):
         if self._process_mode:
-            return ProcessWorker(self, self._node)
+            return ProcessWorker(self, self._node, runtime_env=runtime_env)
         return Worker(self, self._node)
 
     def prestart_workers(self, n: int):
@@ -455,18 +463,40 @@ class WorkerPool:
                 self._all[w.worker_id] = w
                 self._idle.append(w)
 
-    def pop_worker(self) -> Optional[Worker]:
+    def pop_worker(self, runtime_env=None) -> Optional[Worker]:
         """Lease an idle worker, starting one if under the cap
-        (WorkerPool::PopWorker, worker_pool.h:338)."""
+        (WorkerPool::PopWorker, worker_pool.h:338).  In process mode
+        workers are keyed by runtime-env hash (worker_pool.h:428);
+        thread workers are universal (env applied per task)."""
+        want_hash = (runtime_env or {}).get("_hash", "") \
+            if self._process_mode else ""
         with self._lock:
+            kept = []
+            found = None
             while self._idle:
                 w = self._idle.pop()
-                if w.state == WorkerState.IDLE:
-                    w.state = WorkerState.LEASED
-                    self._leased[w.worker_id] = w
-                    return w
+                if w.state != WorkerState.IDLE:
+                    continue
+                if w.runtime_env_hash != want_hash:
+                    kept.append(w)
+                    continue
+                found = w
+                break
+            self._idle.extend(kept)
+            if found is not None:
+                found.state = WorkerState.LEASED
+                self._leased[found.worker_id] = found
+                return found
+            if len(self._all) >= self._max_workers and kept:
+                # At the cap with only mismatched-env idle workers:
+                # evict one to make room (the reference kills an idle
+                # worker rather than starving the new env forever).
+                victim = kept[0]
+                self._idle.remove(victim)
+                self._all.pop(victim.worker_id, None)
+                victim.stop()
             if len(self._all) < self._max_workers:
-                w = self._new_worker()
+                w = self._new_worker(runtime_env=runtime_env)
                 self._all[w.worker_id] = w
                 w.state = WorkerState.LEASED
                 self._leased[w.worker_id] = w

@@ -17,7 +17,8 @@ from ray_tpu._private import worker_context
 from ray_tpu._private.executor import pack_args
 from ray_tpu._private.ids import ActorID
 from ray_tpu._private.task_spec import TaskType, make_spec
-from ray_tpu.remote_function import _resource_dict, resolve_pg_strategy
+from ray_tpu.remote_function import (
+    _normalized_env, _resource_dict, resolve_pg_strategy)
 
 _DEFAULT_ACTOR_OPTIONS = dict(
     num_cpus=None, num_tpus=0, num_gpus=0, memory=0, resources=None,
@@ -167,7 +168,7 @@ class ActorClass:
             max_concurrency=o.get("max_concurrency", 1),
             placement_group_id=pg_id,
             placement_group_bundle_index=bundle_idx,
-            runtime_env=o.get("runtime_env"),
+            runtime_env=_normalized_env(o.get("runtime_env"), w),
             lifetime_resources=lifetime_resources,
         )
         namespace = o.get("namespace")

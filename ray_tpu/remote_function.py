@@ -52,6 +52,15 @@ def resolve_pg_strategy(options: Dict[str, Any], resources: Dict[str, float]):
     return rewritten, "DEFAULT", pg.id, idx
 
 
+def _normalized_env(runtime_env, w):
+    """Package local paths + stamp the pool-keying hash at submit time
+    (packaging.py parity — once per content, deduped in the GCS KV)."""
+    if not runtime_env:
+        return None
+    from ray_tpu._private import runtime_env as runtime_env_mod
+    return runtime_env_mod.normalize(runtime_env, w.cluster.gcs.kv)
+
+
 class RemoteFunction:
     def __init__(self, fn, options: Optional[Dict[str, Any]] = None):
         self._function = fn
@@ -118,7 +127,7 @@ class RemoteFunction:
             retry_exceptions=bool(options.get("retry_exceptions")),
             placement_group_id=pg_id,
             placement_group_bundle_index=bundle_idx,
-            runtime_env=options.get("runtime_env"),
+            runtime_env=_normalized_env(options.get("runtime_env"), w),
         )
         refs = core.submit_task(spec, holders=holders)
         if spec.num_returns == 0:

@@ -1,0 +1,274 @@
+"""ray-tpu CLI: operate the framework without writing a driver.
+
+Parity: reference ``python/ray/scripts/scripts.py`` (``ray
+start/stop/status/submit/...``) + ``dashboard/modules/job/cli.py``
+(``ray job submit/logs/stop/list``), collapsed into one argparse tool:
+
+    python -m ray_tpu start --head [--port 7788] [--num-cpus 8]
+    python -m ray_tpu start --address 127.0.0.1:7788 --num-cpus 4
+    python -m ray_tpu status
+    python -m ray_tpu submit --working-dir . -- python script.py
+    python -m ray_tpu jobs
+    python -m ray_tpu logs <job-id>
+    python -m ray_tpu job-stop <job-id>
+    python -m ray_tpu down
+
+The head address is resolved from ``--address``, then the
+``RAY_TPU_ADDRESS`` env var, then the head's address file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Optional, Tuple
+
+from ray_tpu._private.head_main import DEFAULT_ADDRESS_FILE
+
+
+def _resolve_address(explicit: Optional[str]) -> Tuple[str, int]:
+    addr = explicit or os.environ.get("RAY_TPU_ADDRESS")
+    if not addr:
+        try:
+            with open(DEFAULT_ADDRESS_FILE) as f:
+                addr = f.read().strip()
+        except OSError:
+            raise SystemExit(
+                "no head address: pass --address, set RAY_TPU_ADDRESS, or "
+                "start a head on this machine first "
+                "(`python -m ray_tpu start --head`)")
+    host, _, port = addr.rpartition(":")
+    return host, int(port)
+
+
+def _client(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+    return JobSubmissionClient(_resolve_address(args.address))
+
+
+# ---------------------------------------------------------------------------
+# commands
+# ---------------------------------------------------------------------------
+
+def cmd_start(args) -> int:
+    if args.head:
+        cmd = [sys.executable, "-m", "ray_tpu._private.head_main",
+               "--port", str(args.port),
+               "--resources", args.resources,
+               "--address-file", args.address_file]
+        if args.num_cpus is not None:
+            cmd += ["--num-cpus", str(args.num_cpus)]
+        if args.num_tpus is not None:
+            cmd += ["--num-tpus", str(args.num_tpus)]
+        if args.block:
+            return subprocess.call(cmd)
+        proc = _spawn_daemon(cmd, "head")
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if os.path.exists(args.address_file):
+                with open(args.address_file) as f:
+                    print(f"head started (pid {proc.pid}) at "
+                          f"{f.read().strip()}")
+                return 0
+            if proc.poll() is not None:
+                print("head failed to start", file=sys.stderr)
+                return 1
+            time.sleep(0.1)
+        print("timed out waiting for the head address file",
+              file=sys.stderr)
+        return 1
+    # Worker-host node joining an existing head.
+    host, port = _resolve_address(args.address)
+    resources = json.loads(args.resources)
+    resources.setdefault("CPU", args.num_cpus
+                         if args.num_cpus is not None else 1)
+    if args.num_tpus:
+        resources.setdefault("TPU", args.num_tpus)
+    cmd = [sys.executable, "-m", "ray_tpu._private.node_host",
+           "--head", f"{host}:{port}",
+           "--resources", json.dumps(resources),
+           "--name", args.name]
+    if args.block:
+        return subprocess.call(cmd)
+    proc = _spawn_daemon(cmd, args.name or "node")
+    print(f"worker host started (pid {proc.pid}), joining {host}:{port}")
+    return 0
+
+
+def _spawn_daemon(cmd, tag: str) -> subprocess.Popen:
+    """Detach fully: a daemon must not inherit the CLI's stdio pipes —
+    an inherited pipe keeps the caller's readers blocked long after the
+    CLI exits.  Output goes to a per-daemon log file instead."""
+    log_dir = "/tmp/ray_tpu/logs"
+    os.makedirs(log_dir, exist_ok=True)
+    log_f = open(os.path.join(log_dir, f"{tag}-{int(time.time())}.log"),
+                 "ab")
+    return subprocess.Popen(cmd, start_new_session=True,
+                            stdin=subprocess.DEVNULL,
+                            stdout=log_f, stderr=subprocess.STDOUT)
+
+
+def cmd_status(args) -> int:
+    client = _client(args)
+    try:
+        status = client.cluster_status()
+    finally:
+        client.close()
+    print(f"{'NODE':34} {'STATE':8} RESOURCES")
+    for node in status["nodes"]:
+        res = " ".join(f"{k}={v:g}"
+                       for k, v in sorted(node["resources"].items()))
+        name = node["name"] or node["node_id"][:12]
+        print(f"{name:34} {node['state']:8} {res}")
+    print("\ntotal:    ",
+          {k: round(v, 2) for k, v in sorted(status["total"].items())})
+    print("available:",
+          {k: round(v, 2) for k, v in sorted(status["available"].items())})
+    running = [j for j in status["jobs"] if j["status"] == "RUNNING"]
+    if running:
+        print(f"\n{len(running)} running job(s):",
+              ", ".join(j["submission_id"] for j in running))
+    return 0
+
+
+def cmd_submit(args) -> int:
+    runtime_env = {}
+    if args.working_dir:
+        runtime_env["working_dir"] = args.working_dir
+    for pair in args.env or []:
+        key, _, value = pair.partition("=")
+        runtime_env.setdefault("env_vars", {})[key] = value
+    entrypoint = " ".join(args.entrypoint)
+    if not entrypoint:
+        raise SystemExit("no entrypoint: ray-tpu submit -- python script.py")
+    client = _client(args)
+    try:
+        job_id = client.submit_job(entrypoint,
+                                   runtime_env=runtime_env or None,
+                                   submission_id=args.submission_id)
+        print(f"submitted: {job_id}")
+        if not args.wait:
+            return 0
+        while True:
+            status = client.get_job_status(job_id)
+            if status in ("SUCCEEDED", "FAILED", "STOPPED"):
+                break
+            time.sleep(0.25)
+        sys.stdout.write(client.get_job_logs(job_id))
+        print(f"job {job_id}: {status}")
+        return 0 if status == "SUCCEEDED" else 1
+    finally:
+        client.close()
+
+
+def cmd_jobs(args) -> int:
+    client = _client(args)
+    try:
+        jobs = client.list_jobs()
+    finally:
+        client.close()
+    print(f"{'JOB':26} {'STATUS':10} ENTRYPOINT")
+    for job in jobs:
+        print(f"{job['submission_id']:26} {job['status']:10} "
+              f"{job['entrypoint']}")
+    return 0
+
+
+def cmd_logs(args) -> int:
+    client = _client(args)
+    try:
+        sys.stdout.write(client.get_job_logs(args.job_id))
+    finally:
+        client.close()
+    return 0
+
+
+def cmd_job_stop(args) -> int:
+    client = _client(args)
+    try:
+        ok = client.stop_job(args.job_id)
+    finally:
+        client.close()
+    print("stopped" if ok else "not running")
+    return 0
+
+
+def cmd_down(args) -> int:
+    from ray_tpu.rpc import RpcClient
+    host, port = _resolve_address(args.address)
+    client = RpcClient((host, port))
+    try:
+        client.call("shutdown_head", None, timeout=10.0)
+        print(f"head at {host}:{port} shutting down")
+        return 0
+    finally:
+        client.close()
+
+
+# ---------------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(prog="ray-tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("start", help="start a head or a worker-host node")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address", default=None,
+                   help="head to join (worker-host mode)")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--num-tpus", type=float, default=None)
+    p.add_argument("--resources", default="{}")
+    p.add_argument("--name", default="")
+    p.add_argument("--address-file", default=DEFAULT_ADDRESS_FILE)
+    p.add_argument("--block", action="store_true",
+                   help="run in the foreground")
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("status", help="cluster nodes, resources, jobs")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("submit", help="submit a job: submit -- python x.py")
+    p.add_argument("--address", default=None)
+    p.add_argument("--working-dir", default=None)
+    p.add_argument("--env", action="append", metavar="KEY=VALUE")
+    p.add_argument("--submission-id", default=None)
+    p.add_argument("--no-wait", dest="wait", action="store_false")
+    p.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    p.set_defaults(fn=cmd_submit, wait=True)
+
+    p = sub.add_parser("jobs", help="list jobs")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_jobs)
+
+    p = sub.add_parser("logs", help="print a job's driver log")
+    p.add_argument("job_id")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_logs)
+
+    p = sub.add_parser("job-stop", help="stop a running job")
+    p.add_argument("job_id")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_job_stop)
+
+    p = sub.add_parser("down", help="shut the head down")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_down)
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    entry = list(getattr(args, "entrypoint", []) or [])
+    if entry and entry[0] == "--":
+        args.entrypoint = entry[1:]
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

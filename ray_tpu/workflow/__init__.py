@@ -114,7 +114,8 @@ def resume(workflow_id: str):
 
 
 def resume_all(include_failed: bool = True) -> Dict[str, Any]:
-    """Resume every resumable workflow in storage; id -> result ref."""
+    """Resume every resumable workflow in storage; id -> result ref.
+    Virtual-actor records (no step DAG) are skipped."""
     out = {}
     for wid, status in list_workflows().items():
         if status in (WorkflowStatus.RESUMABLE, WorkflowStatus.RUNNING) or \
@@ -126,17 +127,45 @@ def resume_all(include_failed: bool = True) -> Dict[str, Any]:
     return out
 
 
+@ray_tpu.remote
+def _await_result(base: str, workflow_id: str):
+    """Wait for a live run to reach a verdict, then read the checkpoint
+    — never re-launches steps (a second launch would re-run in-flight
+    side effects concurrently with the first)."""
+    import time as _time
+
+    storage = WorkflowStorage(workflow_id, base)
+    while True:
+        meta = storage.load_workflow() or {}
+        status = meta.get("status")
+        if status == WorkflowStatus.SUCCESSFUL:
+            return storage.load_output(meta["entry_step"])
+        if status in (WorkflowStatus.RESUMABLE, WorkflowStatus.FAILED,
+                      WorkflowStatus.CANCELED):
+            raise RuntimeError(
+                f"workflow {workflow_id!r} ended as {status}; "
+                "use workflow.resume() to re-run it")
+        _time.sleep(0.1)
+
+
 def get_output(workflow_id: str):
-    """Ref on a workflow's final output (finished: served from the
-    checkpoint; unfinished: resumes it)."""
+    """Ref on a workflow's final output.  Finished: served from the
+    checkpoint.  Still running: a waiter tracks the live run (reference
+    semantics — get_output never starts a second execution)."""
     storage = WorkflowStorage(workflow_id)
     meta = storage.load_workflow()
     if meta is None:
         raise ValueError(f"No workflow record for {workflow_id!r}")
+    if not meta.get("entry_step"):
+        raise ValueError(f"{workflow_id!r} is a virtual actor, not a "
+                         "workflow")
     if meta.get("status") == WorkflowStatus.SUCCESSFUL and \
             storage.has_output(meta["entry_step"]):
         return ray_tpu.put(storage.load_output(meta["entry_step"]))
-    return resume_workflow(workflow_id)
+    if meta.get("status") in (WorkflowStatus.RESUMABLE,
+                              WorkflowStatus.FAILED):
+        return resume_workflow(workflow_id)
+    return _await_result.remote(storage.base, workflow_id)
 
 
 def get_status(workflow_id: str) -> Optional[str]:
@@ -151,8 +180,9 @@ def list_all(status_filter: Optional[str] = None) -> Dict[str, str]:
 
 
 def cancel(workflow_id: str):
-    """Best-effort cancel: mark CANCELED; queued steps of this workflow
-    will not re-launch on resume (running steps cannot be preempted)."""
+    """Best-effort cancel: mark CANCELED.  Steps not yet started observe
+    the mark and refuse to run; resume() on a canceled workflow raises
+    (running step bodies cannot be preempted)."""
     WorkflowStorage(workflow_id).set_status(WorkflowStatus.CANCELED)
 
 

