@@ -1,0 +1,215 @@
+"""Runtime scale-envelope benchmark — the BASELINE.md envelope driven
+through the real ``ray_tpu`` API.
+
+Reference: ``benchmarks/single_node/test_single_node.py`` (MAX_ARGS
+10k / MAX_RETURNS 3k / MAX_QUEUED_TASKS 1M / many-get 10k),
+``benchmarks/distributed/test_many_{tasks,actors,pgs}.py``, and
+``python/ray/_private/ray_perf.py`` (task/actor throughput).
+
+Each row prints one JSON line; the final line is the whole envelope.
+``--quick`` shrinks the counts ~10x for smoke runs.  The companion
+``bench.py`` (scheduler kernel on real TPU) is separate — this file
+measures the RUNTIME's envelope on CPU.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def emit(metric, value, unit, **extra):
+    row = {"metric": metric, "value": round(value, 2), "unit": unit}
+    row.update(extra)
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def bench_tasks(n):
+    import ray_tpu
+
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    ray_tpu.get([noop.remote() for _ in range(200)])      # warm
+    t0 = time.monotonic()
+    ray_tpu.get([noop.remote() for _ in range(n)])
+    dt = time.monotonic() - t0
+    return emit("tasks_per_second", n / dt, "tasks/s", n=n)
+
+
+def bench_queued(n, num_blockers):
+    """Queue depth: block every worker slot, pour n tasks into the
+    scheduler queues, measure submission rate, then release and drain."""
+    import tempfile
+
+    import ray_tpu
+
+    gate = os.path.join(tempfile.mkdtemp(), "release")
+
+    @ray_tpu.remote
+    def blocker(gate_path):
+        deadline = time.monotonic() + 600
+        while not os.path.exists(gate_path) and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        return None
+
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    blockers = [blocker.remote(gate) for _ in range(num_blockers)]
+    time.sleep(0.2)
+    t0 = time.monotonic()
+    refs = [noop.remote() for _ in range(n)]
+    submit_dt = time.monotonic() - t0
+    emit("queued_tasks_submit_rate", n / submit_dt, "tasks/s", queued=n)
+    open(gate, "w").close()
+    t0 = time.monotonic()
+    ray_tpu.get(refs)
+    ray_tpu.get(blockers)
+    drain_dt = time.monotonic() - t0
+    return emit("queued_tasks_drained", n, "tasks",
+                drain_rate=round(n / drain_dt, 2))
+
+
+def bench_actors(n):
+    import ray_tpu
+
+    @ray_tpu.remote
+    class Echo:
+        def ping(self, v):
+            return v
+
+    t0 = time.monotonic()
+    actors = [Echo.remote() for _ in range(n)]
+    assert ray_tpu.get([a.ping.remote(i) for i, a in enumerate(actors)],
+                       timeout=600) == list(range(n))
+    dt = time.monotonic() - t0
+    row = emit("actors_created_and_called", n / dt, "actors/s", n=n)
+    for a in actors:
+        ray_tpu.kill(a)
+    return row
+
+
+def bench_pgs(n):
+    import ray_tpu
+    from ray_tpu.util.placement_group import (
+        placement_group, remove_placement_group)
+
+    t0 = time.monotonic()
+    pgs = [placement_group([{"CPU": 0.01}]) for _ in range(n)]
+    for pg in pgs:
+        assert ray_tpu.get(pg.ready(), timeout=120)
+    dt = time.monotonic() - t0
+    row = emit("placement_groups_per_second", n / dt, "pgs/s", n=n)
+    for pg in pgs:
+        remove_placement_group(pg)
+    return row
+
+
+def bench_args(n):
+    import ray_tpu
+
+    @ray_tpu.remote
+    def count(*args):
+        return len(args)
+
+    refs = [ray_tpu.put(i) for i in range(n)]
+    t0 = time.monotonic()
+    got = ray_tpu.get(count.remote(*refs), timeout=600)
+    dt = time.monotonic() - t0
+    assert got == n, got
+    return emit("max_args_single_task", n, "args", seconds=round(dt, 2))
+
+
+def bench_returns(n):
+    import ray_tpu
+
+    @ray_tpu.remote(num_returns=n)
+    def spread():
+        return list(range(n))
+
+    t0 = time.monotonic()
+    refs = spread.remote()
+    values = ray_tpu.get(refs, timeout=600)
+    dt = time.monotonic() - t0
+    assert values == list(range(n))
+    return emit("max_returns_single_task", n, "returns",
+                seconds=round(dt, 2))
+
+
+def bench_get_many(n):
+    import ray_tpu
+    refs = [ray_tpu.put(i) for i in range(n)]
+    t0 = time.monotonic()
+    values = ray_tpu.get(refs, timeout=600)
+    dt = time.monotonic() - t0
+    assert values == list(range(n))
+    return emit("objects_in_one_get", n, "objects", seconds=round(dt, 2))
+
+
+def bench_object_gb(gib):
+    import numpy as np
+
+    import ray_tpu
+    data = np.ones(int(gib * 1024**3), dtype=np.uint8)
+    t0 = time.monotonic()
+    ref = ray_tpu.put(data)
+    put_dt = time.monotonic() - t0
+    t0 = time.monotonic()
+    out = ray_tpu.get(ref)
+    get_dt = time.monotonic() - t0
+    assert out.nbytes == data.nbytes
+    del out, ref, data
+    return emit("large_object_roundtrip", gib, "GiB",
+                put_gbps=round(gib / put_dt, 2),
+                get_gbps=round(gib / get_dt, 2))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true",
+                        help="~10x smaller counts")
+    parser.add_argument("--queued", type=int, default=None,
+                        help="queued-task count (default 1M; quick 20k)")
+    args = parser.parse_args()
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import ray_tpu
+    cpus = 8
+    ray_tpu.init(num_cpus=cpus, _system_config={
+        "scheduler_backend": "native",   # runtime envelope, not kernel
+        "object_store_memory": 4 * 1024**3,
+    })
+
+    quick = args.quick
+    rows = []
+    rows.append(bench_tasks(1_000 if quick else 10_000))
+    rows.append(bench_actors(100 if quick else 1_000))
+    rows.append(bench_pgs(20 if quick else 100))
+    rows.append(bench_args(1_000 if quick else 10_000))
+    rows.append(bench_returns(300 if quick else 3_000))
+    rows.append(bench_get_many(1_000 if quick else 10_000))
+    rows.append(bench_object_gb(0.25 if quick else 1.0))
+    queued = args.queued if args.queued is not None else \
+        (20_000 if quick else 1_000_000)
+    rows.append(bench_queued(queued, num_blockers=cpus))
+
+    print(json.dumps({"metric": "runtime_envelope", "value": len(rows),
+                      "unit": "rows",
+                      "rows": {r["metric"]: {k: v for k, v in r.items()
+                                             if k != "metric"}
+                               for r in rows}}), flush=True)
+    ray_tpu.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,6 +25,7 @@ class Cluster:
     def __init__(self, initialize_head: bool = True,
                  head_node_args: Optional[dict] = None,
                  gcs_storage_path: Optional[str] = None):
+        self._gcs_storage_path = gcs_storage_path
         self.gcs = GcsServer(storage_path=gcs_storage_path)
         self.object_directory = ObjectDirectory()
         self._lock = threading.Lock()
@@ -192,6 +193,22 @@ class Cluster:
             self.head_service.stop()
             self.head_service = None
         self.gcs.shutdown()
+
+    def restart_gcs(self):
+        """Kill and restart the control plane over the same persistent
+        storage, then reconcile it against the still-running raylets —
+        the test surface of ``test_gcs_fault_tolerance.py``.  Requires a
+        file-backed GCS (``gcs_storage_path``)."""
+        if self._gcs_storage_path is None:
+            raise ValueError("restart_gcs requires gcs_storage_path "
+                             "(the in-memory store dies with the GCS)")
+        self.gcs.shutdown()
+        self.gcs = GcsServer(storage_path=self._gcs_storage_path)
+        self.gcs.subscribe_node_death(self._on_node_death)
+        self.gcs.reconcile(self.raylets())
+        if self.core_worker is not None:
+            self.core_worker.actor_submitter.on_gcs_restart()
+        return self.gcs
 
     def proxy_for(self, node_id: NodeID):
         """The RemoteNodeProxy currently mirroring ``node_id`` (None for

@@ -84,8 +84,12 @@ class Config:
     num_workers_soft_limit: int = 64
     #: Seconds an idle worker thread lingers before exit.
     idle_worker_killing_time_threshold_ms: int = 1000
-    #: Maximum worker threads started per node.
+    #: Maximum workers starting up concurrently (reference semantics:
+    #: a throttle on spawns, NOT a total cap).
     maximum_startup_concurrency: int = 64
+    #: Hard per-node worker cap (runaway backstop; the envelope needs
+    #: thousands of dedicated actor workers, reference supports 10k+).
+    max_workers_per_node: int = 20_000
 
     # ------ GCS ------
     gcs_storage_backend: str = "memory"  # "memory" | "file"

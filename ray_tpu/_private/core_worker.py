@@ -347,18 +347,24 @@ class CoreWorker:
 
     # ---- task submission (core_worker.cc:1650) -------------------------
     def build_args(self, flat_args):
-        """Returns (task_args, dep_ids, holders).
+        """Returns (task_args, dep_ids, holders, borrowed_ids).
 
         ``holders`` are temporary ObjectRefs for big literal args promoted
         to owned objects (put-in-plasma path, _raylet.pyx:1487).  The caller
         MUST keep them alive until ``submit_task`` has registered the
         submitted-task refs, otherwise the Python GC frees the arg object
         between promotion and submission.
+
+        ``borrowed_ids`` are refs nested inside small inlined args — the
+        task borrows them for its lifetime (reference: borrower protocol,
+        reference_count.h).  They go on the spec so the TaskManager pins
+        them while the task is pending and releases them at completion.
         """
         cfg = get_config()
         out: List[TaskArg] = []
         dep_ids: List[ObjectID] = []
         holders: List[ObjectRef] = []
+        borrowed: List[ObjectID] = []
         for a in flat_args:
             if isinstance(a, ObjectRef):
                 out.append(TaskArg(is_inline=False, object_id=a.object_id(),
@@ -374,11 +380,9 @@ class CoreWorker:
                                        owner_id=self.worker_id))
                     dep_ids.append(ref.object_id())
                 else:
-                    for inner in s.contained_refs:
-                        self.reference_counter.add_borrowed_object(
-                            inner.object_id(), borrower=self.worker_id)
+                    borrowed.extend(r.object_id() for r in s.contained_refs)
                     out.append(TaskArg(is_inline=True, value=s))
-        return out, dep_ids, holders
+        return out, dep_ids, holders, borrowed
 
     def submit_task(self, spec: TaskSpec, holders=()) -> List[ObjectRef]:
         self.task_manager.add_pending_task(spec)
@@ -396,7 +400,27 @@ class CoreWorker:
 
     def create_actor(self, creation_spec: TaskSpec, name: str = "",
                      namespace: str = "", detached: bool = False):
+        from ray_tpu.gcs import pubsub as pubsub_mod
         from ray_tpu.gcs.actor_manager import GcsActor
+
+        # Creation args (ref args AND refs inside inlined args) must
+        # outlive the ACTOR, not just the creation task — the pinned
+        # creation spec re-runs on every restart (reference: actor
+        # creation args owned until actor death).  Released on DEAD.
+        pinned = creation_spec.arg_object_ids() +             list(creation_spec.borrowed_ids)
+        if pinned:
+            self.reference_counter.add_submitted_task_refs(pinned)
+            released = threading.Event()
+
+            def on_update(_key, info, ids=tuple(pinned)):
+                if info.get("state") == "DEAD" and not released.is_set():
+                    released.set()
+                    self.reference_counter.remove_submitted_task_refs(
+                        list(ids))
+
+            self.cluster.gcs.publisher.subscribe(
+                pubsub_mod.ACTOR_CHANNEL, creation_spec.actor_id.binary(),
+                on_update)
         actor = GcsActor(creation_spec.actor_id, creation_spec, name=name,
                          namespace=namespace,
                          max_restarts=creation_spec.max_restarts,

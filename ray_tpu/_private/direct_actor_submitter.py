@@ -102,6 +102,27 @@ class DirectActorTaskSubmitter:
 
             worker.submit_actor_task(spec, on_done)
 
+    def on_gcs_restart(self):
+        """Re-home every live per-actor queue onto the restarted GCS:
+        pubsub subscriptions died with the old publisher, and the worker
+        handles must be re-read from the reconciled actor registry."""
+        from ray_tpu.gcs import pubsub as pubsub_mod
+        with self._lock:
+            actor_ids = list(self._queues)
+        gcs = self._core.cluster.gcs
+        for actor_id in actor_ids:
+            gcs.publisher.subscribe(
+                pubsub_mod.ACTOR_CHANNEL, actor_id.binary(),
+                lambda key, info, aid=actor_id:
+                self._on_actor_update(aid, info))
+            actor = gcs.actor_manager.get_actor(actor_id)
+            with self._lock:
+                q = self._queues.get(actor_id)
+                if q is not None and actor is not None:
+                    q.state = actor.state
+                    q.worker = actor.worker
+            self._pump(actor_id)
+
     def _on_actor_update(self, actor_id: ActorID, info: dict):
         actor = self._core.cluster.gcs.actor_manager.get_actor(actor_id)
         with self._lock:

@@ -61,6 +61,14 @@ class ObjectRef:
         # do not register a local ref — the store serializer handles borrows.
         return (ObjectRef, (self._id, self._owner_id, True))
 
+    def __copy__(self):
+        # A copied handle is a real second reference (unlike the pickle
+        # path): it must pin independently or its deletion under-counts.
+        return ObjectRef(self._id, self._owner_id)
+
+    def __deepcopy__(self, _memo):
+        return ObjectRef(self._id, self._owner_id)
+
     # -- refcounting hooks ------------------------------------------------
     def __del__(self):
         if self._registered:

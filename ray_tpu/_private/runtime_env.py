@@ -177,19 +177,32 @@ class RuntimeEnvContext:
 
 
 def _extract_uri(uri: str, kv, dest_root: str) -> str:
+    import fcntl
+
     digest = uri[len("pkg://"):]
     dest = os.path.join(dest_root, digest)
     marker = os.path.join(dest, ".materialized")
     if os.path.exists(marker):
         return dest
-    blob = kv.get(_PKG_PREFIX + digest.encode())
-    if blob is None:
-        raise RuntimeEnvError(f"package {uri} not found in GCS KV")
-    os.makedirs(dest, exist_ok=True)
-    with zipfile.ZipFile(io.BytesIO(blob)) as zf:
-        zf.extractall(dest)
-    open(marker, "w").close()
-    return dest
+    # Cross-process/thread exclusion: concurrent materializations of the
+    # same package must not extract over files a finished caller is
+    # already importing from.
+    os.makedirs(dest_root, exist_ok=True)
+    with open(os.path.join(dest_root, f".{digest}.lock"), "w") as lock_f:
+        fcntl.flock(lock_f, fcntl.LOCK_EX)
+        try:
+            if os.path.exists(marker):
+                return dest
+            blob = kv.get(_PKG_PREFIX + digest.encode())
+            if blob is None:
+                raise RuntimeEnvError(f"package {uri} not found in GCS KV")
+            os.makedirs(dest, exist_ok=True)
+            with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+                zf.extractall(dest)
+            open(marker, "w").close()
+            return dest
+        finally:
+            fcntl.flock(lock_f, fcntl.LOCK_UN)
 
 
 def materialize(spec: Optional[dict], kv,

@@ -48,7 +48,8 @@ class TaskManager:
         rc = self._core.reference_counter
         for oid in spec.return_ids:
             rc.add_owned_object(oid, lineage_task_id=spec.task_id)
-        rc.add_submitted_task_refs(spec.arg_object_ids())
+        rc.add_submitted_task_refs(
+            spec.arg_object_ids() + list(spec.borrowed_ids))
 
     def is_pending(self, task_id: TaskID) -> bool:
         with self._lock:
@@ -71,7 +72,7 @@ class TaskManager:
             self._pending.pop(spec.task_id, None)
             self._completion_cv.notify_all()
         self._core.reference_counter.remove_submitted_task_refs(
-            spec.arg_object_ids())
+            spec.arg_object_ids() + list(spec.borrowed_ids))
 
     def fail_or_retry(self, spec: TaskSpec, error: BaseException,
                       resubmit: Callable[[TaskSpec], None]) -> bool:
@@ -107,7 +108,7 @@ class TaskManager:
         for oid in spec.return_ids:
             self._core.memory_store.put_error(oid, _user_error(error))
         self._core.reference_counter.remove_submitted_task_refs(
-            spec.arg_object_ids())
+            spec.arg_object_ids() + list(spec.borrowed_ids))
 
     # ---- lineage / reconstruction ---------------------------------------
     def lineage_spec_for_object(self, object_id: ObjectID) -> Optional[TaskSpec]:

@@ -103,6 +103,9 @@ class TaskSpec:
     capture_child_tasks: bool = False
     # Runtime env (dict: {"env_vars": ..., "pip": ..., "working_dir": ...})
     runtime_env: Optional[dict] = None
+    # Refs nested inside inlined args: borrowed for the task's lifetime
+    # (reference: borrower registration, reference_count.h:61).
+    borrowed_ids: List[ObjectID] = field(default_factory=list)
     # Dynamic/streaming returns
     returns_dynamic: bool = False
     # Actor creation only: resources held while the actor is alive.  The

@@ -118,6 +118,10 @@ class Worker:
                 traceback.print_exc()
                 if on_done is not None:
                     on_done(exceptions.RayTpuError(str(e)))
+            # Drop the frame's bindings: an idle worker must not pin the
+            # last spec (its inline args hold live ObjectRefs — keeping
+            # them would defer the owner's release indefinitely).
+            kind = spec = on_done = None
         self._on_exit()
 
     def _handle_create_actor(self, spec, on_done, executor_mod):
@@ -158,6 +162,7 @@ class Worker:
                 self._queue.put(("exit", None, None))  # propagate to siblings
                 break
             self._run_actor_task(spec, on_done, executor_mod)
+            kind = spec = on_done = None   # same: no idle-frame pinning
 
     def _on_exit(self):
         was_actor = self.state == WorkerState.ACTOR
@@ -438,7 +443,13 @@ class WorkerPool:
         self._actors: Dict[WorkerID, Worker] = {}
         self._all: Dict[WorkerID, Worker] = {}
         cfg = get_config()
-        self._max_workers = cfg.maximum_startup_concurrency
+        # Total cap is the runaway backstop; maximum_startup_concurrency
+        # throttles concurrent SPAWNS, it is not a total cap
+        # (worker_pool.h:428 semantics — 10k dedicated actor workers
+        # must be reachable).
+        self._max_workers = cfg.max_workers_per_node
+        self._max_starting = cfg.maximum_startup_concurrency
+        self._starting = 0
         self._soft_limit = cfg.num_workers_soft_limit
         self._process_mode = cfg.worker_process_mode == "process"
         self._host_service: Optional[WorkerHostService] = None
@@ -487,7 +498,8 @@ class WorkerPool:
                 found.state = WorkerState.LEASED
                 self._leased[found.worker_id] = found
                 return found
-            if len(self._all) >= self._max_workers and kept:
+            total = len(self._all) + self._starting
+            if total >= self._max_workers and kept:
                 # At the cap with only mismatched-env idle workers:
                 # evict one to make room (the reference kills an idle
                 # worker rather than starving the new env forever).
@@ -495,13 +507,26 @@ class WorkerPool:
                 self._idle.remove(victim)
                 self._all.pop(victim.worker_id, None)
                 victim.stop()
-            if len(self._all) < self._max_workers:
-                w = self._new_worker(runtime_env=runtime_env)
-                self._all[w.worker_id] = w
-                w.state = WorkerState.LEASED
-                self._leased[w.worker_id] = w
-                return w
-            return None
+                total -= 1
+            if total >= self._max_workers or \
+                    self._starting >= self._max_starting:
+                return None      # caller retries on the dispatch tick
+            self._starting += 1
+        # Construct OUTSIDE the lock: a process-mode spawn materializes
+        # the runtime env (KV fetch + unzip) — holding the pool lock for
+        # that would stall every concurrent lease/return.
+        try:
+            w = self._new_worker(runtime_env=runtime_env)
+        except BaseException:
+            with self._lock:
+                self._starting -= 1
+            raise
+        with self._lock:
+            self._starting -= 1
+            self._all[w.worker_id] = w
+            w.state = WorkerState.LEASED
+            self._leased[w.worker_id] = w
+            return w
 
     def push_worker(self, worker: Worker):
         """Return a leased worker to the idle pool."""
@@ -530,6 +555,18 @@ class WorkerPool:
             self._actors.pop(worker.worker_id, None)
             if worker in self._idle:
                 self._idle.remove(worker)
+
+    def worker_for_actor(self, actor_id):
+        """The dedicated worker currently running ``actor_id`` (GCS
+        restart reconciliation scans surviving raylets with this)."""
+        with self._lock:
+            # Scan every tracked worker: a dedicated actor worker may sit
+            # in _leased (the lease is held by the GCS actor manager and
+            # never returned) as well as in _actors.
+            for w in self._all.values():
+                if w.actor_id == actor_id and w.state == WorkerState.ACTOR:
+                    return w
+            return None
 
     def num_idle(self) -> int:
         with self._lock:

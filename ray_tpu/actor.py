@@ -71,7 +71,7 @@ class ActorHandle:
         gcs_actor = w.cluster.gcs.actor_manager.get_actor(self._actor_id)
         creation = gcs_actor.creation_spec if gcs_actor else None
         flat = pack_args(args, kwargs)
-        task_args, _, holders = core.build_args(flat)
+        task_args, _, holders, borrowed = core.build_args(flat)
         parent = worker_context.current_task_spec()
         spec = make_spec(
             job_id=w.job_id,
@@ -87,6 +87,7 @@ class ActorHandle:
             actor_id=self._actor_id,
             actor_method_name=method_name,
             max_retries=(creation.max_task_retries if creation else 0),
+            borrowed_ids=borrowed,
         )
         refs = core.submit_actor_task(spec, holders=holders)
         if num_returns == 0:
@@ -147,7 +148,7 @@ class ActorClass:
             o, resources)
         lifetime_resources = resources if explicit_any else {}
         flat = pack_args(args, kwargs)
-        task_args, _, holders = core.build_args(flat)
+        task_args, _, holders, borrowed = core.build_args(flat)
         actor_id = ActorID.from_random()
         parent = worker_context.current_task_spec()
         spec = make_spec(
@@ -170,6 +171,7 @@ class ActorClass:
             placement_group_bundle_index=bundle_idx,
             runtime_env=_normalized_env(o.get("runtime_env"), w),
             lifetime_resources=lifetime_resources,
+            borrowed_ids=borrowed,
         )
         namespace = o.get("namespace")
         core.create_actor(

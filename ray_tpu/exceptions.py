@@ -31,6 +31,12 @@ class TaskError(RayTpuError):
         self.task_desc = task_desc
         self.traceback_str = tb or "".join(
             traceback.format_exception(type(cause), cause, cause.__traceback__))
+        # The formatted string above is the durable record; drop the frame
+        # chain — stored error objects otherwise pin the executor's and the
+        # user function's locals (including deserialized arg refs) for as
+        # long as the error is retrievable (reference: RayTaskError ships
+        # text, never traceback objects).
+        cause.__traceback__ = None
         super().__init__(
             f"Task {task_desc} failed:\n{self.traceback_str}")
 

@@ -10,6 +10,7 @@ forward vs ``gcs_actor_distribution.h:66`` GCS-decides, switched by
 
 from __future__ import annotations
 
+import pickle
 import random
 import threading
 import time
@@ -80,7 +81,7 @@ class GcsActorManager:
                         f"namespace {actor.namespace!r}")
                 self._named[key] = actor.actor_id
             self._actors[actor.actor_id] = actor
-            self._gcs.storage.actor_table.put(actor.actor_id, actor.info())
+            self._persist(actor)
         self._schedule(actor, ready_cb)
         return actor
 
@@ -143,7 +144,7 @@ class GcsActorManager:
                     actor.death_cause = f"creation failed: {error}"
                 else:
                     actor.state = ActorState.ALIVE
-                self._gcs.storage.actor_table.put(actor.actor_id, actor.info())
+                self._persist(actor)
             self._publish(actor)
             if ready_cb:
                 ready_cb(actor, error)
@@ -168,7 +169,7 @@ class GcsActorManager:
                 actor.worker = None
                 if actor.name:
                     self._named.pop((actor.namespace, actor.name), None)
-            self._gcs.storage.actor_table.put(actor_id, actor.info())
+            self._persist(actor)
         self._publish(actor)
         if restarting:
             self._gcs.loop.post(lambda: self._schedule(actor),
@@ -195,6 +196,71 @@ class GcsActorManager:
             worker.kill_actor()
         else:
             self.on_actor_worker_died(actor_id, "killed via destroy_actor")
+
+    def _persist(self, actor: GcsActor):
+        """Durable record: info + pickled creation spec, so a restarted
+        GCS can rebuild the actor registry (GcsInitData parity)."""
+        record = actor.info()
+        try:
+            record["spec_blob"] = pickle.dumps(actor.creation_spec,
+                                               protocol=5)
+        except Exception:
+            record["spec_blob"] = None
+        self._gcs.storage.actor_table.put(actor.actor_id, record)
+
+    # ---- GCS-restart reconciliation (gcs_init_data.cc parity) -----------
+    def reconcile(self, raylets):
+        """Rebuild the registry from the durable table after a GCS
+        restart: actors whose dedicated workers still run on a surviving
+        raylet are re-attached ALIVE; actors whose worker/node vanished
+        with the outage are restarted per max_restarts."""
+        from ray_tpu._private.ids import ActorID as _ActorID
+
+        for key, record in self._gcs.storage.actor_table.get_all():
+            actor_id = key if isinstance(key, _ActorID) else _ActorID(key)
+            if record.get("state") == ActorState.DEAD:
+                continue
+            blob = record.get("spec_blob")
+            if not blob:
+                continue
+            try:
+                spec = pickle.loads(blob)
+            except Exception:
+                continue
+            actor = GcsActor(
+                actor_id, spec,
+                name=record.get("name", ""),
+                namespace=record.get("namespace", ""),
+                max_restarts=record.get("max_restarts", 0),
+                detached=record.get("detached", False))
+            actor.num_restarts = record.get("num_restarts", 0)
+            worker = node_id = None
+            for raylet in raylets:
+                w = getattr(raylet, "worker_pool", None)
+                w = w.worker_for_actor(actor_id) if w is not None else None
+                if w is not None:
+                    worker, node_id = w, raylet.node_id
+                    break
+            with self._lock:
+                self._actors[actor_id] = actor
+                if actor.name:
+                    self._named[(actor.namespace, actor.name)] = actor_id
+                if worker is not None:
+                    actor.worker = worker
+                    actor.node_id = node_id
+                    actor.state = ActorState.ALIVE
+                    self._persist(actor)
+            if worker is not None:
+                self._publish(actor)
+            elif record.get("state") == ActorState.ALIVE:
+                # Was running, worker lost with the outage: restart path
+                # (consumes one of max_restarts, like any worker death).
+                self.on_actor_worker_died(actor_id, "lost during GCS restart")
+            else:
+                # Creation was still in flight when the GCS died: finish
+                # the original placement — NOT a death, no restart burned.
+                self._gcs.loop.post(lambda a=actor: self._schedule(a),
+                                    "actor.reconcile")
 
     # ---- lookup ---------------------------------------------------------
     def get_actor(self, actor_id: ActorID) -> Optional[GcsActor]:

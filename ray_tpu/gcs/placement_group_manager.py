@@ -206,6 +206,67 @@ class GcsPlacementGroupManager:
                 pass
         return True
 
+    # ---- GCS-restart reconciliation (gcs_init_data.cc +
+    # ReleaseUnusedBundles, node_manager.proto:312-355) ------------------
+    def reconcile(self, raylets):
+        """Rebuild PG state from the durable table after a GCS restart,
+        re-adopting bundles still committed on surviving raylets,
+        rescheduling bundles lost with the outage, and releasing bundles
+        raylets hold for PGs that no longer exist."""
+        from ray_tpu._private.ids import NodeID as _NodeID
+        from ray_tpu._private.ids import PlacementGroupID as _PGID
+
+        live_nodes = {r.node_id: r for r in raylets}
+        for key, record in \
+                self._gcs.storage.placement_group_table.get_all():
+            pg_id = key if isinstance(key, _PGID) else _PGID(key)
+            if record.get("state") == PlacementGroupState.REMOVED:
+                continue
+            bundles = [ResourceRequest(b) for b in record.get("bundles", [])]
+            pg = GcsPlacementGroup(pg_id, bundles,
+                                   record.get("strategy",
+                                              PlacementStrategy.PACK),
+                                   name=record.get("name", ""))
+            lost = False
+            for idx_str, node_hex in record.get("bundle_nodes",
+                                                {}).items():
+                idx = int(idx_str)
+                node_id = _NodeID.from_hex(node_hex)
+                raylet = live_nodes.get(node_id)
+                if raylet is not None and \
+                        (pg_id, idx) in getattr(raylet,
+                                                "_committed_bundles", {}):
+                    pg.bundle_nodes[idx] = node_id
+                else:
+                    lost = True
+            with self._lock:
+                if len(pg.bundle_nodes) == len(pg.bundles) and not lost:
+                    pg.state = PlacementGroupState.CREATED
+                else:
+                    pg.state = PlacementGroupState.RESCHEDULING
+                    if pg_id not in self._pending:
+                        self._pending.append(pg_id)
+                self._groups[pg_id] = pg
+                if pg.name:
+                    self._named[pg.name] = pg_id
+                self._state_cond.notify_all()
+        # ReleaseUnusedBundles: drop raylet-held bundles for unknown or
+        # removed PGs (leaked by the outage).
+        for raylet in raylets:
+            held = dict(getattr(raylet, "_committed_bundles", {}))
+            held.update(getattr(raylet, "_prepared_bundles", {}))
+            for (pg_id, idx) in held:
+                with self._lock:
+                    pg = self._groups.get(pg_id)
+                    keep = pg is not None and \
+                        pg.state != PlacementGroupState.REMOVED
+                if not keep:
+                    try:
+                        raylet.cancel_resource_reserve(pg_id, idx)
+                    except Exception:
+                        pass
+        self._gcs.loop.post(self._schedule_pending, "pg.reconcile")
+
     # ---- failure handling ----------------------------------------------
     def on_node_death(self, node_id: NodeID):
         with self._lock:

@@ -73,6 +73,19 @@ class GcsNodeManager:
                 out[nid] = dict(info)
             return out
 
+    def record_death_from_storage(self, node_id: NodeID, info: dict,
+                                  reason: str):
+        """Mark a node dead that only exists as a durable record (GCS
+        restart reconciliation — it was never re-registered live)."""
+        with self._lock:
+            info = dict(info, state="DEAD", death_reason=reason,
+                        end_time=time.time())
+            self.alive_nodes.pop(node_id, None)
+            self.dead_nodes[node_id] = info
+            self._storage.node_table.put(node_id, info)
+        self._publisher.publish(pubsub_mod.NODE_CHANNEL, node_id.binary(),
+                                {"state": "DEAD", "info": info})
+
     def is_alive(self, node_id: NodeID) -> bool:
         with self._lock:
             return node_id in self.alive_nodes
@@ -294,6 +307,28 @@ class GcsServer:
 
     def raylets(self):
         return dict(self._raylets)
+
+    def reconcile(self, raylets):
+        """After a GCS restart over persistent storage, re-attach the
+        surviving raylets and rebuild live actor/PG state from the
+        durable tables (GcsInitData + ReleaseUnusedWorkers/Bundles
+        parity).  Node-table entries with no surviving raylet are
+        declared dead."""
+        survivors = set()
+        for raylet in raylets:
+            self.register_raylet(raylet)
+            survivors.add(raylet.node_id)
+        for key, info in self.storage.node_table.get_all():
+            node_id = key if isinstance(key, NodeID) else NodeID(key)
+            if info.get("state") == "ALIVE" and node_id not in survivors:
+                # Pre-outage node that did not come back: record + publish
+                # its death directly (it was never re-registered, so the
+                # normal on_node_death path would no-op).
+                self.node_manager.record_death_from_storage(
+                    node_id, info, "did not survive GCS restart")
+                self._notify_node_death(node_id)
+        self.actor_manager.reconcile(raylets)
+        self.placement_group_manager.reconcile(raylets)
 
     def _on_node_death(self, node_id: NodeID):
         self.node_manager.on_node_death(node_id)

@@ -106,7 +106,7 @@ class RemoteFunction:
         resources, strategy, pg_id, bundle_idx = \
             resolve_pg_strategy(options, resources)
         flat = pack_args(args, kwargs)
-        task_args, _, holders = core.build_args(flat)
+        task_args, _, holders, borrowed = core.build_args(flat)
         parent = worker_context.current_task_spec()
         cfg_retries = options.get("max_retries")
         from ray_tpu._private.config import get_config
@@ -128,6 +128,7 @@ class RemoteFunction:
             placement_group_id=pg_id,
             placement_group_bundle_index=bundle_idx,
             runtime_env=_normalized_env(options.get("runtime_env"), w),
+            borrowed_ids=borrowed,
         )
         refs = core.submit_task(spec, holders=holders)
         if spec.num_returns == 0:

@@ -1,0 +1,377 @@
+"""Deep reference-counting / ownership scenarios.
+
+Modeled on the reference's ``src/ray/core_worker/reference_count_test.cc``
+(2,878 LoC) scenario families: local-ref lifecycles, submitted-task
+pinning, borrowing through inlined args, nested refs in puts and
+returns, recursive containment cascades, lineage interaction, and
+free-vs-reconstruction races.  Complements the basics in
+``test_reference_counting.py``."""
+
+import gc
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import worker as worker_mod
+
+
+def _core():
+    return worker_mod.global_worker().core_worker
+
+
+def _rc():
+    return _core().reference_counter
+
+
+def _gone(oid, timeout=5.0):
+    """True once the owner drops its last reference to oid."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        gc.collect()
+        if not _rc().has_reference(oid):
+            return True
+        time.sleep(0.02)
+    return False
+
+
+BIG = 2 * 1024 * 1024   # node-store sized
+
+
+# ---------------------------------------------------------------------------
+# Local ref lifecycle
+# ---------------------------------------------------------------------------
+
+class TestLocalRefs:
+    def test_two_handles_same_object(self, ray_start_regular):
+        import copy
+        ref = ray_tpu.put("v")
+        oid = ref.object_id()
+        ref2 = copy.copy(ref)
+        del ref
+        gc.collect()
+        assert _rc().has_reference(oid), "second handle must keep it alive"
+        del ref2
+        assert _gone(oid)
+
+    def test_deserialized_handle_counts(self, ray_start_regular):
+        """A ref that round-trips through get (inside a container) is a
+        NEW local reference on arrival."""
+        inner = ray_tpu.put("x")
+        oid = inner.object_id()
+        outer = ray_tpu.put({"k": inner})
+        got = ray_tpu.get(outer)["k"]
+        del inner, outer
+        gc.collect()
+        assert _rc().has_reference(oid), "deserialized handle must pin"
+        assert ray_tpu.get(got) == "x"
+        del got
+        assert _gone(oid)
+
+    def test_ref_count_accounting(self, ray_start_regular):
+        import copy
+        ref = ray_tpu.put(1)
+        oid = ref.object_id()
+        assert _rc().ref_count(oid) == 1
+        ref2 = copy.copy(ref)
+        assert _rc().ref_count(oid) == 2
+        del ref2
+        gc.collect()
+        assert _rc().ref_count(oid) == 1
+        del ref
+        assert _gone(oid)
+
+    def test_free_objects_explicit(self, ray_start_regular):
+        """Explicit free drops stored copies even while a handle lives
+        (internal free API; double-free is a no-op)."""
+        ref = ray_tpu.put(np.zeros(BIG, dtype=np.uint8))
+        oid = ref.object_id()
+        core = _core()
+        core.free_objects([ref])
+        core.free_objects([ref])   # idempotent
+        raylet = worker_mod.global_worker().cluster.head_node
+        assert not raylet.object_store.contains(oid)
+
+
+# ---------------------------------------------------------------------------
+# Submitted-task pinning
+# ---------------------------------------------------------------------------
+
+class TestSubmittedTaskRefs:
+    def test_multiple_pending_tasks_one_arg(self, ray_start_regular):
+        @ray_tpu.remote
+        def hold(x, delay):
+            time.sleep(delay)
+            return len(x)
+
+        ref = ray_tpu.put(np.zeros(BIG, dtype=np.uint8))
+        oid = ref.object_id()
+        outs = [hold.remote(ref, 0.2) for _ in range(3)]
+        del ref
+        gc.collect()
+        assert _rc().has_reference(oid), "3 pending tasks must pin the arg"
+        assert ray_tpu.get(outs) == [BIG] * 3
+        assert _gone(oid), "all tasks done + no handle -> freed"
+
+    def test_failed_task_releases_arg(self, ray_start_regular):
+        @ray_tpu.remote(max_retries=0)
+        def boom(x):
+            raise ValueError("no")
+
+        ref = ray_tpu.put(np.zeros(BIG, dtype=np.uint8))
+        oid = ref.object_id()
+        out = boom.remote(ref)
+        del ref
+        with pytest.raises(ValueError):
+            ray_tpu.get(out)
+        del out
+        assert _gone(oid), "failure path must release the task's arg pin"
+
+    def test_chained_dependency_release_order(self, ray_start_regular):
+        @ray_tpu.remote
+        def grow(x):
+            return np.concatenate([x, x])
+
+        a = grow.remote(np.ones(BIG // 2, dtype=np.uint8))
+        b = grow.remote(a)
+        a_id = a.object_id()
+        del a
+        gc.collect()
+        assert _rc().has_reference(a_id), "b's pending spec pins a"
+        assert ray_tpu.get(b).shape == (BIG * 2,)
+        assert _gone(a_id)
+
+
+# ---------------------------------------------------------------------------
+# Borrowing through inlined args
+# ---------------------------------------------------------------------------
+
+class TestBorrowedRefs:
+    def test_ref_inside_inline_arg_pinned_until_done(self, ray_start_regular):
+        """A ref nested in a small (inlined) container arg must stay
+        alive for the task's lifetime, then be released — the
+        borrower-protocol collapse (reference_count.h borrowers)."""
+        @ray_tpu.remote
+        def use(box, delay):
+            time.sleep(delay)
+            return ray_tpu.get(box["ref"])
+
+        inner = ray_tpu.put("borrowed-payload")
+        oid = inner.object_id()
+        out = use.remote({"ref": inner}, 0.3)
+        del inner
+        gc.collect()
+        assert _rc().has_reference(oid), "borrow must pin while pending"
+        assert ray_tpu.get(out) == "borrowed-payload"
+        del out
+        assert _gone(oid), "borrow must be RELEASED after completion"
+
+    def test_borrow_released_on_task_failure(self, ray_start_regular):
+        @ray_tpu.remote(max_retries=0)
+        def fail(box):
+            raise RuntimeError("died")
+
+        inner = ray_tpu.put("p")
+        oid = inner.object_id()
+        out = fail.remote([inner])
+        del inner
+        with pytest.raises(RuntimeError):
+            ray_tpu.get(out)
+        del out
+        assert _gone(oid)
+
+    def test_two_tasks_borrow_same_ref(self, ray_start_regular):
+        @ray_tpu.remote
+        def use(box, delay):
+            time.sleep(delay)
+            return ray_tpu.get(box[0])
+
+        inner = ray_tpu.put(7)
+        oid = inner.object_id()
+        slow = use.remote([inner], 0.4)
+        fast = use.remote([inner], 0.0)
+        del inner
+        assert ray_tpu.get(fast) == 7
+        gc.collect()
+        assert _rc().has_reference(oid), \
+            "fast task done but slow task still borrows"
+        assert ray_tpu.get(slow) == 7
+        del slow, fast
+        assert _gone(oid)
+
+
+# ---------------------------------------------------------------------------
+# Nested refs (contained-in edges)
+# ---------------------------------------------------------------------------
+
+class TestNestedRefs:
+    def test_return_containing_ref(self, ray_start_regular):
+        """A task RETURN whose value contains a ref: the inner object
+        outlives the task and is released when the outer return and all
+        deserialized handles drop.  The ref must ride inside a container
+        arg — a bare ref arg is materialized to its value."""
+        @ray_tpu.remote
+        def rewrap(box):
+            return {"inner": box["r"]}
+
+        inner = ray_tpu.put("deep")
+        oid = inner.object_id()
+        outer = rewrap.remote({"r": inner})
+        got = ray_tpu.get(outer)
+        del inner
+        gc.collect()
+        assert _rc().has_reference(oid)
+        assert ray_tpu.get(got["inner"]) == "deep"
+        del got, outer
+        assert _gone(oid)
+
+    def test_three_level_cascade(self, ray_start_regular):
+        a = ray_tpu.put("a")
+        a_id = a.object_id()
+        b = ray_tpu.put([a])
+        b_id = b.object_id()
+        c = ray_tpu.put({"b": b})
+        del a, b
+        gc.collect()
+        assert _rc().has_reference(a_id) and _rc().has_reference(b_id)
+        del c
+        assert _gone(b_id), "dropping c must cascade to b"
+        assert _gone(a_id), "...and through b to a"
+
+    def test_sibling_containment(self, ray_start_regular):
+        """One inner object contained in TWO outers: freed only after
+        both outers drop."""
+        inner = ray_tpu.put("shared")
+        oid = inner.object_id()
+        out1 = ray_tpu.put([inner])
+        out2 = ray_tpu.put((inner,))
+        del inner
+        gc.collect()
+        assert _rc().has_reference(oid)
+        del out1
+        gc.collect()
+        assert _rc().has_reference(oid), "out2 still contains it"
+        del out2
+        assert _gone(oid)
+
+    def test_worker_created_nested_ref(self, ray_start_regular):
+        """The task itself puts an object and returns its ref inside a
+        container (reference: nested return ids owned by the worker)."""
+        @ray_tpu.remote
+        def produce():
+            inner_ref = ray_tpu.put(np.arange(16))
+            return [inner_ref]
+
+        box = ray_tpu.get(produce.remote())
+        np.testing.assert_array_equal(ray_tpu.get(box[0]), np.arange(16))
+
+
+# ---------------------------------------------------------------------------
+# Lineage interaction
+# ---------------------------------------------------------------------------
+
+class TestLineageInteraction:
+    def test_lineage_evicted_on_free(self, ray_start_regular):
+        @ray_tpu.remote
+        def make():
+            return np.ones(8)
+
+        ref = make.remote()
+        ray_tpu.get(ref)
+        task_id = ref.task_id()
+        tm = _core().task_manager
+        assert tm.lineage_spec_for_object(ref.object_id()) is not None
+        del ref
+        gc.collect()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and \
+                tm._lineage.get(task_id) is not None:
+            time.sleep(0.02)
+        assert tm._lineage.get(task_id) is None, \
+            "lineage must be evicted once returns go out of scope"
+
+    def test_get_after_free_raises_promptly(self, ray_start_regular):
+        """free + lineage evicted -> get must surface the loss, not
+        hang (free racing reconstruction family)."""
+        @ray_tpu.remote
+        def make():
+            return np.zeros(BIG, dtype=np.uint8)
+
+        ref = make.remote()
+        ray_tpu.get(ref)
+        _core().free_objects([ref])
+        _core().task_manager.evict_lineage(ref.task_id())
+        with pytest.raises((ray_tpu.exceptions.ObjectLostError,
+                            ray_tpu.exceptions.GetTimeoutError)):
+            ray_tpu.get(ref, timeout=5)
+
+    def test_recover_after_free_with_lineage(self, ray_start_regular):
+        """Free the stored copy but KEEP the handle: lineage
+        reconstruction recomputes the value on get."""
+        @ray_tpu.remote(max_retries=2)
+        def make():
+            return np.full(BIG, 3, dtype=np.uint8)
+
+        ref = make.remote()
+        first = ray_tpu.get(ref)
+        assert first[0] == 3
+        # Drop every stored copy, preserving refs + lineage.
+        raylet = worker_mod.global_worker().cluster.head_node
+        raylet.object_store.delete(ref.object_id())
+        _core().memory_store.delete(ref.object_id())
+        worker_mod.global_worker().cluster.object_directory.remove_object(
+            ref.object_id())
+        again = ray_tpu.get(ref, timeout=15)
+        assert again[0] == 3 and again.shape == first.shape
+
+
+# ---------------------------------------------------------------------------
+# Store eviction on release
+# ---------------------------------------------------------------------------
+
+class TestStoreRelease:
+    def test_memory_store_evicted(self, ray_start_regular):
+        ref = ray_tpu.put("small-value")
+        oid = ref.object_id()
+        assert _core().memory_store.contains(oid)
+        del ref
+        assert _gone(oid)
+        assert not _core().memory_store.contains(oid)
+
+    def test_node_store_and_directory_evicted(self, ray_start_regular):
+        ref = ray_tpu.put(np.zeros(BIG, dtype=np.uint8))
+        oid = ref.object_id()
+        cluster = worker_mod.global_worker().cluster
+        assert cluster.object_directory.get_locations(oid)
+        del ref
+        assert _gone(oid)
+        assert not cluster.object_directory.get_locations(oid)
+        assert not cluster.head_node.object_store.contains(oid)
+
+    def test_return_value_store_release(self, ray_start_regular):
+        @ray_tpu.remote
+        def big():
+            return np.zeros(BIG, dtype=np.uint8)
+
+        ref = big.remote()
+        ray_tpu.get(ref)
+        oid = ref.object_id()
+        cluster = worker_mod.global_worker().cluster
+        del ref
+        assert _gone(oid)
+        assert not cluster.head_node.object_store.contains(oid)
+
+    def test_wait_does_not_leak_refs(self, ray_start_regular):
+        @ray_tpu.remote
+        def slow():
+            time.sleep(0.2)
+            return 1
+
+        refs = [slow.remote() for _ in range(4)]
+        ready, rest = ray_tpu.wait(refs, num_returns=4, timeout=10)
+        assert len(ready) == 4
+        oids = [r.object_id() for r in refs]
+        del refs, ready, rest
+        for oid in oids:
+            assert _gone(oid)

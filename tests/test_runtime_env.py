@@ -5,7 +5,6 @@ env_vars visible to tasks, working_dir/py_modules packaged and importable
 on the executor, per-env worker-process keying."""
 
 import os
-import sys
 
 import pytest
 
@@ -73,7 +72,6 @@ class TestThreadModeEnv:
             return secret_module_xyz.MAGIC
 
         assert ray_tpu.get(use.remote(), timeout=30) == 12345
-        assert "secret_module_xyz" not in sys.modules or True
 
     def test_py_modules(self, ray_start_regular, tmp_path):
         lib = tmp_path / "libs"
