@@ -1,0 +1,262 @@
+"""Client-mode runtime: the full public API from inside a process-mode
+worker, proxied through the worker-host service.
+
+Parity: the reference's in-worker CoreWorker — every worker process runs
+its own submission/ownership client talking to its raylet and the GCS
+(``core_worker.cc`` in non-driver mode).  Here the child builds real
+TaskSpecs locally (it has the same spec machinery as the driver) and
+ships them to the host, whose core worker owns the resulting objects:
+nested ``.remote`` calls, ``put/get/wait``, actor creation and method
+calls, named-actor lookup, and ``kill`` all work inside process-mode
+workers.
+
+Installed by ``worker_main`` right after registration:
+``install(host_client)`` populates the process-global worker singleton,
+so user code just calls ``ray_tpu.*``.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Any, List, Optional, Sequence, Tuple
+
+from ray_tpu import exceptions
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.config import get_config
+from ray_tpu._private.ids import ObjectID, TaskID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.serialization import (
+    SerializedObject, deserialize, serialize)
+from ray_tpu._private.task_spec import TaskArg
+
+
+class _ClientKV:
+    """GCS KV slice used by runtime-env normalization in the child."""
+
+    def __init__(self, client):
+        self._client = client
+
+    def get(self, key: bytes, namespace=None):
+        return self._client.call("kv_get", key, timeout=30.0)
+
+    def put(self, key: bytes, value: bytes, overwrite: bool = True,
+            namespace=None) -> bool:
+        return self._client.call(
+            "kv_put", {"key": key, "value": value, "overwrite": overwrite},
+            timeout=60.0)
+
+
+class _ClientActorRecord:
+    """Duck-types the GcsActor slice ``actor.py`` reads (creation_spec,
+    class name) for method submission."""
+
+    def __init__(self, record: dict):
+        self.actor_id = record["actor_id"]
+        self.state = record.get("state")
+        self.num_restarts = record.get("num_restarts", 0)
+        self._info = {"class_name": record.get("class_name", "")}
+        self.creation_spec = pickle.loads(record["spec_blob"]) \
+            if record.get("spec_blob") else None
+
+    def info(self):
+        return dict(self._info)
+
+
+class _ClientActorManager:
+    def __init__(self, client):
+        self._client = client
+
+    def get_actor(self, actor_id):
+        record = self._client.call("actor_info", {"actor_id": actor_id},
+                                   timeout=30.0)
+        return None if record is None else _ClientActorRecord(record)
+
+    def get_named_actor(self, name: str, namespace: str = ""):
+        record = self._client.call(
+            "named_actor_info", {"name": name, "namespace": namespace},
+            timeout=30.0)
+        return None if record is None else _ClientActorRecord(record)
+
+    def destroy_actor(self, actor_id, no_restart: bool = True):
+        self._client.call("kill_actor",
+                          {"actor_id": actor_id, "no_restart": no_restart},
+                          timeout=30.0)
+
+
+class _ClientGcs:
+    def __init__(self, client):
+        self.kv = _ClientKV(client)
+        self.actor_manager = _ClientActorManager(client)
+
+
+class _NodeStub:
+    __slots__ = ("node_id",)
+
+    def __init__(self, node_id):
+        self.node_id = node_id
+
+
+class _ClientCluster:
+    """The cluster surface the public API layer touches in client mode."""
+
+    def __init__(self, client, info: dict):
+        self.gcs = _ClientGcs(client)
+        # runtime_context reads cluster.head_node.node_id when no task
+        # context is set; from a worker, "here" is the hosting node.
+        self.head_node = _NodeStub(info.get("node_id"))
+
+
+class _NullReferenceCounter:
+    """Ownership lives host-side; client-side handles don't refcount
+    (deserialized refs register here so the shared ObjectRef machinery
+    works unchanged)."""
+
+    def add_local_ref(self, _oid):
+        pass
+
+    def remove_local_ref(self, _oid):
+        pass
+
+    def add_borrowed_object(self, _oid, borrower=None):
+        pass
+
+    def has_reference(self, _oid) -> bool:
+        return True
+
+
+class ClientCoreWorker:
+    """Duck-types the CoreWorker methods the API layer calls, proxying
+    submission/ownership to the host's core worker."""
+
+    is_driver = False
+
+    def __init__(self, client, info: dict, client_worker_id: str = ""):
+        self._client = client
+        self.job_id = info["job_id"]
+        self.worker_id = info["owner_id"]      # ownership stays host-side
+        self.client_worker_id = client_worker_id   # pin scope on the host
+        self.driver_task_id = TaskID.for_driver(self.job_id)
+        # The real FunctionManager over the client KV: identical export
+        # semantics (incl. keeping exported fns alive so id() reuse can't
+        # alias a stale digest).
+        from ray_tpu._private.function_manager import FunctionManager
+        self.function_manager = FunctionManager(_ClientKV(client))
+        self.reference_counter = _NullReferenceCounter()
+        self.cluster = _ClientCluster(client, info)
+
+    # ---- args / submission ---------------------------------------------
+    def build_args(self, flat_args):
+        cfg = get_config()
+        out: List[TaskArg] = []
+        dep_ids: List[ObjectID] = []
+        holders: List[ObjectRef] = []
+        borrowed: List[ObjectID] = []
+        for a in flat_args:
+            if isinstance(a, ObjectRef):
+                out.append(TaskArg(is_inline=False,
+                                   object_id=a.object_id(),
+                                   owner_id=a.owner_id()))
+                dep_ids.append(a.object_id())
+            else:
+                s = serialize(a)
+                if s.total_bytes > cfg.task_args_inline_bytes_limit:
+                    ref = self.put(a)
+                    holders.append(ref)
+                    out.append(TaskArg(is_inline=False,
+                                       object_id=ref.object_id(),
+                                       owner_id=ref.owner_id()))
+                    dep_ids.append(ref.object_id())
+                else:
+                    borrowed.extend(r.object_id()
+                                    for r in s.contained_refs)
+                    out.append(TaskArg(is_inline=True, value=s))
+        return out, dep_ids, holders, borrowed
+
+    def submit_task(self, spec, holders=()) -> List[ObjectRef]:
+        self._client.call("submit_task", {"spec": spec}, timeout=60.0)
+        del holders
+        return [ObjectRef(oid, owner_id=self.worker_id,
+                          skip_adding_local_ref=True)
+                for oid in spec.return_ids]
+
+    def submit_actor_task(self, spec, holders=()) -> List[ObjectRef]:
+        self._client.call("submit_actor_task", {"spec": spec},
+                          timeout=60.0)
+        del holders
+        return [ObjectRef(oid, owner_id=self.worker_id,
+                          skip_adding_local_ref=True)
+                for oid in spec.return_ids]
+
+    def create_actor(self, creation_spec, name: str = "",
+                     namespace: str = "", detached: bool = False):
+        self._client.call("create_actor", {
+            "spec": creation_spec, "name": name, "namespace": namespace,
+            "detached": detached}, timeout=60.0)
+
+    # ---- objects ---------------------------------------------------------
+    def put(self, value: Any, _owner=None) -> ObjectRef:
+        reply = self._client.call(
+            "put_object", {"blob": serialize(value).to_bytes(),
+                           "worker_id": self.client_worker_id},
+            timeout=300.0)
+        return ObjectRef(reply["object_id"], owner_id=reply["owner_id"],
+                         skip_adding_local_ref=True)
+
+    def get(self, refs: Sequence[ObjectRef],
+            timeout: Optional[float] = None) -> List[Any]:
+        out = []
+        for ref in refs:
+            result = self._client.call(
+                "get_value",
+                {"object_id": ref.object_id(), "timeout": timeout},
+                timeout=None if timeout is None else timeout + 30.0)
+            if result is None:
+                raise exceptions.GetTimeoutError(
+                    f"Get timed out for {ref.object_id()}")
+            kind, blob = result
+            if kind == "error":
+                err = pickle.loads(blob)
+                if isinstance(err, exceptions.TaskError):
+                    raise err.as_instanceof_cause()
+                raise err
+            out.append(deserialize(SerializedObject.from_bytes(blob)))
+        return out
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None,
+             fetch_local: bool = True) -> Tuple[List, List]:
+        reply = self._client.call(
+            "wait_refs",
+            {"object_ids": [r.object_id() for r in refs],
+             "num_returns": num_returns, "timeout": timeout},
+            timeout=None if timeout is None else timeout + 30.0)
+        by_id = {r.object_id(): r for r in refs}
+        return ([by_id[oid] for oid in reply["ready"]],
+                [by_id[oid] for oid in reply["not_ready"]])
+
+    def get_async(self, ref: ObjectRef, callback):
+        def run():
+            try:
+                callback(self.get([ref])[0], None)
+            except BaseException as e:    # noqa: BLE001
+                callback(None, e)
+
+        threading.Thread(target=run, daemon=True).start()
+
+
+def install(host_client, info: Optional[dict] = None,
+            client_worker_id: str = ""):
+    """Connect this process's global worker to the host: after this,
+    ``ray_tpu.*`` works inside the process-mode worker."""
+    info = info or host_client.call("runtime_info", None, timeout=30.0)
+    core = ClientCoreWorker(host_client, info,
+                            client_worker_id=client_worker_id)
+    w = worker_mod.global_worker()
+    w.core_worker = core
+    w.cluster = core.cluster
+    w.job_id = core.job_id
+    w.namespace = info.get("namespace", "")
+    w.mode = "client"
+    w.connected = True
+    return core

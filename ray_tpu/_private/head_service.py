@@ -109,10 +109,10 @@ class _ProxyObjectStore:
 
     def get_serialized(self, object_id: ObjectID
                        ) -> Optional[SerializedObject]:
+        from ray_tpu.rpc.chunked import fetch_chunked
         try:
-            blob = self._proxy.client.call(
-                "fetch_object", {"object_id": object_id.binary()},
-                timeout=60.0)
+            blob = fetch_chunked(self._proxy.client, object_id.binary(),
+                                 timeout=300.0)
         except Exception:
             return None
         return None if blob is None else SerializedObject.from_bytes(blob)
@@ -255,6 +255,13 @@ class HeadService:
         s.register("get_locations", self._handle_get_locations)
         s.register_async("wait_object", self._handle_wait_object)
         s.register("ping", lambda _p: "pong")
+        # Chunked object plane (pull_manager/push_manager parity): any
+        # object size crosses the wire as chunk frames with per-chunk
+        # acks and sender-side admission control.
+        from ray_tpu.rpc.chunked import serve_chunks
+        self.chunk_server = serve_chunks(
+            s, lambda oid_bin: self._handle_fetch_object(
+                {"object_id": oid_bin}))
         cluster.gcs.subscribe_node_death(self._on_node_death)
 
     @property
@@ -366,7 +373,16 @@ class HeadService:
                     return ("error", pickle.dumps(
                         exceptions.RayTpuError(str(entry.error))))
         blob = self._handle_fetch_object(payload)
-        return None if blob is None else ("ok", blob)
+        if blob is None:
+            return None
+        from ray_tpu._private.config import get_config
+        if len(blob) > get_config().object_manager_chunk_size:
+            # Hand back a session over the bytes we already hold —
+            # re-fetching them through fetch_meta would double the wire
+            # and memory cost of every big value.
+            meta = self.chunk_server.open_session(blob)
+            return ("chunked", meta)   # meta None -> caller retries
+        return ("ok", blob)
 
     def _handle_put_inline(self, payload) -> bool:
         core = self._cluster.core_worker

@@ -88,8 +88,9 @@ class _PeerStoreReader:
 
     def get_serialized(self, object_id: ObjectID
                        ) -> Optional[SerializedObject]:
-        blob = self._host.client.call(
-            "fetch_object", {"object_id": object_id.binary()}, timeout=60.0)
+        from ray_tpu.rpc.chunked import fetch_chunked
+        blob = fetch_chunked(self._host.client, object_id.binary(),
+                             timeout=300.0)
         return None if blob is None else SerializedObject.from_bytes(blob)
 
     def get(self, object_id: ObjectID):
@@ -206,6 +207,19 @@ class _RemoteCoreWorker:
                 kind, blob = result
                 if kind == "error":
                     raise pickle.loads(blob)
+                if kind == "chunked":
+                    from ray_tpu.rpc.chunked import (
+                        fetch_chunked, fetch_session)
+                    if blob is not None:     # pre-opened session meta
+                        blob = fetch_session(self._host.client, blob,
+                                             timeout=300.0)
+                    else:                    # admission-full: retry path
+                        blob = fetch_chunked(self._host.client,
+                                             object_id.binary(),
+                                             timeout=300.0)
+                    if blob is None:
+                        raise exceptions.ObjectLostError(
+                            object_id, "chunked arg fetch failed")
                 return deserialize(SerializedObject.from_bytes(blob))
             remaining = deadline - time.monotonic()
             if remaining <= 0:
@@ -293,7 +307,10 @@ class NodeHost:
         self.stopped = False
         self.client = RpcClient(tuple(head_address))
         self.adapter = _RemoteClusterAdapter(self)
-        self.raylet = Raylet(self.adapter, resources, node_name=node_name)
+        store_bytes = resources.get("object_store_memory")
+        self.raylet = Raylet(
+            self.adapter, resources, node_name=node_name,
+            object_store_memory=int(store_bytes) if store_bytes else None)
         self.core_shim = _RemoteCoreWorker(self)
         self.raylet.core_worker = self.core_shim
         self.adapter.core_worker = self.core_shim
@@ -318,6 +335,10 @@ class NodeHost:
         s.register("cancel_bundle", self._handle_cancel_bundle)
         s.register("ping", lambda _p: "pong")
         s.register("stop", self._handle_stop)
+        from ray_tpu.rpc.chunked import serve_chunks
+        self.chunk_server = serve_chunks(
+            s, lambda oid_bin: self._handle_fetch_object(
+                {"object_id": oid_bin}))
         self._stop_event = threading.Event()
 
         # Join the cluster (NodeInfoGcsService RegisterNode parity).

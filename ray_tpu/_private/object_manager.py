@@ -12,6 +12,7 @@ the chunked-flow structure the gRPC path would have.
 from __future__ import annotations
 
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Set
 
 from ray_tpu import exceptions
@@ -103,6 +104,14 @@ class NodeObjectManager:
         self._directory = directory
         self._lock = threading.Lock()
         self._inflight_pulls: Dict[ObjectID, List[Callable]] = {}
+        # Transfers run on their own IO pool — a multi-GiB pull on the
+        # raylet's event loop would stall its heartbeats and scheduling
+        # ticks (the reference's pull manager runs on dedicated io
+        # contexts for the same reason).
+        self._pull_pool = ThreadPoolExecutor(
+            max_workers=4,
+            thread_name_prefix=f"ray_tpu::pull::"
+                               f"{raylet.node_id.hex()[:6]}")
         self.stats = {"pulled_objects": 0, "pulled_bytes": 0,
                       "chunks_transferred": 0}
 
@@ -150,8 +159,7 @@ class NodeObjectManager:
 
         locations = self._directory.get_locations(object_id)
         if locations:
-            self._raylet.loop.post(
-                lambda: attempt(next(iter(locations))), "pull")
+            self._pull_pool.submit(attempt, next(iter(locations)))
             return
         # Freed object: nothing will ever produce it again — fail fast
         # instead of subscribing forever (the caller may try lineage
@@ -169,8 +177,7 @@ class NodeObjectManager:
         # the pull manager's retry loop + memory-store GetAsync.
         self._directory.subscribe_location(
             object_id,
-            lambda node_id: self._raylet.loop.post(
-                lambda: attempt(node_id), "pull"))
+            lambda node_id: self._pull_pool.submit(attempt, node_id))
         core = self._raylet.core_worker
         if core is not None:
             core.memory_store.get_async(

@@ -90,6 +90,13 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
         cluster.gcs.job_manager.add_job(w.job_id, job_config)
         w.connected = True
         w.mode = "local" if _cluster is None else "cluster"
+        if get_config().worker_process_mode == "process" and \
+                cluster.head_node is not None:
+            # Hide OS-process spawn latency behind init (reference:
+            # PrestartWorkers on driver start, worker_pool.h:350).
+            total = cluster.head_node.local_resources.to_float_dict("total")
+            cluster.head_node.worker_pool.prestart_workers(
+                min(int(total.get("CPU", 1)), 8))
         atexit.register(_atexit_shutdown)
         return RuntimeContextInfo(w)
 

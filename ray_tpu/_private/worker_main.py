@@ -16,11 +16,11 @@ Protocol here (framed RPC, ray_tpu.rpc):
      ``kv_get`` and cached; serialized return values ride back in the
      reply (the host stores them with owner semantics).
 
-Scope (v1): tasks and actors execute here; calling the ray_tpu API
-*from inside* a process-mode task (nested .remote) is not yet wired —
-that needs the full CoreWorker in the child, which is the thread-mode
-default's job.  Process mode exists to put real OS-process isolation
-and a real wire under the lease/execute path.
+Task bodies get the FULL public API: after registration the process's
+global worker is wired to the host via ``client_runtime`` (the
+reference's in-worker CoreWorker role), so nested ``.remote`` calls,
+``put/get/wait``, actor creation/lookup/kill all work from inside a
+process-mode task.
 """
 
 from __future__ import annotations
@@ -39,6 +39,32 @@ from ray_tpu._private.serialization import (
 from ray_tpu.rpc import RpcClient, RpcServer
 
 
+class _CtxSpec:
+    """Task-context slice for runtime_context inside the child (the host
+    ships the relevant spec fields in the push payload)."""
+
+    def __init__(self, payload):
+        from ray_tpu.scheduler.resources import ResourceRequest
+        self.task_id = payload.get("task_id")
+        self.actor_id = payload.get("actor_id")
+        self.task_type = payload.get("task_type", "NORMAL_TASK")
+        self.resources = ResourceRequest(payload.get("resources") or {})
+        lr = payload.get("lifetime_resources")
+        self.lifetime_resources = \
+            ResourceRequest(lr) if lr is not None else None
+        self.depth = 0
+        self.function_name = payload.get("function_name", "")
+        self.placement_group_id = payload.get("placement_group_id")
+        self.placement_group_bundle_index = \
+            payload.get("placement_group_bundle_index", -1)
+
+    def is_actor_creation(self) -> bool:
+        return self.task_type == "ACTOR_CREATION_TASK"
+
+    def is_actor_task(self) -> bool:
+        return self.task_type == "ACTOR_TASK"
+
+
 class _WorkerRuntime:
     def __init__(self, host: str, port: int, worker_id: str):
         self.worker_id = worker_id
@@ -54,11 +80,33 @@ class _WorkerRuntime:
         self._stop_event = threading.Event()
 
     def run(self):
+        # Nested-.remote support: wire this process's global worker to
+        # the host BEFORE registering — a task can be pushed the moment
+        # registration lands (client_runtime — the reference's in-worker
+        # CoreWorker role).
+        from ray_tpu._private import client_runtime
+        client_runtime.install(self.node_client,
+                               client_worker_id=self.worker_id)
         self.node_client.call("register_worker", {
             "worker_id": self.worker_id,
             "port": self.server.address[1],
             "pid": os.getpid(),
         })
+
+        # Orphan watchdog: if the host process dies without a clean
+        # "stop", exit rather than linger (reference: workers die with
+        # their raylet).
+        def watchdog():
+            while not self._stop_event.is_set():
+                try:
+                    self.node_client.call("ping", None, timeout=10.0)
+                except Exception:
+                    self._stop_event.set()
+                    return
+                self._stop_event.wait(timeout=5.0)
+
+        threading.Thread(target=watchdog, daemon=True,
+                         name="ray_tpu::worker::watchdog").start()
         self._stop_event.wait()
         self.server.stop()
 
@@ -79,6 +127,10 @@ class _WorkerRuntime:
             reply(self._execute(payload))
 
     def _execute(self, payload) -> dict:
+        from ray_tpu._private import worker_context
+        prev_ctx = worker_context.get_context()
+        worker_context.set_context(worker_context.ExecutionContext(
+            task_spec=_CtxSpec(payload), node=None, worker=None))
         try:
             args, kwargs = self._resolve_args(payload["args"])
             kind = payload["kind"]
@@ -109,6 +161,8 @@ class _WorkerRuntime:
                 blob = pickle.dumps(exceptions.RayTpuError(
                     "".join(traceback.format_exception(e))))
             return {"error": blob, "returns": []}
+        finally:
+            worker_context.set_context(prev_ctx)
 
     def _resolve_args(self, packed):
         from ray_tpu._private.executor import _split_args

@@ -184,11 +184,29 @@ class WorkerHostService:
         self._lock = threading.Lock()
         self._ports: Dict[str, int] = {}
         self._events: Dict[str, threading.Event] = {}
+        self._worker_pins: Dict[str, list] = {}
         self.server = RpcServer(
             name=f"workerhost-{node.node_id.hex()[:6]}")
         self.server.register("register_worker", self._register_worker)
+        self.server.register("ping", lambda _p: "pong")
         self.server.register("get_object", self._get_object)
         self.server.register("kv_get", self._kv_get)
+        # Client-runtime surface: process-mode workers drive the full
+        # public API (nested .remote, put/get/wait, actors) through
+        # these, with ownership kept by the host's core worker
+        # (reference: the worker's CoreWorker talking to its raylet +
+        # GCS, collapsed onto the host service).
+        self.server.register("runtime_info", self._runtime_info)
+        self.server.register("kv_put", self._kv_put)
+        self.server.register("submit_task", self._submit_task)
+        self.server.register("submit_actor_task", self._submit_actor_task)
+        self.server.register("create_actor", self._create_actor)
+        self.server.register("actor_info", self._actor_info)
+        self.server.register("named_actor_info", self._named_actor_info)
+        self.server.register("kill_actor", self._kill_actor)
+        self.server.register("put_object", self._put_object)
+        self.server.register("get_value", self._get_value)
+        self.server.register("wait_refs", self._wait_refs)
 
     @property
     def port(self) -> int:
@@ -227,6 +245,137 @@ class WorkerHostService:
 
     def _kv_get(self, key: bytes) -> Optional[bytes]:
         return self._node.cluster.gcs.kv.get(key)
+
+    def _kv_put(self, payload) -> bool:
+        return self._node.cluster.gcs.kv.put(
+            payload["key"], payload["value"],
+            overwrite=payload.get("overwrite", True))
+
+    def _core(self):
+        core = self._node.core_worker
+        if core is None:
+            raise RuntimeError("host node has no core worker attached")
+        return core
+
+    def _runtime_info(self, _payload) -> dict:
+        core = self._core()
+        from ray_tpu._private.ids import JobID, WorkerID
+        from ray_tpu._private.worker import global_worker_or_none
+        w = global_worker_or_none()
+        # On a NodeHost spoke the "core" is the remote shim — it carries
+        # the head's identifiers (wired at registration); tolerate their
+        # absence rather than killing the spawning worker.
+        job_id = getattr(core, "job_id", None) or JobID.nil()
+        owner = getattr(core, "worker_id", None) or WorkerID.from_random()
+        return {
+            "job_id": job_id,
+            "owner_id": owner,
+            "namespace": getattr(w, "namespace", "") if w else "",
+            "node_id": self._node.node_id,
+        }
+
+    def _submit_task(self, payload) -> bool:
+        self._core().submit_task(payload["spec"])
+        return True
+
+    def _submit_actor_task(self, payload) -> bool:
+        self._core().submit_actor_task(payload["spec"])
+        return True
+
+    def _create_actor(self, payload) -> bool:
+        self._core().create_actor(
+            payload["spec"], name=payload.get("name", ""),
+            namespace=payload.get("namespace", ""),
+            detached=payload.get("detached", False))
+        return True
+
+    def _actor_record(self, actor):
+        import pickle
+        if actor is None:
+            return None
+        return {"actor_id": actor.actor_id,
+                "class_name": actor.info().get("class_name", ""),
+                "state": actor.state,
+                "num_restarts": actor.num_restarts,
+                "spec_blob": pickle.dumps(actor.creation_spec,
+                                          protocol=5)}
+
+    def _actor_info(self, payload):
+        return self._actor_record(
+            self._node.cluster.gcs.actor_manager.get_actor(
+                payload["actor_id"]))
+
+    def _named_actor_info(self, payload):
+        return self._actor_record(
+            self._node.cluster.gcs.actor_manager.get_named_actor(
+                payload["name"], payload.get("namespace", "")))
+
+    def _kill_actor(self, payload) -> bool:
+        self._node.cluster.gcs.actor_manager.destroy_actor(
+            payload["actor_id"],
+            no_restart=payload.get("no_restart", True))
+        return True
+
+    def _put_object(self, payload):
+        from ray_tpu._private.serialization import (
+            SerializedObject, deserialize)
+        value = deserialize(SerializedObject.from_bytes(payload["blob"]))
+        ref = self._core().put(value)
+        # The host-side handle is dropped after this reply; pin through
+        # the owner table, scoped to the calling WORKER's lifetime so the
+        # store doesn't grow for the whole job (released in
+        # release_worker_pins when the worker exits).
+        self._core().reference_counter.add_local_ref(ref.object_id())
+        wid = payload.get("worker_id")
+        if wid:
+            with self._lock:
+                self._worker_pins.setdefault(wid, []).append(
+                    ref.object_id())
+        return {"object_id": ref.object_id(), "owner_id": ref.owner_id()}
+
+    def release_worker_pins(self, worker_id_hex: str):
+        """Drop the put-object pins a (now dead) worker accumulated."""
+        with self._lock:
+            oids = self._worker_pins.pop(worker_id_hex, [])
+        core = self._node.core_worker
+        if core is None:
+            return
+        for oid in oids:
+            try:
+                core.reference_counter.remove_local_ref(oid)
+            except Exception:
+                pass
+
+    def _get_value(self, payload):
+        import pickle
+
+        from ray_tpu import exceptions
+        from ray_tpu._private.object_ref import ObjectRef
+        from ray_tpu._private.serialization import serialize
+        ref = ObjectRef(payload["object_id"],
+                        skip_adding_local_ref=True)
+        try:
+            value = self._core().get([ref],
+                                     timeout=payload.get("timeout"))[0]
+        except exceptions.GetTimeoutError:
+            return None
+        except Exception as e:   # noqa: BLE001 — ship the user error
+            try:
+                return ("error", pickle.dumps(e))
+            except Exception:
+                return ("error", pickle.dumps(
+                    exceptions.RayTpuError(str(e))))
+        return ("ok", serialize(value).to_bytes())
+
+    def _wait_refs(self, payload):
+        from ray_tpu._private.object_ref import ObjectRef
+        refs = [ObjectRef(oid, skip_adding_local_ref=True)
+                for oid in payload["object_ids"]]
+        ready, rest = self._core().wait(
+            refs, num_returns=payload.get("num_returns", 1),
+            timeout=payload.get("timeout"))
+        return {"ready": [r.object_id() for r in ready],
+                "not_ready": [r.object_id() for r in rest]}
 
     def stop(self):
         self.server.stop()
@@ -293,11 +442,32 @@ class ProcessWorker:
         self._killed.set()
         self._queue.put(("exit", None, None))
 
+        # The pump may be blocked inside a roundtrip for a long-running
+        # task; don't leave the OS process orphaned behind it.
+        def reap():
+            try:
+                self._proc.wait(timeout=5.0)
+            except Exception:
+                try:
+                    self._proc.terminate()
+                    self._proc.wait(timeout=5.0)
+                except Exception:
+                    try:
+                        self._proc.kill()
+                    except Exception:
+                        pass
+
+        threading.Thread(target=reap, daemon=True,
+                         name="ray_tpu::reap::"
+                              + self.worker_id.hex()[:8]).start()
+
     # ---- pump ----------------------------------------------------------
     def _pump_loop(self):
         from ray_tpu.rpc import RpcClient
+        # Generous: on a loaded small box, N concurrently spawned
+        # children serialize their interpreter+numpy imports.
         port = self._pool.host_service().wait_for_worker(
-            self.worker_id.hex(), timeout=30.0)
+            self.worker_id.hex(), timeout=120.0)
         if port is None:
             self._fail_until_exit("worker process failed to register")
             return
@@ -378,6 +548,17 @@ class ProcessWorker:
             "return_ids": [oid.binary() for oid in spec.return_ids],
             "max_concurrency": spec.max_concurrency,
             "args": args,
+            # Context for runtime_context inside the child.
+            "task_id": spec.task_id,
+            "actor_id": spec.actor_id,
+            "resources": spec.resources.to_dict(),
+            "placement_group_id": spec.placement_group_id,
+            "placement_group_bundle_index":
+                spec.placement_group_bundle_index,
+            "lifetime_resources":
+                spec.lifetime_resources.to_dict()
+                if spec.lifetime_resources is not None else None,
+            "task_type": spec.task_type,
         }
 
     def _store_returns(self, returns):
@@ -413,6 +594,12 @@ class ProcessWorker:
     def _on_exit(self):
         was_actor = self.state == WorkerState.ACTOR
         self.state = WorkerState.DEAD
+        host = self._pool._host_service
+        if host is not None:
+            try:
+                host.release_worker_pins(self.worker_id.hex())
+            except Exception:
+                pass
         if self._client is not None:
             try:
                 self._client.call("stop", None, timeout=2.0)
@@ -466,13 +653,23 @@ class WorkerPool:
         return Worker(self, self._node)
 
     def prestart_workers(self, n: int):
+        """Construct outside the lock (same rule as pop_worker: a
+        process-mode spawn must not stall concurrent lease traffic)."""
         with self._lock:
-            for _ in range(n):
-                if len(self._all) >= self._max_workers:
-                    break
-                w = self._new_worker()
-                self._all[w.worker_id] = w
-                self._idle.append(w)
+            capacity = self._max_workers - len(self._all) - self._starting
+            count = max(0, min(n, capacity,
+                               self._max_starting - self._starting))
+            self._starting += count
+        created = []
+        try:
+            for _ in range(count):
+                created.append(self._new_worker())
+        finally:
+            with self._lock:
+                self._starting -= count
+                for w in created:
+                    self._all[w.worker_id] = w
+                    self._idle.append(w)
 
     def pop_worker(self, runtime_env=None) -> Optional[Worker]:
         """Lease an idle worker, starting one if under the cap
@@ -539,9 +736,19 @@ class WorkerPool:
                 return
             worker.state = WorkerState.IDLE
             if len(self._idle) >= self._soft_limit:
-                worker.stop()
-            else:
-                self._idle.append(worker)
+                if not self._idle:
+                    # soft_limit == 0: keep no idle workers at all.
+                    self._all.pop(worker.worker_id, None)
+                    worker.stop()
+                    return
+                # Evict the OLDEST idle worker, not the returning one —
+                # the most recently used worker (with its runtime env and
+                # warm caches) is the one worth keeping (reference: idle
+                # worker killing is LRU, ray_config_def.h:129).
+                victim = self._idle.pop(0)
+                self._all.pop(victim.worker_id, None)
+                victim.stop()
+            self._idle.append(worker)
 
     def promote_to_actor(self, worker: Worker):
         with self._lock:

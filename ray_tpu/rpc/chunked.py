@@ -1,0 +1,187 @@
+"""Chunked object transfer over the framed RPC — the object plane's
+push/pull internals.
+
+Parity: reference ``src/ray/object_manager/`` — ``PullManager``
+(admission-controlled pulls, pull_manager.cc), ``PushManager`` (chunked
+sends, push_manager.cc:95), ``ObjectBufferPool`` (chunk assembly).  The
+receiver drives the flow: each ``chunk`` request doubles as the ack for
+the previous chunk (per-chunk ack + backpressure in one message), a
+bounded number of chunk requests is pipelined to hide latency, and the
+sender's admission control caps concurrent transfer sessions and bytes
+held.
+
+This lifts the single-frame ceiling (``wire.MAX_FRAME``): an object of
+any size crosses as ``object_manager_chunk_size`` frames.
+
+Wire surface (register via :func:`serve_chunks` on any RpcServer):
+
+    fetch_meta   {object_id}        -> None | {"inline": bytes}
+                                       | {"token", "size", "chunk_size"}
+                                       | {"busy": True}
+    fetch_chunk  {token, index}     -> bytes
+    fetch_close  {token}            -> True
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Callable, Dict, Optional
+
+from ray_tpu._private.config import get_config
+
+
+class _Session:
+    __slots__ = ("blob", "created", "last_access")
+
+    def __init__(self, blob: bytes):
+        self.blob = blob
+        self.created = time.monotonic()
+        self.last_access = self.created
+
+
+class ChunkServer:
+    """Sender side: sessions over serialized blobs with admission
+    control (PushManager parity)."""
+
+    SESSION_TTL_S = 120.0
+
+    def __init__(self, get_blob: Callable[[bytes], Optional[bytes]],
+                 max_sessions: int = 8):
+        self._get_blob = get_blob
+        self._max_sessions = max_sessions
+        self._lock = threading.Lock()
+        self._sessions: Dict[str, _Session] = {}
+
+    # ---- handlers ------------------------------------------------------
+    def handle_meta(self, payload):
+        blob = self._get_blob(payload["object_id"])
+        if blob is None:
+            return None
+        chunk = get_config().object_manager_chunk_size
+        if len(blob) <= chunk:
+            return {"inline": blob}
+        with self._lock:
+            self._expire_locked()
+            if len(self._sessions) >= self._max_sessions:
+                # Admission control: receiver backs off and retries
+                # (pull_manager.cc bounded active pulls).
+                return {"busy": True}
+            token = uuid.uuid4().hex
+            self._sessions[token] = _Session(blob)
+        return {"token": token, "size": len(blob), "chunk_size": chunk}
+
+    def open_session(self, blob: bytes) -> Optional[dict]:
+        """Open a transfer session over an ALREADY-materialized blob
+        (lets composite handlers avoid fetching the bytes twice);
+        returns the meta dict, or None when admission-full."""
+        chunk = get_config().object_manager_chunk_size
+        with self._lock:
+            self._expire_locked()
+            if len(self._sessions) >= self._max_sessions:
+                return None
+            token = uuid.uuid4().hex
+            self._sessions[token] = _Session(blob)
+        return {"token": token, "size": len(blob), "chunk_size": chunk}
+
+    def handle_chunk(self, payload) -> Optional[bytes]:
+        token, index = payload["token"], payload["index"]
+        with self._lock:
+            session = self._sessions.get(token)
+            if session is None:
+                return None
+            session.last_access = time.monotonic()
+            blob = session.blob
+        chunk = get_config().object_manager_chunk_size
+        start = index * chunk
+        return blob[start:start + chunk]
+
+    def handle_close(self, payload) -> bool:
+        with self._lock:
+            return self._sessions.pop(payload["token"], None) is not None
+
+    def _expire_locked(self):
+        now = time.monotonic()
+        for token in [t for t, s in self._sessions.items()
+                      if now - s.last_access > self.SESSION_TTL_S]:
+            del self._sessions[token]
+
+
+def serve_chunks(server, get_blob: Callable[[bytes], Optional[bytes]],
+                 max_sessions: int = 8,
+                 prefix: str = "fetch") -> ChunkServer:
+    """Register the chunk protocol on an RpcServer."""
+    cs = ChunkServer(get_blob, max_sessions=max_sessions)
+    server.register(f"{prefix}_meta", cs.handle_meta)
+    server.register(f"{prefix}_chunk", cs.handle_chunk)
+    server.register(f"{prefix}_close", cs.handle_close)
+    return cs
+
+
+def fetch_chunked(client, object_id_bin: bytes,
+                  timeout: float = 300.0, prefix: str = "fetch",
+                  pipeline: int = 4) -> Optional[bytes]:
+    """Receiver side: pull an object of any size as chunk frames.
+
+    Pipelines ``pipeline`` chunk requests to hide round-trip latency;
+    each completed request implicitly acks its chunk.  ``busy`` replies
+    back off and retry until the deadline (admission control)."""
+    deadline = time.monotonic() + timeout
+    backoff = 0.02
+    while True:
+        meta = client.call(f"{prefix}_meta", {"object_id": object_id_bin},
+                           timeout=min(60.0, timeout))
+        if meta is None:
+            return None
+        if "inline" in meta:
+            return meta["inline"]
+        if meta.get("busy"):
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 1.0)
+            continue
+        break
+    return fetch_session(client, meta, timeout=timeout, prefix=prefix,
+                         pipeline=pipeline)
+
+
+def fetch_session(client, meta: dict, timeout: float = 300.0,
+                  prefix: str = "fetch",
+                  pipeline: int = 4) -> Optional[bytes]:
+    """Pull an already-opened transfer session to completion."""
+    deadline = time.monotonic() + timeout
+    token, size, chunk = meta["token"], meta["size"], meta["chunk_size"]
+    n_chunks = (size + chunk - 1) // chunk
+    out = bytearray(size)
+    try:
+        next_index = 0
+        inflight = {}
+        received = 0
+        while received < n_chunks:
+            while next_index < n_chunks and len(inflight) < pipeline:
+                inflight[next_index] = client.call_future(
+                    f"{prefix}_chunk", {"token": token,
+                                        "index": next_index})
+                next_index += 1
+            # Wait for the OLDEST in flight (ordered assembly keeps the
+            # buffer write sequential and the ack stream dense).
+            index = min(inflight)
+            fut = inflight.pop(index)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            data = fut.result(timeout=remaining)
+            if data is None:
+                return None       # session expired sender-side
+            start = index * chunk
+            out[start:start + len(data)] = data
+            received += 1
+        return bytes(out)
+    finally:
+        try:
+            client.call_async(f"{prefix}_close", {"token": token},
+                              lambda _r, _e: None)
+        except Exception:
+            pass

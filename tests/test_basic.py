@@ -135,17 +135,24 @@ def test_nested_tasks(ray_start_regular):
 def test_wait(ray_start_regular):
     import time
 
+    # Process-mode workers pay OS-spawn latency; scale the windows so
+    # the semantics (one ready, one not) stay the thing under test.
+    import os as _os
+    slow_mode = _os.environ.get("RAY_TPU_WORKER_PROCESS_MODE") == "process"
+    wait_timeout, slow_sleep = (30, 120) if slow_mode else (3, 5)
+
     @ray_tpu.remote
     def fast():
         return 1
 
     @ray_tpu.remote
-    def slow():
-        time.sleep(5)
+    def slow(t):
+        time.sleep(t)
         return 2
 
-    refs = [fast.remote(), slow.remote()]
-    ready, not_ready = ray_tpu.wait(refs, num_returns=1, timeout=3)
+    refs = [fast.remote(), slow.remote(slow_sleep)]
+    ready, not_ready = ray_tpu.wait(refs, num_returns=1,
+                                    timeout=wait_timeout)
     assert len(ready) == 1 and len(not_ready) == 1
 
 

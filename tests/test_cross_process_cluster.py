@@ -184,3 +184,85 @@ class TestCrossProcessCluster:
             timeout=10.0)
         # Node 1 still works after its peer left.
         assert ray_tpu.get(on_a.remote(), timeout=30) == h1.proc.pid
+
+
+class TestChunkedObjectPlane:
+    """Chunked transfer internals + big objects over the real wire
+    (pull_manager/push_manager parity; lifts the single-frame cap)."""
+
+    def test_chunk_protocol_roundtrip(self):
+        import os as _os
+
+        from ray_tpu.rpc import RpcClient, RpcServer
+        from ray_tpu.rpc.chunked import fetch_chunked, serve_chunks
+        blob = _os.urandom(23 * 1024 * 1024 + 12345)   # ~5 chunks, ragged
+        server = RpcServer(name="chunks")
+        serve_chunks(server, lambda oid: blob if oid == b"k" else None)
+        client = RpcClient(server.address)
+        try:
+            assert fetch_chunked(client, b"k") == blob
+            assert fetch_chunked(client, b"missing") is None
+            small_server = RpcServer(name="chunks2")
+            serve_chunks(small_server, lambda oid: b"tiny")
+            c2 = RpcClient(small_server.address)
+            assert fetch_chunked(c2, b"x") == b"tiny"   # inline path
+            c2.close()
+            small_server.stop()
+        finally:
+            client.close()
+            server.stop()
+
+    def test_admission_control_busy_then_served(self):
+        from ray_tpu.rpc import RpcClient, RpcServer
+        from ray_tpu.rpc.chunked import fetch_chunked, serve_chunks
+        blob = b"z" * (11 * 1024 * 1024)
+        server = RpcServer(name="chunks3")
+        cs = serve_chunks(server, lambda oid: blob, max_sessions=1)
+        client = RpcClient(server.address)
+        try:
+            # Occupy the only session slot...
+            meta = client.call("fetch_meta", {"object_id": b"a"})
+            assert "token" in meta
+            # ...a second transfer is refused (admission control)...
+            assert client.call("fetch_meta", {"object_id": b"b"}) == \
+                {"busy": True}
+            # ...and proceeds once the slot frees (fetch_chunked retries).
+            client.call("fetch_close", {"token": meta["token"]})
+            assert fetch_chunked(client, b"b", timeout=30.0) == blob
+        finally:
+            client.close()
+            server.stop()
+
+    @pytest.fixture
+    def relaxed_cluster(self):
+        """Multi-GiB serialization holds the GIL for seconds on a small
+        box; give heartbeats real slack so the transfer isn't mistaken
+        for node death."""
+        ray_tpu.init(num_cpus=2, object_store_memory=12 * 1024**3,
+                     _system_config={
+                         "scheduler_backend": "native",
+                         "raylet_heartbeat_period_milliseconds": 200,
+                         "num_heartbeats_timeout": 150,  # 30 s of slack
+                     })
+        yield global_worker().cluster
+        ray_tpu.shutdown()
+
+    def test_big_object_exceeding_frame_cap_crosses_wire(
+            self, relaxed_cluster):
+        """An object larger than wire.MAX_FRAME (1 GiB) returns from a
+        NodeHost OS process — only possible chunked."""
+        relaxed_cluster.add_remote_node(
+            num_cpus=2, resources={"spoke": 4.0},
+            memory=16 * 1024**3, object_store_memory=12 * 1024**3)
+
+        @ray_tpu.remote(resources={"spoke": 1.0})
+        def make_big(n):
+            return np.arange(n, dtype=np.float64)
+
+        # 1.5 GiB (> the 1 GiB frame cap) by default; the full 4 GiB
+        # envelope row is opt-in (serialize-bound: minutes on 1 CPU).
+        gib = 4.0 if os.environ.get("RAY_TPU_TEST_HUGE") else 1.5
+        n = int(gib * 1024**3) // 8
+        arr = ray_tpu.get(make_big.remote(n), timeout=900)
+        assert arr.shape == (n,)
+        assert arr[0] == 0 and arr[-1] == n - 1

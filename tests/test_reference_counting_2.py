@@ -128,18 +128,28 @@ class TestSubmittedTaskRefs:
         del out
         assert _gone(oid), "failure path must release the task's arg pin"
 
-    def test_chained_dependency_release_order(self, ray_start_regular):
+    def test_chained_dependency_release_order(self, ray_start_regular,
+                                              tmp_path):
+        gate = str(tmp_path / "gate")
+
         @ray_tpu.remote
         def grow(x):
             return np.concatenate([x, x])
 
+        @ray_tpu.remote
+        def gated_grow(x, gate_path):
+            while not __import__("os").path.exists(gate_path):
+                time.sleep(0.02)
+            return np.concatenate([x, x])
+
         a = grow.remote(np.ones(BIG // 2, dtype=np.uint8))
-        b = grow.remote(a)
+        b = gated_grow.remote(a, gate)   # deterministically still pending
         a_id = a.object_id()
         del a
         gc.collect()
         assert _rc().has_reference(a_id), "b's pending spec pins a"
-        assert ray_tpu.get(b).shape == (BIG * 2,)
+        open(gate, "w").close()
+        assert ray_tpu.get(b, timeout=30).shape == (BIG * 2,)
         assert _gone(a_id)
 
 

@@ -174,3 +174,75 @@ class TestProcessWorkers:
             [101, 102, 103, 104, 105]
         assert ray_tpu.get(c.where.remote()) != os.getpid()
         ray_tpu.kill(c)
+
+
+class TestNestedRemoteInProcessWorkers:
+    """Process-mode workers drive the full public API through the host
+    (client_runtime): nested tasks, put/get/wait, actors from tasks."""
+
+    def test_nested_remote(self, process_mode_cluster):
+        @ray_tpu.remote
+        def inner(x):
+            return os.getpid(), x * 2
+
+        @ray_tpu.remote
+        def outer(x):
+            pid_inner, doubled = ray_tpu.get(inner.remote(x))
+            return os.getpid(), pid_inner, doubled
+
+        outer_pid, inner_pid, val = ray_tpu.get(outer.remote(21),
+                                                timeout=60)
+        assert val == 42
+        assert outer_pid != os.getpid()
+        assert inner_pid != os.getpid()
+
+    def test_put_get_wait_inside_worker(self, process_mode_cluster):
+        @ray_tpu.remote
+        def use_api():
+            ref = ray_tpu.put(np.arange(10))
+            ready, rest = ray_tpu.wait([ref], num_returns=1, timeout=10)
+            assert ready and not rest
+            return float(ray_tpu.get(ref).sum())
+
+        assert ray_tpu.get(use_api.remote(), timeout=60) == 45.0
+
+    def test_actor_created_from_inside_task(self, process_mode_cluster):
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+                return self.n
+
+        @ray_tpu.remote
+        def spawn_and_use():
+            c = Counter.remote()
+            return [ray_tpu.get(c.bump.remote()) for _ in range(3)]
+
+        assert ray_tpu.get(spawn_and_use.remote(), timeout=60) == [1, 2, 3]
+
+    def test_fan_out_from_worker(self, process_mode_cluster):
+        @ray_tpu.remote
+        def leaf(i):
+            return i * i
+
+        @ray_tpu.remote
+        def fan(n):
+            return sum(ray_tpu.get([leaf.remote(i) for i in range(n)]))
+
+        assert ray_tpu.get(fan.remote(6), timeout=180) == sum(
+            i * i for i in range(6))
+
+    def test_nested_error_propagates(self, process_mode_cluster):
+        @ray_tpu.remote
+        def bad():
+            raise KeyError("inner-kaboom")
+
+        @ray_tpu.remote
+        def outer():
+            return ray_tpu.get(bad.remote())
+
+        with pytest.raises(KeyError, match="inner-kaboom"):
+            ray_tpu.get(outer.remote(), timeout=60)
