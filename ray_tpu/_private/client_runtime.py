@@ -205,12 +205,25 @@ class ClientCoreWorker:
 
     def get(self, refs: Sequence[ObjectRef],
             timeout: Optional[float] = None) -> List[Any]:
+        """Overall deadline across ALL refs (reference ray.get
+        semantics), with the host round-trips issued concurrently."""
+        import time
+
+        deadline = None if timeout is None else \
+            time.monotonic() + timeout
+        futures = [self._client.call_future(
+            "get_value", {"object_id": ref.object_id(),
+                          "timeout": timeout})
+            for ref in refs]
         out = []
-        for ref in refs:
-            result = self._client.call(
-                "get_value",
-                {"object_id": ref.object_id(), "timeout": timeout},
-                timeout=None if timeout is None else timeout + 30.0)
+        for ref, fut in zip(refs, futures):
+            remaining = None if deadline is None else \
+                max(0.0, deadline - time.monotonic()) + 30.0
+            try:
+                result = fut.result(timeout=remaining)
+            except TimeoutError:
+                raise exceptions.GetTimeoutError(
+                    f"Get timed out for {ref.object_id()}")
             if result is None:
                 raise exceptions.GetTimeoutError(
                     f"Get timed out for {ref.object_id()}")
@@ -220,6 +233,12 @@ class ClientCoreWorker:
                 if isinstance(err, exceptions.TaskError):
                     raise err.as_instanceof_cause()
                 raise err
+            if kind == "chunked":
+                from ray_tpu.rpc.chunked import fetch_session
+                blob = fetch_session(self._client, blob, timeout=600.0)
+                if blob is None:
+                    raise exceptions.ObjectLostError(
+                        ref.object_id(), "chunked client fetch failed")
             out.append(deserialize(SerializedObject.from_bytes(blob)))
         return out
 

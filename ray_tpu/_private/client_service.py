@@ -1,0 +1,144 @@
+"""The client-runtime wire surface, shared by every host of client-mode
+core workers.
+
+Parity: reference Ray Client (``src/ray/protobuf/ray_client.proto:300``
+``RayletDriver`` + ``python/ray/util/client/server/``): remote drivers
+(``init(address="ray-tpu://...")``) AND process-mode workers
+(``worker_main`` nested API) both drive the cluster through the same
+handlers — submissions ship as locally-built TaskSpecs, ownership stays
+with the serving core worker.
+
+One implementation, two hosts: the HeadService (remote drivers) and the
+WorkerHostService (process workers).  Big ``get_value`` replies hand
+back a chunk session instead of one oversized frame.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Callable, Optional
+
+from ray_tpu import exceptions
+from ray_tpu._private.ids import JobID, WorkerID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.serialization import (
+    SerializedObject, deserialize, serialize)
+
+
+def register_client_surface(server, *, core: Callable, kv,
+                            actor_manager: Callable, node_id_fn: Callable,
+                            namespace_fn: Optional[Callable] = None,
+                            chunk_server=None,
+                            pin_cb: Optional[Callable] = None):
+    """Register the remote-driver API.
+
+    ``core``/``actor_manager``/``node_id_fn`` are zero-arg callables
+    (late-bound: the backing objects can be swapped, e.g. on GCS
+    restart).  ``pin_cb(worker_id_hex, object_id)`` scopes put-object
+    pins to a client's lifetime where the host tracks one.
+    """
+
+    def runtime_info(_payload) -> dict:
+        c = core()
+        ns = namespace_fn() if namespace_fn else ""
+        return {
+            "job_id": getattr(c, "job_id", None) or JobID.nil(),
+            "owner_id": getattr(c, "worker_id", None) or
+            WorkerID.from_random(),
+            "namespace": ns,
+            "node_id": node_id_fn(),
+        }
+
+    def kv_put(payload) -> bool:
+        return kv.put(payload["key"], payload["value"],
+                      overwrite=payload.get("overwrite", True))
+
+    def submit_task(payload) -> bool:
+        core().submit_task(payload["spec"])
+        return True
+
+    def submit_actor_task(payload) -> bool:
+        core().submit_actor_task(payload["spec"])
+        return True
+
+    def create_actor(payload) -> bool:
+        core().create_actor(payload["spec"],
+                            name=payload.get("name", ""),
+                            namespace=payload.get("namespace", ""),
+                            detached=payload.get("detached", False))
+        return True
+
+    def _actor_record(actor):
+        if actor is None:
+            return None
+        return {"actor_id": actor.actor_id,
+                "class_name": actor.info().get("class_name", ""),
+                "state": actor.state,
+                "num_restarts": actor.num_restarts,
+                "spec_blob": pickle.dumps(actor.creation_spec, protocol=5)}
+
+    def actor_info(payload):
+        return _actor_record(actor_manager().get_actor(payload["actor_id"]))
+
+    def named_actor_info(payload):
+        return _actor_record(actor_manager().get_named_actor(
+            payload["name"], payload.get("namespace", "")))
+
+    def kill_actor(payload) -> bool:
+        actor_manager().destroy_actor(
+            payload["actor_id"], no_restart=payload.get("no_restart", True))
+        return True
+
+    def put_object(payload):
+        value = deserialize(SerializedObject.from_bytes(payload["blob"]))
+        c = core()
+        ref = c.put(value)
+        # Host-side handle drops after this reply; pin through the owner
+        # table (scoped per client when the host tracks one, else until
+        # host shutdown).
+        c.reference_counter.add_local_ref(ref.object_id())
+        if pin_cb is not None and payload.get("worker_id"):
+            pin_cb(payload["worker_id"], ref.object_id())
+        return {"object_id": ref.object_id(), "owner_id": ref.owner_id()}
+
+    def get_value(payload):
+        ref = ObjectRef(payload["object_id"], skip_adding_local_ref=True)
+        try:
+            value = core().get([ref], timeout=payload.get("timeout"))[0]
+        except exceptions.GetTimeoutError:
+            return None
+        except Exception as e:   # noqa: BLE001 — ship the user error
+            try:
+                return ("error", pickle.dumps(e))
+            except Exception:
+                return ("error", pickle.dumps(
+                    exceptions.RayTpuError(str(e))))
+        blob = serialize(value).to_bytes()
+        from ray_tpu._private.config import get_config
+        if chunk_server is not None and \
+                len(blob) > get_config().object_manager_chunk_size:
+            meta = chunk_server.open_session(blob)
+            if meta is not None:
+                return ("chunked", meta)
+        return ("ok", blob)
+
+    def wait_refs(payload):
+        refs = [ObjectRef(oid, skip_adding_local_ref=True)
+                for oid in payload["object_ids"]]
+        ready, rest = core().wait(refs,
+                                  num_returns=payload.get("num_returns", 1),
+                                  timeout=payload.get("timeout"))
+        return {"ready": [r.object_id() for r in ready],
+                "not_ready": [r.object_id() for r in rest]}
+
+    server.register("runtime_info", runtime_info)
+    server.register("kv_put", kv_put)
+    server.register("submit_task", submit_task)
+    server.register("submit_actor_task", submit_actor_task)
+    server.register("create_actor", create_actor)
+    server.register("actor_info", actor_info)
+    server.register("named_actor_info", named_actor_info)
+    server.register("kill_actor", kill_actor)
+    server.register("put_object", put_object)
+    server.register("get_value", get_value)
+    server.register("wait_refs", wait_refs)

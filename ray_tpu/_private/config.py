@@ -91,6 +91,13 @@ class Config:
     #: thousands of dedicated actor workers, reference supports 10k+).
     max_workers_per_node: int = 20_000
 
+    # ------ rpc ------
+    #: Dispatch threads per RpcServer; requests beyond BOTH the pool and
+    #: its queue get dedicated threads so blocking handlers can never
+    #: deadlock the pool (reference: grpc server completion-queue
+    #: thread pool).
+    rpc_dispatch_pool_size: int = 64
+
     # ------ GCS ------
     gcs_storage_backend: str = "memory"  # "memory" | "file"
     gcs_rpc_server_reconnect_timeout_s: int = 60

@@ -1,0 +1,45 @@
+"""Daemon thread pool: bounded workers that never block process exit.
+
+``concurrent.futures.ThreadPoolExecutor`` threads are non-daemon and are
+joined at interpreter shutdown — one handler blocked in a long wait
+would hang the process forever.  Server dispatch and object-plane
+transfers instead run on these daemon workers (the reference's io
+contexts are likewise detached from process teardown).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable
+
+
+class DaemonPool:
+    def __init__(self, max_workers: int, name: str = "pool"):
+        self._queue: "queue.Queue" = queue.Queue()
+        self._stopped = threading.Event()
+        self._threads = []
+        for i in range(max_workers):
+            t = threading.Thread(target=self._loop, daemon=True,
+                                 name=f"{name}::{i}")
+            t.start()
+            self._threads.append(t)
+
+    def submit(self, fn: Callable, *args):
+        if self._stopped.is_set():
+            raise RuntimeError("pool stopped")
+        self._queue.put((fn, args))
+
+    def _loop(self):
+        while not self._stopped.is_set():
+            try:
+                fn, args = self._queue.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            try:
+                fn(*args)
+            except Exception:
+                pass  # dispatch errors are the callee's to report
+
+    def stop(self):
+        self._stopped.set()

@@ -85,6 +85,8 @@ def main(argv=None) -> int:
     parser.add_argument("--address-file", default=DEFAULT_ADDRESS_FILE,
                         help="where to write host:port for the CLI")
     parser.add_argument("--system-config", default="")
+    parser.add_argument("--dashboard-port", type=int, default=0,
+                        help="REST/metrics dashboard port (0 = ephemeral)")
     args = parser.parse_args(argv)
 
     import ray_tpu
@@ -100,6 +102,9 @@ def main(argv=None) -> int:
     host, port = cluster.start_head_service(port=args.port)
     job_manager = JobManager(cluster)
     register_operator_handlers(cluster, job_manager)
+    from ray_tpu.dashboard.head import start_dashboard
+    dashboard = start_dashboard(cluster, job_manager,
+                                port=args.dashboard_port)
 
     stop = threading.Event()
     cluster.head_service.server.register(
@@ -112,7 +117,11 @@ def main(argv=None) -> int:
         f.write(f"{host}:{port}")
     print(f"ray_tpu head listening on {host}:{port} "
           f"(address file: {args.address_file})", flush=True)
+    if dashboard is not None:
+        print(f"dashboard at {dashboard.url}", flush=True)
     stop.wait()
+    if dashboard is not None:
+        dashboard.stop()
     job_manager.shutdown()
     ray_tpu.shutdown()
     try:

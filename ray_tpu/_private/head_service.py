@@ -262,11 +262,35 @@ class HeadService:
         self.chunk_server = serve_chunks(
             s, lambda oid_bin: self._handle_fetch_object(
                 {"object_id": oid_bin}))
+        # Remote-driver surface (Ray Client parity): drivers in other
+        # processes connect via init(address="ray-tpu://host:port").
+        from ray_tpu._private.client_service import register_client_surface
+        from ray_tpu._private.worker import global_worker_or_none
+
+        def _namespace():
+            w = global_worker_or_none()
+            return getattr(w, "namespace", "") if w else ""
+
+        register_client_surface(
+            s,
+            core=lambda: self._require_core(),
+            kv=cluster.gcs.kv,
+            actor_manager=lambda: self._cluster.gcs.actor_manager,
+            node_id_fn=lambda: (cluster.head_node.node_id
+                                if cluster.head_node else None),
+            namespace_fn=_namespace,
+            chunk_server=self.chunk_server)
         cluster.gcs.subscribe_node_death(self._on_node_death)
 
     @property
     def address(self):
         return self.server.address
+
+    def _require_core(self):
+        core = self._cluster.core_worker
+        if core is None:
+            raise RuntimeError("head has no core worker attached")
+        return core
 
     # ---- membership ----------------------------------------------------
     def _handle_register_node(self, payload) -> bool:

@@ -12,7 +12,6 @@ the chunked-flow structure the gRPC path would have.
 from __future__ import annotations
 
 import threading
-from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Set
 
 from ray_tpu import exceptions
@@ -107,11 +106,11 @@ class NodeObjectManager:
         # Transfers run on their own IO pool — a multi-GiB pull on the
         # raylet's event loop would stall its heartbeats and scheduling
         # ticks (the reference's pull manager runs on dedicated io
-        # contexts for the same reason).
-        self._pull_pool = ThreadPoolExecutor(
-            max_workers=4,
-            thread_name_prefix=f"ray_tpu::pull::"
-                               f"{raylet.node_id.hex()[:6]}")
+        # contexts for the same reason).  Daemon workers + stop():
+        # in-flight pulls must not block process exit.
+        from ray_tpu._private.daemon_pool import DaemonPool
+        self._pull_pool = DaemonPool(
+            4, name=f"ray_tpu::pull::{raylet.node_id.hex()[:6]}")
         self.stats = {"pulled_objects": 0, "pulled_bytes": 0,
                       "chunks_transferred": 0}
 
@@ -182,6 +181,9 @@ class NodeObjectManager:
         if core is not None:
             core.memory_store.get_async(
                 object_id, lambda entry: finish(True))
+
+    def stop(self):
+        self._pull_pool.stop()
 
     def _fetch_from(self, object_id: ObjectID, node_id: NodeID) -> bool:
         """Chunked copy of the serialized object from a remote node store

@@ -189,12 +189,14 @@ class Raylet:
         parity) — stops heartbeating and drops all state."""
         self._dead = True
         self.worker_pool.shutdown()
+        self.object_manager.stop()
         self.loop.stop()
 
     def shutdown(self):
         self._dead = True
         self.cluster.gcs.unregister_raylet(self.node_id)
         self.worker_pool.shutdown()
+        self.object_manager.stop()
         self.loop.stop()
 
     def debug_string(self) -> str:

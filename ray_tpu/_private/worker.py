@@ -32,6 +32,7 @@ class Worker:
         self.job_id: Optional[JobID] = None
         self.namespace: str = ""
         self.mode: Optional[str] = None
+        self.client_connection = None    # set in remote-driver mode
 
 
 _global_worker: Optional[Worker] = None
@@ -71,6 +72,17 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
             raise RuntimeError("ray_tpu.init() called twice; pass "
                               "ignore_reinit_error=True to ignore.")
         initialize_config(_system_config)
+        if address and str(address).startswith("ray-tpu://"):
+            # Remote-driver path (Ray Client parity): connect to a
+            # running head's wire service and drive it from here.
+            from ray_tpu._private import client_runtime
+            from ray_tpu.rpc import RpcClient
+            host, _, port = address[len("ray-tpu://"):].rpartition(":")
+            w.client_connection = RpcClient((host, int(port)))
+            client_runtime.install(w.client_connection)
+            w.namespace = namespace or w.namespace
+            atexit.register(_atexit_shutdown)
+            return RuntimeContextInfo(w)
         from ray_tpu._private.cluster import Cluster
         if _cluster is not None:
             cluster = _cluster
@@ -106,6 +118,17 @@ def shutdown():
     if w is None or not w.connected:
         return
     with _init_lock:
+        if w.mode == "client":
+            try:
+                w.client_connection.close()
+            except Exception:
+                pass
+            w.connected = False
+            w.cluster = None
+            w.core_worker = None
+            w.client_connection = None
+            worker_context.clear_context()
+            return
         if w.job_id is not None:
             try:
                 w.cluster.gcs.job_manager.mark_job_finished(w.job_id)
@@ -177,9 +200,11 @@ class RuntimeContextInfo:
     """Return value of init(): address info (client context parity)."""
 
     def __init__(self, worker: Worker):
+        head = getattr(worker.cluster, "head_node", None) \
+            if worker.cluster else None
+        node_id = getattr(head, "node_id", None)
         self.address_info = {
-            "node_id": worker.cluster.head_node.node_id.hex()
-            if worker.cluster.head_node else None,
+            "node_id": node_id.hex() if node_id is not None else None,
             "namespace": worker.namespace,
         }
 

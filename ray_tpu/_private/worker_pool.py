@@ -192,21 +192,30 @@ class WorkerHostService:
         self.server.register("get_object", self._get_object)
         self.server.register("kv_get", self._kv_get)
         # Client-runtime surface: process-mode workers drive the full
-        # public API (nested .remote, put/get/wait, actors) through
-        # these, with ownership kept by the host's core worker
-        # (reference: the worker's CoreWorker talking to its raylet +
-        # GCS, collapsed onto the host service).
-        self.server.register("runtime_info", self._runtime_info)
-        self.server.register("kv_put", self._kv_put)
-        self.server.register("submit_task", self._submit_task)
-        self.server.register("submit_actor_task", self._submit_actor_task)
-        self.server.register("create_actor", self._create_actor)
-        self.server.register("actor_info", self._actor_info)
-        self.server.register("named_actor_info", self._named_actor_info)
-        self.server.register("kill_actor", self._kill_actor)
-        self.server.register("put_object", self._put_object)
-        self.server.register("get_value", self._get_value)
-        self.server.register("wait_refs", self._wait_refs)
+        # public API (nested .remote, put/get/wait, actors) through the
+        # SAME handlers remote drivers use (client_service.py), with
+        # ownership kept by the host's core worker.  Big get_value
+        # replies ride chunk sessions.
+        from ray_tpu._private.client_service import register_client_surface
+        from ray_tpu._private.worker import global_worker_or_none
+        from ray_tpu.rpc.chunked import serve_chunks
+        self._chunk_server = serve_chunks(
+            self.server,
+            lambda oid_bin: self._get_object(oid_bin))
+
+        def _namespace():
+            w = global_worker_or_none()
+            return getattr(w, "namespace", "") if w else ""
+
+        register_client_surface(
+            self.server,
+            core=self._core,
+            kv=node.cluster.gcs.kv,
+            actor_manager=lambda: self._node.cluster.gcs.actor_manager,
+            node_id_fn=lambda: self._node.node_id,
+            namespace_fn=_namespace,
+            chunk_server=self._chunk_server,
+            pin_cb=self._record_pin)
 
     @property
     def port(self) -> int:
@@ -246,92 +255,16 @@ class WorkerHostService:
     def _kv_get(self, key: bytes) -> Optional[bytes]:
         return self._node.cluster.gcs.kv.get(key)
 
-    def _kv_put(self, payload) -> bool:
-        return self._node.cluster.gcs.kv.put(
-            payload["key"], payload["value"],
-            overwrite=payload.get("overwrite", True))
-
     def _core(self):
         core = self._node.core_worker
         if core is None:
             raise RuntimeError("host node has no core worker attached")
         return core
 
-    def _runtime_info(self, _payload) -> dict:
-        core = self._core()
-        from ray_tpu._private.ids import JobID, WorkerID
-        from ray_tpu._private.worker import global_worker_or_none
-        w = global_worker_or_none()
-        # On a NodeHost spoke the "core" is the remote shim — it carries
-        # the head's identifiers (wired at registration); tolerate their
-        # absence rather than killing the spawning worker.
-        job_id = getattr(core, "job_id", None) or JobID.nil()
-        owner = getattr(core, "worker_id", None) or WorkerID.from_random()
-        return {
-            "job_id": job_id,
-            "owner_id": owner,
-            "namespace": getattr(w, "namespace", "") if w else "",
-            "node_id": self._node.node_id,
-        }
-
-    def _submit_task(self, payload) -> bool:
-        self._core().submit_task(payload["spec"])
-        return True
-
-    def _submit_actor_task(self, payload) -> bool:
-        self._core().submit_actor_task(payload["spec"])
-        return True
-
-    def _create_actor(self, payload) -> bool:
-        self._core().create_actor(
-            payload["spec"], name=payload.get("name", ""),
-            namespace=payload.get("namespace", ""),
-            detached=payload.get("detached", False))
-        return True
-
-    def _actor_record(self, actor):
-        import pickle
-        if actor is None:
-            return None
-        return {"actor_id": actor.actor_id,
-                "class_name": actor.info().get("class_name", ""),
-                "state": actor.state,
-                "num_restarts": actor.num_restarts,
-                "spec_blob": pickle.dumps(actor.creation_spec,
-                                          protocol=5)}
-
-    def _actor_info(self, payload):
-        return self._actor_record(
-            self._node.cluster.gcs.actor_manager.get_actor(
-                payload["actor_id"]))
-
-    def _named_actor_info(self, payload):
-        return self._actor_record(
-            self._node.cluster.gcs.actor_manager.get_named_actor(
-                payload["name"], payload.get("namespace", "")))
-
-    def _kill_actor(self, payload) -> bool:
-        self._node.cluster.gcs.actor_manager.destroy_actor(
-            payload["actor_id"],
-            no_restart=payload.get("no_restart", True))
-        return True
-
-    def _put_object(self, payload):
-        from ray_tpu._private.serialization import (
-            SerializedObject, deserialize)
-        value = deserialize(SerializedObject.from_bytes(payload["blob"]))
-        ref = self._core().put(value)
-        # The host-side handle is dropped after this reply; pin through
-        # the owner table, scoped to the calling WORKER's lifetime so the
-        # store doesn't grow for the whole job (released in
-        # release_worker_pins when the worker exits).
-        self._core().reference_counter.add_local_ref(ref.object_id())
-        wid = payload.get("worker_id")
-        if wid:
-            with self._lock:
-                self._worker_pins.setdefault(wid, []).append(
-                    ref.object_id())
-        return {"object_id": ref.object_id(), "owner_id": ref.owner_id()}
+    def _record_pin(self, worker_id_hex: str, object_id):
+        with self._lock:
+            self._worker_pins.setdefault(worker_id_hex, []).append(
+                object_id)
 
     def release_worker_pins(self, worker_id_hex: str):
         """Drop the put-object pins a (now dead) worker accumulated."""
@@ -345,37 +278,6 @@ class WorkerHostService:
                 core.reference_counter.remove_local_ref(oid)
             except Exception:
                 pass
-
-    def _get_value(self, payload):
-        import pickle
-
-        from ray_tpu import exceptions
-        from ray_tpu._private.object_ref import ObjectRef
-        from ray_tpu._private.serialization import serialize
-        ref = ObjectRef(payload["object_id"],
-                        skip_adding_local_ref=True)
-        try:
-            value = self._core().get([ref],
-                                     timeout=payload.get("timeout"))[0]
-        except exceptions.GetTimeoutError:
-            return None
-        except Exception as e:   # noqa: BLE001 — ship the user error
-            try:
-                return ("error", pickle.dumps(e))
-            except Exception:
-                return ("error", pickle.dumps(
-                    exceptions.RayTpuError(str(e))))
-        return ("ok", serialize(value).to_bytes())
-
-    def _wait_refs(self, payload):
-        from ray_tpu._private.object_ref import ObjectRef
-        refs = [ObjectRef(oid, skip_adding_local_ref=True)
-                for oid in payload["object_ids"]]
-        ready, rest = self._core().wait(
-            refs, num_returns=payload.get("num_returns", 1),
-            timeout=payload.get("timeout"))
-        return {"ready": [r.object_id() for r in ready],
-                "not_ready": [r.object_id() for r in rest]}
 
     def stop(self):
         self.server.stop()

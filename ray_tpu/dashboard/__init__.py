@@ -1,0 +1,3 @@
+from ray_tpu.dashboard.head import Dashboard
+
+__all__ = ["Dashboard"]

@@ -1,0 +1,158 @@
+"""Dashboard-lite: the head's REST + metrics endpoint.
+
+Parity: reference ``dashboard/head.py`` + modules (node/actor/job views
+aggregated from the GCS, ``/metrics`` Prometheus scrape via the metrics
+agent, ``datacenter.py`` cluster rollups).  The React client is out of
+scope; this serves the same data as JSON for tools and humans:
+
+    GET /api/cluster            totals, availability, node count, jobs
+    GET /api/nodes              node table (state, resources)
+    GET /api/actors             actor table (state, restarts, class)
+    GET /api/placement_groups   PG table (state, bundles)
+    GET /api/jobs               job submissions (when a JobManager runs)
+    GET /metrics                Prometheus text exposition
+    GET /                       tiny HTML overview
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ray_tpu._private.metrics_agent import get_metrics_registry
+
+
+class Dashboard:
+    def __init__(self, cluster, job_manager=None, host: str = "127.0.0.1",
+                 port: int = 0):
+        self._cluster = cluster
+        self._job_manager = job_manager
+        dashboard = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *_args):       # no stderr spam
+                pass
+
+            def do_GET(self):
+                try:
+                    dashboard._route(self)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:           # noqa: BLE001
+                    self.send_error(500, str(e))
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.address = self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="ray_tpu::dashboard")
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.address[0]}:{self.address[1]}"
+
+    # ---- routing --------------------------------------------------------
+    def _route(self, req: BaseHTTPRequestHandler):
+        path = req.path.split("?", 1)[0].rstrip("/") or "/"
+        if path == "/metrics":
+            self._send(req, get_metrics_registry().render_prometheus(),
+                       content_type="text/plain; version=0.0.4")
+        elif path == "/api/cluster":
+            self._send_json(req, self._cluster_view())
+        elif path == "/api/nodes":
+            self._send_json(req, self._nodes())
+        elif path == "/api/actors":
+            self._send_json(req, self._actors())
+        elif path == "/api/placement_groups":
+            self._send_json(req, self._cluster.gcs
+                            .placement_group_manager.table())
+        elif path == "/api/jobs":
+            self._send_json(req, self._jobs())
+        elif path == "/":
+            self._send(req, self._index_html(), content_type="text/html")
+        else:
+            req.send_error(404, "unknown route")
+
+    # ---- views ----------------------------------------------------------
+    def _cluster_view(self) -> dict:
+        view = self._cluster.gcs.resource_manager.view
+        nodes = self._nodes()
+        return {
+            "total_resources": view.total_cluster_resources(),
+            "available_resources": view.available_cluster_resources(),
+            "alive_nodes": sum(1 for n in nodes
+                               if n.get("state") == "ALIVE"),
+            "dead_nodes": sum(1 for n in nodes
+                              if n.get("state") == "DEAD"),
+            "jobs": self._jobs(),
+        }
+
+    def _nodes(self) -> list:
+        out = []
+        for node_id, info in \
+                self._cluster.gcs.node_manager.get_all_node_info().items():
+            row = {"node_id": node_id.hex(),
+                   "name": info.get("node_name", ""),
+                   "state": info.get("state"),
+                   "resources": info.get("resources", {})}
+            out.append(row)
+        return out
+
+    def _actors(self) -> list:
+        return [info for _aid, info in
+                self._cluster.gcs.actor_manager.all_actor_info().items()]
+
+    def _jobs(self) -> list:
+        if self._job_manager is None:
+            return []
+        from dataclasses import asdict
+        return [asdict(j) for j in self._job_manager.list_jobs()]
+
+    def _index_html(self) -> str:
+        view = self._cluster_view()
+        rows = "".join(
+            f"<tr><td>{n['name'] or n['node_id'][:12]}</td>"
+            f"<td>{n['state']}</td>"
+            f"<td>{json.dumps(n['resources'])}</td></tr>"
+            for n in self._nodes())
+        return (
+            "<html><head><title>ray_tpu dashboard</title></head><body>"
+            f"<h2>ray_tpu cluster — {view['alive_nodes']} node(s) alive"
+            "</h2>"
+            f"<p>total: {json.dumps(view['total_resources'])}</p>"
+            f"<p>available: "
+            f"{json.dumps(view['available_resources'])}</p>"
+            "<table border=1><tr><th>node</th><th>state</th>"
+            "<th>resources</th></tr>" + rows + "</table>"
+            "<p>endpoints: /api/cluster /api/nodes /api/actors "
+            "/api/placement_groups /api/jobs /metrics</p>"
+            "</body></html>")
+
+    # ---- plumbing -------------------------------------------------------
+    @staticmethod
+    def _send(req, body: str, content_type: str = "application/json"):
+        data = body.encode()
+        req.send_response(200)
+        req.send_header("Content-Type", content_type)
+        req.send_header("Content-Length", str(len(data)))
+        req.end_headers()
+        req.wfile.write(data)
+
+    def _send_json(self, req, obj):
+        self._send(req, json.dumps(obj, default=str))
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def start_dashboard(cluster, job_manager=None,
+                    port: int = 0) -> Optional[Dashboard]:
+    try:
+        return Dashboard(cluster, job_manager=job_manager, port=port)
+    except OSError:
+        return None

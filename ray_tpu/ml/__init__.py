@@ -1,0 +1,22 @@
+"""ray_tpu.ml — the AIR v0 unification layer.
+
+Parity: reference ``python/ray/ml/`` (6.7k LoC preview): ``Preprocessor``
+fit/transform over Datasets, ``Checkpoint`` as the interchange object,
+``DataParallelTrainer.fit() -> Result``, ``Predictor``/``BatchPredictor``
+for inference over Datasets, and a ``Tuner`` facade bridging trainers
+into Tune.  Built purely on ray_tpu.train / ray_tpu.tune /
+ray_tpu.data, like the reference builds only on its libraries.
+"""
+
+from ray_tpu.ml.checkpoint import Checkpoint
+from ray_tpu.ml.predictor import BatchPredictor, Predictor
+from ray_tpu.ml.preprocessor import (
+    BatchMapper, Chain, MinMaxScaler, Preprocessor, StandardScaler)
+from ray_tpu.ml.trainer import DataParallelTrainer, Result
+from ray_tpu.ml.tuner import Tuner
+
+__all__ = [
+    "Checkpoint", "Predictor", "BatchPredictor", "Preprocessor",
+    "StandardScaler", "MinMaxScaler", "BatchMapper", "Chain",
+    "DataParallelTrainer", "Result", "Tuner",
+]

@@ -1,0 +1,17 @@
+"""ray_tpu.rllib — reinforcement learning on the task/actor core.
+
+Parity: a focused slice of the reference's ``rllib/`` (118k LoC):
+``RolloutWorker``/``WorkerSet`` (evaluation/), the PPO trainer
+(agents/ppo/) with GAE and clipped-surrogate loss, and Trainer
+save/restore — jax-first (jit-compiled learner, numpy-pytree weight
+shipping, actor-fleet sampling).  Algorithms beyond PPO follow the same
+WorkerSet + jit-learner shape.
+"""
+
+from ray_tpu.rllib.env import CartPole
+from ray_tpu.rllib.policy import ActorCritic, compute_gae
+from ray_tpu.rllib.ppo import DEFAULT_CONFIG, PPOTrainer
+from ray_tpu.rllib.rollout_worker import RolloutWorker, WorkerSet
+
+__all__ = ["CartPole", "ActorCritic", "compute_gae", "PPOTrainer",
+           "DEFAULT_CONFIG", "RolloutWorker", "WorkerSet"]

@@ -1,0 +1,94 @@
+"""RolloutWorker + WorkerSet: the sampling fleet.
+
+Parity: reference ``rllib/evaluation/rollout_worker.py`` (an actor
+holding env + policy, producing sample batches) and
+``rllib/evaluation/worker_set.py`` (the fleet with weight broadcast and
+parallel sampling).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.policy import ActorCritic, compute_gae
+
+
+@ray_tpu.remote
+class RolloutWorker:
+    """One sampler: steps its env with the current policy and returns
+    GAE-processed batches."""
+
+    def __init__(self, env_fn: Callable, policy_config: Dict,
+                 gamma: float = 0.99, lam: float = 0.95, seed: int = 0):
+        self.env = env_fn()
+        self.policy = ActorCritic(seed=seed, **policy_config)
+        self.gamma = gamma
+        self.lam = lam
+        self._obs = self.env.reset()
+        self._episode_reward = 0.0
+        self._episode_rewards: List[float] = []
+
+    def set_weights(self, weights: Dict):
+        self.policy.set_weights(weights)
+        return True
+
+    def sample(self, num_steps: int) -> Dict[str, np.ndarray]:
+        obs_buf = np.zeros((num_steps, len(self._obs)), dtype=np.float32)
+        act_buf = np.zeros(num_steps, dtype=np.int32)
+        rew_buf = np.zeros(num_steps, dtype=np.float32)
+        done_buf = np.zeros(num_steps, dtype=np.float32)
+        logp_buf = np.zeros(num_steps, dtype=np.float32)
+        val_buf = np.zeros(num_steps, dtype=np.float32)
+        for t in range(num_steps):
+            action, logp, value = self.policy.compute_actions(
+                self._obs[None, :])
+            obs_buf[t] = self._obs
+            act_buf[t] = action[0]
+            logp_buf[t] = logp[0]
+            val_buf[t] = value[0]
+            self._obs, reward, done, _info = self.env.step(int(action[0]))
+            rew_buf[t] = reward
+            done_buf[t] = float(done)
+            self._episode_reward += reward
+            if done:
+                self._episode_rewards.append(self._episode_reward)
+                self._episode_reward = 0.0
+                self._obs = self.env.reset()
+        _, _, last_value = self.policy.compute_actions(self._obs[None, :])
+        advantages, returns = compute_gae(
+            rew_buf, val_buf, done_buf, float(last_value[0]),
+            self.gamma, self.lam)
+        episode_rewards, self._episode_rewards = self._episode_rewards, []
+        return {
+            "obs": obs_buf, "actions": act_buf, "logp_old": logp_buf,
+            "advantages": advantages, "returns": returns,
+            "episode_rewards": np.asarray(episode_rewards,
+                                          dtype=np.float32),
+        }
+
+
+class WorkerSet:
+    """The rollout fleet (worker_set.py parity): parallel sampling and
+    weight broadcast over plain actor calls."""
+
+    def __init__(self, env_fn: Callable, policy_config: Dict,
+                 num_workers: int, gamma: float, lam: float):
+        self.workers = [
+            RolloutWorker.remote(env_fn, policy_config, gamma=gamma,
+                                 lam=lam, seed=1000 + i)
+            for i in range(num_workers)]
+
+    def broadcast_weights(self, weights: Dict):
+        ray_tpu.get([w.set_weights.remote(weights) for w in self.workers])
+
+    def sample(self, steps_per_worker: int) -> List[Dict[str, np.ndarray]]:
+        return ray_tpu.get(
+            [w.sample.remote(steps_per_worker) for w in self.workers])
+
+    def stop(self):
+        for w in self.workers:
+            ray_tpu.kill(w)
+        self.workers = []

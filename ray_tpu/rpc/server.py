@@ -48,6 +48,19 @@ class RpcServer:
         self._stopped = threading.Event()
         self._conns: set = set()
         self._conns_lock = threading.Lock()
+        # Bounded dispatch pool (reference grpc_server.h: a fixed io
+        # thread pool, not a thread per call).  Overflow takes a
+        # dedicated thread instead of queueing: many handlers block on
+        # other requests to this same server (lease dep-waits, gets),
+        # so queueing behind them could deadlock.  Daemon threads: a
+        # handler stuck in a long wait must not hang interpreter exit.
+        from ray_tpu._private.config import get_config
+        from ray_tpu._private.daemon_pool import DaemonPool
+        self._pool_size = get_config().rpc_dispatch_pool_size
+        self._pool = DaemonPool(self._pool_size,
+                                name=f"ray_tpu::rpc::{name}::pool")
+        self._active = 0
+        self._active_lock = threading.Lock()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True,
             name=f"ray_tpu::rpc::{name}::accept")
@@ -72,6 +85,7 @@ class RpcServer:
     # ---- lifecycle -----------------------------------------------------
     def stop(self):
         self._stopped.set()
+        self._pool.stop()
         # shutdown() before close(): a close alone does not tear the
         # connection down while another thread is blocked in recv on the
         # same fd (the in-flight syscall pins the file description, so
@@ -104,11 +118,8 @@ class RpcServer:
                     msg_id, method, payload = wire.recv_msg(conn)
                 except (wire.ConnectionClosed, OSError, EOFError):
                     return
-                threading.Thread(
-                    target=self._dispatch,
-                    args=(conn, write_lock, msg_id, method, payload),
-                    daemon=True,
-                    name=f"ray_tpu::rpc::{self._name}::call").start()
+                self._submit_dispatch(conn, write_lock, msg_id, method,
+                                      payload)
         finally:
             with self._conns_lock:
                 self._conns.discard(conn)
@@ -116,6 +127,33 @@ class RpcServer:
                 conn.close()
             except OSError:
                 pass
+
+    def _submit_dispatch(self, conn, write_lock, msg_id, method,
+                         payload):
+        with self._active_lock:
+            pooled = self._active < self._pool_size
+            if pooled:
+                self._active += 1
+        if pooled:
+            def run():
+                try:
+                    self._dispatch(conn, write_lock, msg_id, method,
+                                   payload)
+                finally:
+                    with self._active_lock:
+                        self._active -= 1
+
+            try:
+                self._pool.submit(run)
+                return
+            except RuntimeError:      # pool stopped mid-stop
+                with self._active_lock:
+                    self._active -= 1
+        threading.Thread(
+            target=self._dispatch,
+            args=(conn, write_lock, msg_id, method, payload),
+            daemon=True,
+            name=f"ray_tpu::rpc::{self._name}::call").start()
 
     def _dispatch(self, conn, write_lock, msg_id, method, payload):
         entry = self._handlers.get(method)

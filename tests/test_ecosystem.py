@@ -1,0 +1,116 @@
+"""Ecosystem adapters + dashboard-lite.
+
+Reference test models: ``python/ray/tests/test_multiprocessing.py``,
+``test_joblib.py``, and the dashboard REST routes."""
+
+import json
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.worker import global_worker
+
+
+def _sq(x):
+    return x * x
+
+
+def _addmul(a, b, c=1):
+    return (a + b) * c
+
+
+class TestMultiprocessingPool:
+    def test_map(self, ray_start_regular):
+        from ray_tpu.util.multiprocessing import Pool
+        with Pool(3) as pool:
+            assert pool.map(_sq, range(20)) == [i * i for i in range(20)]
+
+    def test_apply_and_async(self, ray_start_regular):
+        from ray_tpu.util.multiprocessing import Pool
+        with Pool(2) as pool:
+            assert pool.apply(_addmul, (2, 3), {"c": 10}) == 50
+            res = pool.apply_async(_addmul, (1, 1))
+            res.wait(timeout=30)
+            assert res.ready() and res.get(timeout=30) == 2
+
+    def test_starmap_and_imap(self, ray_start_regular):
+        from ray_tpu.util.multiprocessing import Pool
+        with Pool(2) as pool:
+            assert pool.starmap(_addmul, [(1, 2), (3, 4)]) == [3, 7]
+            assert list(pool.imap(_sq, range(7))) == \
+                [i * i for i in range(7)]
+            assert sorted(pool.imap_unordered(_sq, range(7))) == \
+                sorted(i * i for i in range(7))
+
+    def test_initializer_and_close(self, ray_start_regular):
+        from ray_tpu.util.multiprocessing import Pool
+
+        def init(v):
+            import builtins
+            builtins._POOL_SEED = v
+
+        def read(_):
+            import builtins
+            return builtins._POOL_SEED
+
+        pool = Pool(2, initializer=init, initargs=(42,))
+        assert pool.map(read, range(4)) == [42] * 4
+        pool.close()
+        with pytest.raises(ValueError):
+            pool.map(_sq, [1])
+        pool.join()
+
+
+class TestJoblibBackend:
+    def test_parallel_roundtrip(self, ray_start_regular):
+        joblib = pytest.importorskip("joblib")
+        from ray_tpu.util.joblib import register_ray
+        register_ray()
+        with joblib.parallel_backend("ray_tpu", n_jobs=4):
+            out = joblib.Parallel()(
+                joblib.delayed(_sq)(i) for i in range(12))
+        assert out == [i * i for i in range(12)]
+
+
+class TestDashboard:
+    @pytest.fixture
+    def dash(self, ray_start_regular):
+        from ray_tpu.dashboard import Dashboard
+        d = Dashboard(global_worker().cluster)
+        yield d
+        d.stop()
+
+    def _get(self, dash, path):
+        with urllib.request.urlopen(dash.url + path, timeout=10) as r:
+            return r.read().decode()
+
+    def test_cluster_and_nodes(self, dash):
+        cluster = json.loads(self._get(dash, "/api/cluster"))
+        assert cluster["alive_nodes"] >= 1
+        assert cluster["total_resources"].get("CPU", 0) > 0
+        nodes = json.loads(self._get(dash, "/api/nodes"))
+        assert any(n["state"] == "ALIVE" for n in nodes)
+
+    def test_actors_route(self, dash):
+        @ray_tpu.remote
+        class Visible:
+            def ping(self):
+                return 1
+
+        v = Visible.remote()
+        ray_tpu.get(v.ping.remote(), timeout=30)
+        actors = json.loads(self._get(dash, "/api/actors"))
+        assert any(a["state"] == "ALIVE" for a in actors)
+
+    def test_metrics_prometheus_text(self, dash):
+        from ray_tpu.util.metrics import Counter
+        c = Counter("dash_test_counter", description="d")
+        c.inc(3)
+        text = self._get(dash, "/metrics")
+        assert "dash_test_counter" in text
+        assert "# TYPE" in text
+
+    def test_index_html(self, dash):
+        html = self._get(dash, "/")
+        assert "ray_tpu cluster" in html

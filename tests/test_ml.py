@@ -1,0 +1,118 @@
+"""ray_tpu.ml (AIR) tests: preprocess -> train -> checkpoint -> predict.
+
+Reference test models: ``python/ray/ml/tests/`` (preprocessors,
+data-parallel trainer, batch predictor)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rt_data
+from ray_tpu.ml import (
+    BatchMapper, BatchPredictor, Chain, Checkpoint, DataParallelTrainer,
+    MinMaxScaler, Predictor, StandardScaler, Tuner)
+
+
+def _table(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return rt_data.from_items(
+        [{"x": float(v), "y": float(2 * v + 1)}
+         for v in rng.normal(3.0, 2.0, size=n)])
+
+
+class TestPreprocessors:
+    def test_standard_scaler(self, ray_start_regular):
+        ds = _table()
+        scaled = StandardScaler(["x"]).fit_transform(ds)
+        xs = np.array([row["x"] for row in scaled.take(64)])
+        assert abs(xs.mean()) < 1e-6
+        # Dataset.std is the sample std (ddof=1), matching the fit.
+        assert abs(xs.std(ddof=1) - 1.0) < 1e-6
+
+    def test_minmax_and_chain(self, ray_start_regular):
+        ds = _table()
+        chain = Chain(MinMaxScaler(["x"]),
+                      BatchMapper(lambda b: {**b,
+                                             "x2": np.asarray(b["x"]) * 2}))
+        out = chain.fit(ds).transform(ds)
+        rows = out.take(64)
+        xs = np.array([r["x"] for r in rows])
+        assert xs.min() >= 0 and xs.max() <= 1.0
+        assert all(abs(r["x2"] - 2 * r["x"]) < 1e-12 for r in rows)
+
+    def test_unfit_raises(self, ray_start_regular):
+        with pytest.raises(RuntimeError, match="must be fit"):
+            StandardScaler(["x"]).transform(_table())
+
+
+class TestCheckpoint:
+    def test_conversions(self, tmp_path):
+        ckpt = Checkpoint.from_dict({"w": 3, "b": [1, 2]})
+        assert Checkpoint.from_bytes(ckpt.to_bytes())["w"] == 3
+        d = ckpt.to_directory(str(tmp_path / "c"))
+        assert Checkpoint.from_directory(d).get("b") == [1, 2]
+
+
+def _linear_loop(config):
+    """Least-squares fit of y = w*x + b on the shipped batches."""
+    from ray_tpu.ml.trainer import get_dataset_batches
+    from ray_tpu.train import session
+    batches = get_dataset_batches(config, "train")
+    xs = np.concatenate([np.asarray(b["x"]) for b in batches])
+    ys = np.concatenate([np.asarray(b["y"]) for b in batches])
+    design = np.stack([xs, np.ones_like(xs)], axis=1)
+    (w, b), *_ = np.linalg.lstsq(design, ys, rcond=None)
+    loss = float(np.mean((design @ np.array([w, b]) - ys) ** 2))
+    session.report(loss=loss)
+    session.save_checkpoint(w=float(w), b=float(b))
+    return loss
+
+
+class TestTrainerAndPredictor:
+    def test_fit_returns_result_with_checkpoint(self, ray_start_regular):
+        trainer = DataParallelTrainer(
+            _linear_loop, datasets={"train": _table()},
+            scaling_config={"num_workers": 1})
+        result = trainer.fit()
+        assert result.metrics["loss"] < 1e-10
+        assert result.checkpoint is not None
+        assert result.checkpoint["w"] == pytest.approx(2.0)
+        assert result.checkpoint["b"] == pytest.approx(1.0)
+
+    def test_batch_predictor_end_to_end(self, ray_start_regular):
+        trainer = DataParallelTrainer(
+            _linear_loop, datasets={"train": _table()},
+            scaling_config={"num_workers": 1})
+        ckpt = trainer.fit().checkpoint
+
+        def model_from_checkpoint(c):
+            w, b = c["w"], c["b"]
+            return lambda batch: {
+                "pred": np.asarray(batch["x"]) * w + b}
+
+        bp = BatchPredictor.from_checkpoint(ckpt, model_from_checkpoint)
+        preds = bp.predict(_table(16, seed=9))
+        for row in preds.take(16):
+            # pred column present and finite
+            assert np.isfinite(row["pred"])
+
+    def test_predictor_applies_preprocessor(self, ray_start_regular):
+        pre = BatchMapper(lambda b: {**b, "x": np.asarray(b["x"]) + 100})
+        ckpt = Checkpoint.from_dict({"_preprocessor": pre})
+        p = Predictor.from_checkpoint(
+            ckpt, lambda _c: (lambda batch: batch["x"]))
+        out = p.predict({"x": np.array([1.0, 2.0])})
+        np.testing.assert_allclose(out, [101.0, 102.0])
+
+
+class TestTuner:
+    def test_sweep_picks_best(self, ray_start_regular):
+        trainer = DataParallelTrainer(
+            _linear_loop, datasets={"train": _table()},
+            scaling_config={"num_workers": 1})
+        from ray_tpu import tune
+        analysis = Tuner(trainer,
+                         param_space={"noise": tune.grid_search([0, 1])},
+                         metric="loss", mode="min").fit()
+        best = analysis.best_config
+        assert best is not None

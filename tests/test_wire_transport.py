@@ -246,3 +246,21 @@ class TestNestedRemoteInProcessWorkers:
 
         with pytest.raises(KeyError, match="inner-kaboom"):
             ray_tpu.get(outer.remote(), timeout=60)
+
+    def test_nested_big_get_rides_chunk_sessions(self, process_mode_cluster):
+        """A nested get of a > chunk-size object inside a process worker
+        must stream back as chunk frames (review regression: single-frame
+        replies silently hung the child)."""
+        @ray_tpu.remote
+        def make(n):
+            return np.ones(n, dtype=np.float64)
+
+        @ray_tpu.remote
+        def consume():
+            n = (8 * 1024 * 1024) // 8          # 8 MiB > 5 MiB chunk
+            arr = ray_tpu.get(make.remote(n))
+            return float(arr.sum()), len(arr)
+
+        total, n = ray_tpu.get(consume.remote(), timeout=180)
+        assert n == (8 * 1024 * 1024) // 8
+        assert total == float(n)
