@@ -747,18 +747,30 @@ class DeviceRuntimeSolver:
     """
 
     _NNZ_BUCKETS = (256, 2048, 16384, 131072)
+    # A class row idle this many ticks is an eviction candidate when the
+    # demand matrix would otherwise have to grow (growing c_cap
+    # recompiles _jit_solve_tick, so eviction is strictly cheaper).
+    _CLASS_IDLE_TICKS = 256
+    # Hard bound on interned class rows.  Past this the tick falls back
+    # to the native greedy path instead of growing without limit — a
+    # single tick with >4096 *distinct live* resource shapes is outside
+    # the kernel's design envelope anyway.
+    _MAX_CLASS_ROWS = 4096
 
     def __init__(self):
         self._state: Optional[dict] = None
-        # scheduling_class -> demand row; rows are append-only.
+        # scheduling_class -> demand row.  Rows grow as classes are
+        # interned and are compacted by _evict_stale_classes when growth
+        # would force a recompile (see _CLASS_IDLE_TICKS).
         self._class_rows: Dict[int, int] = {}
         self._class_reqs: List = []
+        self._class_last_used: Dict[int, int] = {}
         self._demand_host: Optional[np.ndarray] = None   # [c_cap, r_pad]
         self._accel_host: Optional[np.ndarray] = None    # [c_cap]
         self._demand_dev = None
         self._accel_dev = None
         self.stats = {"ticks": 0, "full_syncs": 0, "row_deltas": 0,
-                      "fallbacks": 0}
+                      "fallbacks": 0, "class_evictions": 0}
         # Probe once: without jax the device path is permanently off —
         # a failed import is NOT cached in sys.modules, so retrying it
         # every scheduling tick would rescan sys.path on the hot path.
@@ -819,6 +831,25 @@ class DeviceRuntimeSolver:
         # Register any new scheduling classes (rare: classes are interned
         # resource shapes).  A class demanding an unknown resource column
         # forces the column into the view (version bump -> full resync).
+        tick = self.stats["ticks"]
+        for cls in groups:
+            self._class_last_used[cls] = tick
+        new_classes = [c for c in groups if c not in self._class_rows]
+        if new_classes and (len(self._class_reqs) + len(new_classes)
+                            > self._demand_host.shape[0]):
+            # Growth would widen c_cap (a recompile): first try to
+            # reclaim rows from classes that have gone idle.
+            self._evict_stale_classes(set(groups), st)
+            if (len(self._class_reqs) + len(new_classes)
+                    > self._MAX_CLASS_ROWS):
+                # Over the hard cap even after stale eviction: churn
+                # interned >4096 classes inside the idle window.  Evict
+                # LRU rows regardless of idleness — only the classes
+                # live THIS tick are protected — before giving up.
+                self._evict_stale_classes(set(groups), st, force_lru=True)
+            if (len(self._class_reqs) + len(new_classes)
+                    > self._MAX_CLASS_ROWS):
+                return False
         for cls, members in groups.items():
             if cls not in self._class_rows:
                 req = specs[members[0]].resources
@@ -908,6 +939,35 @@ class DeviceRuntimeSolver:
         self._demand_host, self._accel_host = demand, accel
         self._demand_dev = jax.device_put(demand)
         self._accel_dev = jax.device_put(accel)
+
+    def _evict_stale_classes(self, keep: set, st: dict,
+                             force_lru: bool = False) -> bool:
+        """Compact the demand matrix by dropping rows for classes unused
+        for _CLASS_IDLE_TICKS ticks (never ones in ``keep`` — the
+        classes scheduling right now).  With ``force_lru`` the idle
+        threshold is ignored and everything outside ``keep`` goes (the
+        over-hard-cap path).  Returns True if anything moved.  Eviction
+        only costs a cheap re-registration if the class ever reappears;
+        it never affects correctness."""
+        tick = self.stats["ticks"]
+        row_to_cls = {row: c for c, row in self._class_rows.items()}
+        survivors = []
+        for row in range(len(self._class_reqs)):
+            cls = row_to_cls[row]
+            idle = tick - self._class_last_used.get(cls, tick)
+            if cls in keep or (not force_lru
+                               and idle < self._CLASS_IDLE_TICKS):
+                survivors.append((cls, self._class_reqs[row]))
+        if len(survivors) == len(self._class_reqs):
+            return False
+        self.stats["class_evictions"] += \
+            len(self._class_reqs) - len(survivors)
+        self._class_rows = {c: i for i, (c, _) in enumerate(survivors)}
+        self._class_reqs = [req for _, req in survivors]
+        self._class_last_used = {
+            c: self._class_last_used.get(c, tick) for c, _ in survivors}
+        self._rebuild_demand(st["columns"], st["r_pad"])
+        return True
 
     def _register_class(self, cls: int, req, st: dict):
         import jax

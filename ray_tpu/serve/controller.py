@@ -7,17 +7,37 @@ creating/stopping replica actors, long-poll change notifications
 (long_poll.py), queue-metric autoscaling (autoscaling_policy.py:
 scale to ceil(total_queued / target_num_ongoing_requests_per_replica)
 clamped to [min,max]).
+
+Updates are *rolling* (reference ``deployment_state.py`` version-aware
+reconciler): a redeploy that changes code/config marks live replicas as
+old-version; the reconciler surges new-version replicas in, waits for
+them to pass ``check_health``, then retires the same number of
+old-version ones — serving capacity never drops below the target.  A
+redeploy that changes only ``user_config`` skips restarts entirely and
+calls ``reconfigure`` on the live replicas in place (reference
+``deployment_state.py`` lightweight-update path).  The reconciler also
+runs periodic health checks and replaces replicas that fail them
+(reference ``replica.py`` health-check loop).
 """
 
 from __future__ import annotations
 
+import math
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
+from ray_tpu import exceptions
 
 CONTROLLER_NAME = "SERVE_CONTROLLER_ACTOR"
+
+# Fraction of the target replica count a rolling update may surge above
+# it while replacing old-version replicas (reference max_surge semantics).
+_ROLLING_SURGE_FRACTION = 0.25
+_HEALTH_CHECK_PERIOD_S = 2.5
+_HEALTH_CHECK_FAILURE_THRESHOLD = 2
+_RECONCILE_PERIOD_S = 0.25
 
 
 class DeploymentInfo:
@@ -36,15 +56,41 @@ class DeploymentInfo:
         self.route_prefix = route_prefix
         self.version = version
 
+    def replica_fingerprint(self) -> tuple:
+        """Everything that forces a replica restart when it changes —
+        the deployment body and actor options, but NOT user_config
+        (which reconfigures in place)."""
+        deployment_def, init_args, init_kwargs, _user_config = \
+            self.serialized_init
+        return (deployment_def, init_args, init_kwargs,
+                tuple(sorted(self.ray_actor_options.items())),
+                self.max_concurrent_queries)
+
+
+class _Replica:
+    """A live replica actor and the deployment version it was built at."""
+
+    __slots__ = ("handle", "version")
+
+    def __init__(self, handle, version: int):
+        self.handle = handle
+        self.version = version
+
 
 class ServeController:
     def __init__(self):
         self._deployments: Dict[str, DeploymentInfo] = {}
-        self._replicas: Dict[str, List] = {}   # name -> actor handles
+        self._replicas: Dict[str, List[_Replica]] = {}
         self._config_version = 0
         self._lock = threading.RLock()
         self._cv = threading.Condition(self._lock)
+        # Serializes whole reconcile passes (deploy handler vs loop):
+        # replica startup blocks on health checks, so two concurrent
+        # passes would both see the same deficit and double-start.
+        self._reconcile_mutex = threading.Lock()
         self._shutdown = False
+        self._last_health_check = 0.0
+        self._health_fail_counts: Dict[_Replica, int] = {}
         self._reconcile_thread = threading.Thread(
             target=self._reconcile_loop, daemon=True, name="serve-reconcile")
         self._reconcile_thread.start()
@@ -64,14 +110,55 @@ class ServeController:
                             f"used by deployment {other!r}")
             prev = self._deployments.get(name)
             version = (prev.version + 1) if prev else 0
-            self._deployments[name] = DeploymentInfo(
+            info = DeploymentInfo(
                 name, serialized_init, num_replicas, ray_actor_options,
                 max_concurrent_queries, autoscaling_config, route_prefix,
                 version)
-            if prev is not None:
-                # Code/config changed: replace existing replicas.
-                self._stop_replicas(name, len(self._replicas.get(name, [])))
+            self._deployments[name] = info
+            lightweight = (
+                prev is not None
+                and prev.replica_fingerprint() == info.replica_fingerprint())
+            replicas = list(self._replicas.get(name, ()))
             self._cv.notify_all()
+        if lightweight:
+            # Only user_config (or replica count / autoscaling / route)
+            # changed: reconfigure live replicas in place, no restarts.
+            # Under the reconcile mutex so two concurrent deploys can't
+            # interleave their reconfigure waves out of order.  A
+            # replica is version-bumped only AFTER its reconfigure
+            # succeeds — on failure (rejected config, dead actor) it
+            # stays old-version and the rolling reconciler replaces it
+            # with a fresh replica built from the new serialized_init.
+            user_config = serialized_init[3]
+            with self._reconcile_mutex:
+                # A later deploy may have won the mutex first: applying
+                # this (older) wave would regress replicas to a stale
+                # config, so skip it entirely.
+                with self._lock:
+                    cur = self._deployments.get(name)
+                    stale = cur is None or cur.version != version
+                if not stale:
+                    # All reconfigures issued up front, gathered under
+                    # one shared deadline — N hung replicas cost one
+                    # timeout, not N, and we hold _reconcile_mutex here.
+                    waves = [(rep,
+                              rep.handle.reconfigure.remote(user_config)
+                              if user_config is not None else None)
+                             for rep in replicas]
+                    deadline = time.monotonic() + 30.0
+                    for rep, fut in waves:
+                        try:
+                            if fut is not None:
+                                ray_tpu.get(fut, timeout=max(
+                                    0.1, deadline - time.monotonic()))
+                            rep.version = version
+                        except Exception:
+                            # Rejected config / hung or dead replica:
+                            # stays old-version; the rolling reconciler
+                            # replaces it with a fresh replica.
+                            pass
+            with self._lock:
+                self._bump()
         self._reconcile_once()
         return True
 
@@ -90,10 +177,12 @@ class ServeController:
             info = self._deployments.get(name)
             if info is None:
                 return None
+            reps = self._replicas.get(name, [])
             return {"name": info.name, "num_replicas": info.num_replicas,
                     "version": info.version,
-                    "num_running_replicas":
-                        len(self._replicas.get(name, []))}
+                    "num_running_replicas": len(reps),
+                    "num_current_version_replicas":
+                        sum(1 for r in reps if r.version == info.version)}
 
     def list_deployments(self) -> List[str]:
         with self._lock:
@@ -122,8 +211,10 @@ class ServeController:
                     if info.route_prefix}
 
     def get_replica_handles(self, name: str) -> List:
+        # Old-version replicas keep serving until the rolling update
+        # retires them, so the router sees all of them.
         with self._lock:
-            return list(self._replicas.get(name, []))
+            return [r.handle for r in self._replicas.get(name, [])]
 
     # ---- long poll (reference long_poll.py) ---------------------------
     def listen_for_change(self, known_version: int, timeout: float = 10.0
@@ -149,13 +240,15 @@ class ServeController:
         cfg = info.autoscaling_config
         if not cfg:
             return info.num_replicas
-        import math
-        handles = self._replicas.get(info.name, [])
+        handles = [r.handle for r in self._replicas.get(info.name, [])]
         if not handles:
             return max(1, cfg.get("min_replicas", 1))
         try:
+            # Bounded: this runs under self._lock — an untimed get on a
+            # hung replica would freeze every controller entry point.
             inflight = sum(ray_tpu.get(
-                [h.get_num_inflight.remote() for h in handles]))
+                [h.get_num_inflight.remote() for h in handles],
+                timeout=5.0))
         except Exception:
             return len(handles)
         target_per = cfg.get("target_num_ongoing_requests_per_replica", 1)
@@ -164,53 +257,200 @@ class ServeController:
         return max(cfg.get("min_replicas", 1),
                    min(cfg.get("max_replicas", 10), want))
 
-    def _reconcile_once(self):
+    def _start_replicas(self, info: DeploymentInfo, count: int
+                        ) -> List[_Replica]:
         from ray_tpu.serve.replica import ReplicaActor
+        opts = dict(info.ray_actor_options)
+        opts.setdefault("num_cpus", 1)
+        # +2 headroom so control calls (get_num_inflight, health) never
+        # queue behind saturated request slots — the router, not actor
+        # concurrency, enforces max_concurrent_queries.
+        opts["max_concurrency"] = max(2, info.max_concurrent_queries) + 2
+        cls = ray_tpu.remote(**opts)(ReplicaActor)
+        return [_Replica(cls.remote(info.serialized_init), info.version)
+                for _ in range(count)]
+
+    def _adopt_or_kill(self, name: str, version: int,
+                       new: List[_Replica]) -> bool:
+        """Register freshly started replicas iff the deployment still
+        wants that version; otherwise kill them.  Returns adopted?"""
+        with self._lock:
+            info = self._deployments.get(name)
+            if info is not None and info.version == version:
+                self._replicas.setdefault(name, []).extend(new)
+                return True
+        for rep in new:
+            try:
+                ray_tpu.kill(rep.handle)
+            except Exception:
+                pass
+        return False
+
+    def _wait_healthy(self, reps: List[_Replica], timeout: float = 30.0
+                      ) -> List[_Replica]:
+        """Block until each replica answers check_health (actor started
+        and ctor ran); drop ones that error out.  All probes are issued
+        up front so N hung replicas cost one timeout, not N."""
+        futs = [(rep, rep.handle.check_health.remote()) for rep in reps]
+        healthy = []
+        deadline = time.monotonic() + timeout
+        for rep, fut in futs:
+            try:
+                ray_tpu.get(fut, timeout=max(
+                    0.1, deadline - time.monotonic()))
+                healthy.append(rep)
+            except Exception:
+                try:
+                    ray_tpu.kill(rep.handle)
+                except Exception:
+                    pass
+        return healthy
+
+    def _drain_and_kill(self, victims: List[_Replica],
+                        drain_timeout: float = 10.0):
+        """Retire replicas gracefully: they are already out of
+        _replicas and the config version was bumped, so routers drop
+        them on their next long-poll refresh; wait for in-flight
+        requests (and the router refresh window) to drain before
+        killing (reference replica graceful_shutdown loop)."""
+        if not victims:
+            return
+        # Grace so routers' long-polls (woken by the bump) refetch the
+        # replica set before we start judging in-flight counts.
+        time.sleep(0.25)
+        deadline = time.monotonic() + drain_timeout
+        pending = list(victims)
+        while pending and time.monotonic() < deadline:
+            still = []
+            for rep in pending:
+                try:
+                    if ray_tpu.get(rep.handle.get_num_inflight.remote(),
+                                   timeout=2.0) > 0:
+                        still.append(rep)
+                except exceptions.GetTimeoutError:
+                    # Slow to answer != dead: keep draining it until
+                    # the overall deadline.
+                    still.append(rep)
+                except Exception:
+                    pass   # dead already — nothing to drain
+            pending = still
+            if pending:
+                time.sleep(0.05)
+        for rep in victims:
+            self._health_fail_counts.pop(rep, None)
+            try:
+                ray_tpu.kill(rep.handle)
+            except Exception:
+                pass
+
+    def _reconcile_once(self):
+        with self._reconcile_mutex:
+            self._reconcile_locked()
+
+    def _reconcile_locked(self):
         with self._lock:
             if self._shutdown:
                 return
-            work = []
+            scale_up: List[Tuple[str, DeploymentInfo, int]] = []
+            rolling: List[Tuple[str, DeploymentInfo, int]] = []
+            retire: List[_Replica] = []
             for name, info in self._deployments.items():
-                have = self._replicas.setdefault(name, [])
+                reps = self._replicas.setdefault(name, [])
                 want = self._target_replicas(info)
-                if len(have) < want:
-                    work.append((name, info, want - len(have)))
-                elif len(have) > want:
-                    self._stop_replicas(name, len(have) - want)
-                    self._bump()
-            deployments = dict(self._deployments)
-        changed = False
-        for name, info, count in work:
-            opts = dict(info.ray_actor_options)
-            opts.setdefault("num_cpus", 1)
-            # +2 headroom so control calls (get_num_inflight, health) never
-            # queue behind saturated request slots — the router, not actor
-            # concurrency, enforces max_concurrent_queries.
-            opts["max_concurrency"] = max(2, info.max_concurrent_queries) + 2
-            cls = ray_tpu.remote(**opts)(ReplicaActor)
-            new = [cls.remote(info.serialized_init) for _ in range(count)]
-            with self._lock:
-                if name in self._deployments and \
-                        self._deployments[name].version == info.version:
-                    self._replicas[name].extend(new)
-                    changed = True
-                else:
-                    for h in new:
-                        try:
-                            ray_tpu.kill(h)
-                        except Exception:
-                            pass
-        if changed:
-            with self._lock:
+                old = [r for r in reps if r.version != info.version]
+                if len(reps) < want:
+                    scale_up.append((name, info, want - len(reps)))
+                elif len(reps) > want:
+                    # Retire old-version replicas first when shrinking.
+                    reps.sort(key=lambda r: r.version == info.version)
+                    n_drop = len(reps) - want
+                    retire.extend(reps[:n_drop])
+                    self._replicas[name] = reps[n_drop:]
+                    old = [r for r in self._replicas[name]
+                           if r.version != info.version]
+                if old:
+                    surge = max(1, math.ceil(want * _ROLLING_SURGE_FRACTION))
+                    rolling.append((name, info, min(surge, len(old))))
+            if retire:
                 self._bump()
+        self._drain_and_kill(retire)
+        for name, info, count in scale_up:
+            new = self._wait_healthy(self._start_replicas(info, count))
+            if new and self._adopt_or_kill(name, info.version, new):
+                with self._lock:
+                    self._bump()
+        for name, info, count in rolling:
+            # Surge `count` new-version replicas in, wait until they are
+            # serving, then retire `count` old-version ones.
+            new = self._wait_healthy(self._start_replicas(info, count))
+            if not new:
+                continue
+            if not self._adopt_or_kill(name, info.version, new):
+                continue
+            with self._lock:
+                reps = self._replicas.get(name, [])
+                old = [r for r in reps if r.version != info.version]
+                victims = old[:len(new)]
+                self._replicas[name] = [r for r in reps
+                                        if r not in victims]
+                self._bump()
+            self._drain_and_kill(victims)
+        self._maybe_health_check()
+
+    def _maybe_health_check(self):
+        now = time.monotonic()
+        if now - self._last_health_check < _HEALTH_CHECK_PERIOD_S:
+            return
+        self._last_health_check = now
+        with self._lock:
+            snapshot = {name: list(reps)
+                        for name, reps in self._replicas.items()}
+        # All probes issued up front against ONE shared deadline, so N
+        # hung replicas cost one timeout — and this runs under the
+        # reconcile mutex, where a long stall would block deploys.
+        probes = [(name, rep, rep.handle.check_health.remote())
+                  for name, reps in snapshot.items() for rep in reps]
+        deadline = time.monotonic() + 10.0
+        dead: List[Tuple[str, _Replica]] = []
+        for name, rep, fut in probes:
+            try:
+                ray_tpu.get(fut, timeout=max(
+                    0.1, deadline - time.monotonic()))
+                self._health_fail_counts.pop(rep, None)
+            except exceptions.GetTimeoutError:
+                # Slow answers only count toward a consecutive-failure
+                # threshold (reference health loop): one long GC pause
+                # or load spike is not death.
+                fails = self._health_fail_counts.get(rep, 0) + 1
+                self._health_fail_counts[rep] = fails
+                if fails >= _HEALTH_CHECK_FAILURE_THRESHOLD:
+                    dead.append((name, rep))
+            except Exception:
+                # The probe itself failed (actor died, user
+                # check_health raised): definitively unhealthy.
+                dead.append((name, rep))
+        if not dead:
+            return
+        with self._lock:
+            for name, rep in dead:
+                reps = self._replicas.get(name)
+                if reps and rep in reps:
+                    reps.remove(rep)
+                self._health_fail_counts.pop(rep, None)
+            self._bump()
+        # Drain whatever is still answering before the kill; a truly
+        # dead replica drains instantly (its probe raises non-timeout).
+        self._drain_and_kill([rep for _, rep in dead], drain_timeout=5.0)
+        # The next reconcile pass scales the deployment back up.
 
     def _stop_replicas(self, name: str, count: int):
         # Must hold lock.
-        handles = self._replicas.get(name, [])
-        victims, self._replicas[name] = handles[:count], handles[count:]
-        for h in victims:
+        reps = self._replicas.get(name, [])
+        victims, self._replicas[name] = reps[:count], reps[count:]
+        for rep in victims:
+            self._health_fail_counts.pop(rep, None)
             try:
-                ray_tpu.kill(h)
+                ray_tpu.kill(rep.handle)
             except Exception:
                 pass
 
@@ -220,7 +460,7 @@ class ServeController:
                 self._reconcile_once()
             except Exception:
                 pass
-            time.sleep(0.25)
+            time.sleep(_RECONCILE_PERIOD_S)
 
     def shutdown(self) -> bool:
         with self._lock:

@@ -11,9 +11,10 @@ from ray_tpu.tune.sample import (  # noqa: F401
     choice, grid_search, loguniform, qrandint, quniform, randint,
     sample_from, uniform)
 from ray_tpu.tune.schedulers import (  # noqa: F401
-    AsyncHyperBandScheduler, FIFOScheduler, MedianStoppingRule,
-    PopulationBasedTraining, TrialScheduler)
-from ray_tpu.tune.suggest import BasicVariantGenerator, Searcher  # noqa: F401
+    AsyncHyperBandScheduler, FIFOScheduler, HyperBandScheduler,
+    MedianStoppingRule, PopulationBasedTraining, TrialScheduler)
+from ray_tpu.tune.suggest import (  # noqa: F401
+    BasicVariantGenerator, Searcher, TPESearcher, TuneBOHB)
 from ray_tpu.tune.trainable import (  # noqa: F401
     Trainable, get_trial_id, load_checkpoint, report, save_checkpoint)
 from ray_tpu.tune.trial import Trial  # noqa: F401
@@ -24,9 +25,10 @@ ASHAScheduler = AsyncHyperBandScheduler
 
 __all__ = [
     "ASHAScheduler", "AsyncHyperBandScheduler", "BasicVariantGenerator",
-    "ExperimentAnalysis", "FIFOScheduler", "MedianStoppingRule",
-    "PopulationBasedTraining", "Searcher", "Trainable", "Trial",
-    "TrialRunner", "TrialScheduler", "TuneError", "choice", "get_trial_id",
+    "ExperimentAnalysis", "FIFOScheduler", "HyperBandScheduler",
+    "MedianStoppingRule", "PopulationBasedTraining", "Searcher",
+    "TPESearcher", "Trainable", "Trial", "TrialRunner", "TrialScheduler",
+    "TuneBOHB", "TuneError", "choice", "get_trial_id",
     "grid_search", "load_checkpoint", "loguniform", "qrandint", "quniform",
     "randint", "report", "run", "sample_from", "save_checkpoint", "uniform",
 ]

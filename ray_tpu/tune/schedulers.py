@@ -32,6 +32,15 @@ class TrialScheduler:
     def on_trial_complete(self, trial: Trial, result: Optional[Dict]):
         pass
 
+    def choose_trial_to_run(self, trials: List[Trial]) -> Optional[Trial]:
+        """Pick the next trial the runner should (re)start (reference
+        trial_scheduler.py choose_trial_to_run).  Synchronous schedulers
+        override this to hold PAUSED trials until their cohort decides."""
+        for t in trials:
+            if t.status in (Trial.PENDING, Trial.PAUSED):
+                return t
+        return None
+
 
 class FIFOScheduler(TrialScheduler):
     pass
@@ -90,6 +99,165 @@ class AsyncHyperBandScheduler(TrialScheduler):
         if action == TrialScheduler.STOP:
             self.stopped += 1
         return action
+
+
+class _Bracket:
+    """One synchronous successive-halving bracket."""
+
+    def __init__(self, capacity: int, r0: int, eta: float, max_t: int):
+        self.capacity = capacity
+        self.milestone = r0
+        self.eta = eta
+        self.max_t = max_t
+        self.added = 0                         # trials EVER assigned
+        self.halved = False
+        self.closed = False                    # no more trials coming
+        self.live: List[Trial] = []
+        self.recorded: Dict[str, float] = {}   # trial_id -> metric
+        self.resumable: set = set()            # trial_ids cleared to run
+
+    def full(self) -> bool:
+        # Count trials ever added, not the live list — halving shrinks
+        # live, and a bracket must not keep absorbing new trials (which
+        # would join at an already-advanced milestone and skip the
+        # early rungs the incumbents were filtered at).
+        return self.added >= self.capacity or self.halved
+
+    def quorum(self) -> bool:
+        # The first halving waits for the bracket to actually FILL (or
+        # for the source to run dry — ``closed``): with a lazy variant
+        # source, halving over just the trials that happen to have
+        # arrived would shrink every cohort to the concurrency level
+        # (and to 1 at max_concurrent_trials=1, a silent no-op).
+        if not self.live or len(self.recorded) < len(self.live):
+            return False
+        return self.halved or self.closed or self.added >= self.capacity
+
+    def halve(self) -> set:
+        """Keep the top 1/eta, terminate the rest.  Returns the
+        surviving trial_ids; losers that are PAUSED are terminated here
+        (their actors are already stopped), a loser still RUNNING gets
+        STOP from on_trial_result."""
+        self.halved = True
+        ranked = sorted(self.live,
+                        key=lambda t: self.recorded[t.trial_id],
+                        reverse=True)
+        k = max(1, int(math.ceil(len(ranked) / self.eta)))
+        survivors, losers = ranked[:k], ranked[k:]
+        for t in losers:
+            if t.status == Trial.PAUSED:
+                t.status = Trial.TERMINATED
+        self.live = survivors
+        self.milestone = min(int(self.milestone * self.eta), self.max_t)
+        self.recorded = {}
+        ids = {t.trial_id for t in survivors}
+        self.resumable |= ids
+        return ids
+
+
+class HyperBandScheduler(TrialScheduler):
+    """Synchronous HyperBand (reference ``hyperband.py``): trials fill
+    successive-halving brackets of geometrically varying width/budget;
+    each bracket waits (PAUSE) for all members to reach the current
+    milestone, keeps the top 1/eta, and multiplies the milestone by eta.
+    Unlike ASHA the halving decision sees the whole cohort, so stragglers
+    are held at the rung instead of racing ahead."""
+
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 time_attr: str = "training_iteration",
+                 max_t: int = 81, reduction_factor: float = 3):
+        self._metric = metric
+        self._mode = mode
+        self._time_attr = time_attr
+        self._eta = reduction_factor
+        self._max_t = max_t
+        # Bracket ladder: s = s_max..0, bracket s starts
+        # n_s = ceil((s_max+1)/(s+1) * eta^s) trials at budget
+        # r_s = max_t * eta^-s (the HyperBand paper's outer loop).
+        s_max = int(math.log(max_t, reduction_factor))
+        self._specs = []
+        for s in range(s_max, -1, -1):
+            n = int(math.ceil((s_max + 1) / (s + 1)
+                              * reduction_factor ** s))
+            r = max(1, int(max_t * reduction_factor ** (-s)))
+            self._specs.append((n, r))
+        self._brackets: List[_Bracket] = []
+        self._spec_idx = 0
+        self._by_trial: Dict[str, _Bracket] = {}
+        self.stopped = 0
+
+    def _value(self, result: Dict) -> Optional[float]:
+        v = result.get(self._metric)
+        if v is None:
+            return None
+        return float(v) if self._mode == "max" else -float(v)
+
+    def on_trial_add(self, trial: Trial):
+        if not self._brackets or self._brackets[-1].full():
+            n, r = self._specs[self._spec_idx % len(self._specs)]
+            self._spec_idx += 1
+            self._brackets.append(
+                _Bracket(n, r, self._eta, self._max_t))
+        b = self._brackets[-1]
+        b.added += 1
+        b.live.append(trial)
+        self._by_trial[trial.trial_id] = b
+
+    def on_trial_result(self, trial: Trial, result: Dict) -> str:
+        b = self._by_trial.get(trial.trial_id)
+        t = result.get(self._time_attr, 0)
+        if b is None or trial not in b.live:
+            return TrialScheduler.STOP
+        if t >= self._max_t:
+            b.live.remove(trial)
+            b.recorded.pop(trial.trial_id, None)
+            if b.quorum():
+                b.halve()
+            return TrialScheduler.STOP
+        v = self._value(result)
+        if v is None or t < b.milestone:
+            return TrialScheduler.CONTINUE
+        b.recorded[trial.trial_id] = v
+        if not b.quorum():
+            return TrialScheduler.PAUSE     # wait for the cohort
+        survivors = b.halve()
+        if trial.trial_id in survivors:
+            b.resumable.discard(trial.trial_id)   # it is already running
+            return TrialScheduler.CONTINUE
+        self.stopped += 1
+        return TrialScheduler.STOP
+
+    def on_trial_complete(self, trial: Trial, result: Optional[Dict]):
+        b = self._by_trial.pop(trial.trial_id, None)
+        if b is None or trial not in b.live:
+            return
+        b.live.remove(trial)
+        b.recorded.pop(trial.trial_id, None)
+        b.resumable.discard(trial.trial_id)
+        if b.quorum():
+            b.halve()
+
+    def choose_trial_to_run(self, trials: List[Trial]) -> Optional[Trial]:
+        for t in trials:
+            if t.status == Trial.PENDING:
+                return t
+        for t in trials:
+            if t.status == Trial.PAUSED:
+                b = self._by_trial.get(t.trial_id)
+                if b is not None and t.trial_id in b.resumable:
+                    b.resumable.discard(t.trial_id)
+                    return t
+        return None
+
+    def no_more_trials(self):
+        """The variant source is exhausted (runner callback): brackets
+        that were waiting to fill will never fill — close them and
+        halve any whose cohort has fully recorded, so paused trials
+        resume instead of waiting forever."""
+        for b in self._brackets:
+            b.closed = True
+            if b.quorum():
+                b.halve()
 
 
 class MedianStoppingRule(TrialScheduler):

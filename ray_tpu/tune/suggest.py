@@ -16,7 +16,7 @@ import itertools
 import random
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
-from ray_tpu.tune.sample import Domain
+from ray_tpu.tune.sample import Categorical, Domain, Float, Integer
 
 
 def _split_spec(spec: Dict[str, Any], prefix=()):
@@ -81,6 +81,168 @@ class Searcher:
                           result: Optional[Dict] = None,
                           error: bool = False):
         pass
+
+
+class TPESearcher(Searcher):
+    """Tree-structured Parzen Estimator searcher — the model behind
+    BOHB (reference wraps hyperopt/``TuneBOHB``; this is a
+    self-contained numpy implementation over the repo's own Domains).
+
+    Observed (config, score) pairs are split at the ``gamma`` quantile
+    into good/bad sets; per dimension a kernel-density model is fit to
+    each set (Gaussian KDE for Float/Integer, smoothed frequencies for
+    Categorical) and candidates drawn from the good model are ranked by
+    the density ratio l(x)/g(x).  Until ``n_initial`` results arrive it
+    samples randomly."""
+
+    def __init__(self, space: Dict[str, Any], metric: str = "score",
+                 mode: str = "max", n_initial: int = 8,
+                 gamma: float = 0.25, n_candidates: int = 24,
+                 seed: Optional[int] = None):
+        super().__init__(metric, mode)
+        self._space = dict(space)
+        self._rng = random.Random(seed)
+        self._n_initial = n_initial
+        self._gamma = gamma
+        self._n_candidates = n_candidates
+        self._configs: Dict[str, Dict[str, Any]] = {}
+        self._scores: Dict[str, float] = {}
+
+    # -- observation ---------------------------------------------------
+    def _observe(self, trial_id: str, result: Optional[Dict]):
+        if not result or trial_id not in self._configs:
+            return
+        v = result.get(self.metric)
+        if v is None:
+            return
+        v = float(v) if self.mode == "max" else -float(v)
+        # Keep the best score the trial ever reported.
+        prev = self._scores.get(trial_id)
+        self._scores[trial_id] = v if prev is None else max(prev, v)
+
+    def on_trial_result(self, trial_id: str, result: Dict):
+        self._observe(trial_id, result)
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict] = None,
+                          error: bool = False):
+        if not error:
+            self._observe(trial_id, result)
+
+    # -- modelling -----------------------------------------------------
+    def _split(self):
+        scored = [(self._scores[tid], self._configs[tid])
+                  for tid in self._scores]
+        scored.sort(key=lambda p: p[0], reverse=True)
+        k = max(1, int(len(scored) * self._gamma))
+        return [c for _, c in scored[:k]], [c for _, c in scored[k:]]
+
+    @staticmethod
+    def _kde_logpdf(x: float, points: List[float], lo: float, hi: float
+                    ) -> float:
+        import math
+        if not points:
+            return 0.0
+        span = max(hi - lo, 1e-12)
+        # Silverman-ish bandwidth, floored so single points still smear.
+        bw = max(span * 1.06 * len(points) ** -0.2 / 4, span * 0.05)
+        dens = sum(math.exp(-0.5 * ((x - p) / bw) ** 2) for p in points)
+        return math.log(dens / (len(points) * bw) + 1e-300)
+
+    def _dim_logratio(self, name: str, dom, value, good, bad) -> float:
+        import math
+        gv = [c[name] for c in good if name in c]
+        bv = [c[name] for c in bad if name in c]
+        if isinstance(dom, Categorical):
+            n = len(dom.categories)
+            gcount = 1 + sum(1 for v in gv if v == value)
+            bcount = 1 + sum(1 for v in bv if v == value)
+            return math.log(gcount / (len(gv) + n)) - \
+                math.log(bcount / (len(bv) + n))
+        if hasattr(dom, "lo"):
+            lo, hi = float(dom.lo), float(dom.hi)
+            if getattr(dom, "log", False):
+                tr = math.log
+                lo, hi = tr(lo), tr(hi)
+                x = tr(value)
+                gv = [tr(v) for v in gv]
+                bv = [tr(v) for v in bv]
+            else:
+                x = float(value)
+                gv = [float(v) for v in gv]
+                bv = [float(v) for v in bv]
+            return self._kde_logpdf(x, gv, lo, hi) - \
+                self._kde_logpdf(x, bv, lo, hi)
+        return 0.0
+
+    def _sample_random(self) -> Dict[str, Any]:
+        cfg = {}
+        for k, v in self._space.items():
+            cfg[k] = v.sample(self._rng) if isinstance(v, Domain) else v
+        return cfg
+
+    def _sample_from_good(self, good: List[Dict]) -> Dict[str, Any]:
+        """Candidate draw: per dimension, perturb a random good value
+        (the TPE l(x) draw), falling back to the prior."""
+        base = self._rng.choice(good)
+        cfg = {}
+        for k, dom in self._space.items():
+            if not isinstance(dom, Domain) or k not in base \
+                    or self._rng.random() < 0.2:
+                cfg[k] = dom.sample(self._rng) \
+                    if isinstance(dom, Domain) else dom
+                continue
+            v = base[k]
+            if isinstance(dom, Categorical):
+                cfg[k] = v
+            elif isinstance(dom, Float):
+                import math
+                if dom.log:
+                    span = math.log(dom.hi) - math.log(dom.lo)
+                    x = math.log(v) + self._rng.gauss(0, span * 0.1)
+                    cfg[k] = min(dom.hi, max(dom.lo, math.exp(x)))
+                else:
+                    span = dom.hi - dom.lo
+                    x = v + self._rng.gauss(0, span * 0.1)
+                    cfg[k] = min(dom.hi, max(dom.lo, x))
+                if dom.q:
+                    cfg[k] = round(cfg[k] / dom.q) * dom.q
+            elif isinstance(dom, Integer):
+                span = max(1, dom.hi - dom.lo)
+                x = int(round(v + self._rng.gauss(0, span * 0.1)))
+                x = min(dom.hi - 1, max(dom.lo, x))
+                if dom.q > 1:
+                    x = (x // dom.q) * dom.q
+                cfg[k] = x
+            else:
+                cfg[k] = dom.sample(self._rng)
+        return cfg
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if len(self._scores) < self._n_initial:
+            cfg = self._sample_random()
+        else:
+            good, bad = self._split()
+            if not good:
+                cfg = self._sample_random()
+            else:
+                best, best_score = None, -float("inf")
+                for _ in range(self._n_candidates):
+                    cand = self._sample_from_good(good)
+                    s = sum(
+                        self._dim_logratio(k, dom, cand[k], good, bad)
+                        for k, dom in self._space.items()
+                        if isinstance(dom, Domain))
+                    if s > best_score:
+                        best, best_score = cand, s
+                cfg = best
+        self._configs[trial_id] = cfg
+        return dict(cfg)
+
+
+# BOHB = HyperBand scheduling + TPE model (reference tune/suggest/bohb.py
+# TuneBOHB); pair TPESearcher with schedulers.HyperBandScheduler.
+TuneBOHB = TPESearcher
 
 
 class BasicVariantGenerator:

@@ -48,15 +48,17 @@ class TrialRunner:
         self._resources = dict(resources_per_trial or {"cpu": 1})
         self._raise_on_failed = raise_on_failed_trial
         self.trials: List[Trial] = []
-        while True:
-            v = variant_source.next_variant()
-            if v is None:
-                break
-            tag, cfg, trial_id = v if len(v) == 3 else (*v, None)
-            trial = Trial(cfg, resources=self._resources,
-                          experiment_tag=tag, trial_id=trial_id)
-            self.trials.append(trial)
-            self._scheduler.on_trial_add(trial)
+        self._source = variant_source
+        self._source_empty = False
+        self._no_more_sent = False
+        if searcher is None:
+            # Grid/random variants are free to enumerate: register them
+            # all up front so synchronous schedulers (HyperBand) see
+            # full brackets regardless of concurrency.  Only a
+            # model-based searcher is pulled lazily (in step()), so it
+            # sees completed results before suggesting the next config.
+            while self._next_trial() is not None:
+                pass
         if max_concurrent_trials is None:
             total = ray_tpu.cluster_resources().get("CPU", 1)
             per = self._resources.get("cpu", 1) or 1
@@ -93,22 +95,65 @@ class TrialRunner:
                 pass
             trial.runner = None
 
+    def _next_trial(self) -> Optional[Trial]:
+        if self._source_empty:
+            return None
+        v = self._source.next_variant()
+        if v is None:
+            self._source_empty = True
+            return None
+        tag, cfg, trial_id = v if len(v) == 3 else (*v, None)
+        trial = Trial(cfg, resources=self._resources,
+                      experiment_tag=tag, trial_id=trial_id)
+        self.trials.append(trial)
+        self._scheduler.on_trial_add(trial)
+        return trial
+
     # ------------------------------------------------------------------
     def is_finished(self) -> bool:
-        return all(t.is_finished() for t in self.trials)
+        return self._source_empty and \
+            all(t.is_finished() for t in self.trials)
 
     def step(self):
-        # (1) launch pending trials up to the concurrency cap.
-        running = self._running()
-        if len(running) < self._max_concurrent:
-            for t in self.trials:
-                if t.status in (Trial.PENDING, Trial.PAUSED):
-                    self._start_trial(t)
-                    running = self._running()
-                    if len(running) >= self._max_concurrent:
-                        break
+        # (0) a synchronous scheduler (HyperBand halving) may terminate
+        # PAUSED trials by setting their status directly — run the
+        # completion lifecycle (searcher/scheduler notifications) for
+        # any finished trial that never went through _complete.
+        for t in self.trials:
+            if t.is_finished() and not getattr(t, "_lifecycle_done",
+                                               False):
+                self._notify_complete(t)
+        # (1) launch runnable trials up to the concurrency cap.  The
+        # scheduler picks (reference choose_trial_to_run): synchronous
+        # schedulers hold PAUSED trials at a rung until the cohort
+        # decides; the default takes any PENDING/PAUSED trial.  When the
+        # scheduler has nothing runnable, pull the next variant from the
+        # (lazy) source.
+        while len(self._running()) < self._max_concurrent:
+            t = self._scheduler.choose_trial_to_run(self.trials)
+            if t is None:
+                if self._next_trial() is None:
+                    break
+                continue
+            self._start_trial(t)
         if not self._inflight:
-            return
+            if self.is_finished():
+                return
+            # Nothing running and nothing startable, but unfinished
+            # trials remain: they are PAUSED waiting on cohorts that
+            # can never fill (the source is exhausted).  Tell the
+            # scheduler once so it can close its brackets; if it has no
+            # such hook (or that didn't help), fail loudly over hanging.
+            hook = getattr(self._scheduler, "no_more_trials", None)
+            if hook is not None and not self._no_more_sent:
+                self._no_more_sent = True
+                hook()
+                return
+            raise TuneError(
+                "Tune deadlock: no trial is runnable, none are running, "
+                "and the variant source is exhausted; paused trials: " +
+                ", ".join(t.trial_id for t in self.trials
+                          if t.status == Trial.PAUSED))
         # (2) wait for one trial event.
         ready, _ = ray_tpu.wait(list(self._inflight.keys()), num_returns=1,
                                 timeout=60.0)
@@ -154,15 +199,25 @@ class TrialRunner:
 
     def _complete(self, trial: Trial, status: str):
         self._stop_trial(trial, status)
+        self._notify_complete(trial)
+
+    def _notify_complete(self, trial: Trial):
+        trial._lifecycle_done = True
         if self._searcher is not None:
             self._searcher.on_trial_complete(
                 trial.trial_id, trial.last_result,
-                error=status == Trial.ERROR)
+                error=trial.status == Trial.ERROR)
         self._scheduler.on_trial_complete(trial, trial.last_result)
 
     def run(self):
         while not self.is_finished():
             self.step()
+        # Final sweep: trials the scheduler terminated on the last step
+        # still owe their completion notifications.
+        for t in self.trials:
+            if t.is_finished() and not getattr(t, "_lifecycle_done",
+                                               False):
+                self._notify_complete(t)
         # Drop dangling poll refs.
         self._inflight.clear()
 

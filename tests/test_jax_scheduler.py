@@ -210,6 +210,38 @@ class TestDeviceRuntimeSolver:
         assert max(Counter(placed).values()) <= 2
 
 
+    def test_class_eviction_bounds_demand_matrix(self):
+        """Churning through many distinct scheduling classes must not
+        grow the demand matrix forever: idle classes are evicted when
+        growth would widen c_cap, and the solver still solves correctly
+        afterwards (VERDICT r3 weak #7)."""
+        view = self._view(n=4, cpu=64.0)
+        solver = DeviceRuntimeSolver()
+        solver._CLASS_IDLE_TICKS = 4   # make staleness cheap to reach
+        for wave in range(40):
+            specs = [self._Spec(1.0, 20000 + wave)]
+            targets = solver.solve(view, specs)
+            assert targets is not None and targets[0] is not None
+        assert solver.stats["class_evictions"] > 0
+        # Bounded: far fewer live rows than the 40 classes ever seen.
+        assert len(solver._class_reqs) < 24
+        assert solver._demand_host.shape[0] <= 24
+        # Still correct after compaction, including for a re-appearing
+        # evicted class.
+        specs = [self._Spec(1.0, 20000), self._Spec(1.0, 20039)]
+        targets = solver.solve(view, specs)
+        assert targets is not None and all(t is not None for t in targets)
+
+    def test_class_hard_cap_falls_back(self):
+        """A tick needing more than _MAX_CLASS_ROWS live classes returns
+        None (native greedy fallback) instead of growing unboundedly."""
+        view = self._view(n=2, cpu=8.0)
+        solver = DeviceRuntimeSolver()
+        solver._MAX_CLASS_ROWS = 8
+        specs = [self._Spec(1.0, 30000 + i) for i in range(12)]
+        assert solver.solve(view, specs) is None
+
+
 class TestJaxBackendEndToEnd:
     def test_jax_is_the_default_backend_and_on_dispatch_path(self):
         """scheduler_backend defaults to jax since round 3; burst

@@ -232,3 +232,125 @@ class TestAutoscaling:
                 break
             time.sleep(0.2)
         assert info["num_running_replicas"] == 1, "never scaled down"
+
+
+class TestRollingUpdate:
+    def test_rolling_update_no_downtime(self, serve_instance):
+        """Redeploying a multi-replica deployment keeps serving: requests
+        issued continuously through the switch never fail, and the
+        version flips to v2 (reference deployment_state.py rolling
+        reconciler)."""
+        @serve.deployment(name="roll", num_replicas=3)
+        def roll(req):
+            return "v1"
+
+        roll.deploy()
+        h = roll.get_handle()
+        assert ray_tpu.get(h.remote(None)) == "v1"
+
+        failures = []
+        seen = set()
+        stop = threading.Event()
+
+        def load():
+            while not stop.is_set():
+                try:
+                    seen.add(ray_tpu.get(h.remote(None), timeout=10))
+                except Exception as e:  # noqa: BLE001
+                    failures.append(e)
+                time.sleep(0.01)
+
+        t = threading.Thread(target=load, daemon=True)
+        t.start()
+        try:
+            @serve.deployment(name="roll", num_replicas=3)
+            def roll2(req):
+                return "v2"
+
+            roll2.deploy()
+            deadline = time.monotonic() + 20
+            controller = ray_tpu.get_actor(serve.controller.CONTROLLER_NAME)
+            while time.monotonic() < deadline:
+                info = ray_tpu.get(
+                    controller.get_deployment_info.remote("roll"))
+                if info["num_current_version_replicas"] == 3 and \
+                        info["num_running_replicas"] == 3:
+                    break
+                time.sleep(0.1)
+            assert info["num_current_version_replicas"] == 3
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert not failures, f"requests failed during rolling update: " \
+                             f"{failures[:3]}"
+        # Give the router a beat to drop the retired v1 replicas.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if ray_tpu.get(h.remote(None)) == "v2":
+                break
+            time.sleep(0.1)
+        assert ray_tpu.get(h.remote(None)) == "v2"
+
+    def test_user_config_reconfigure_in_place(self, serve_instance):
+        """A redeploy that changes only user_config must NOT restart
+        replicas: in-replica state survives and reconfigure() runs
+        (reference lightweight-update path)."""
+        @serve.deployment(name="cfg", user_config={"threshold": 1})
+        class Configurable:
+            def __init__(self):
+                self.threshold = None
+                self.calls = 0   # dies if the replica restarts
+
+            def reconfigure(self, config):
+                self.threshold = config["threshold"]
+
+            def __call__(self, req):
+                self.calls += 1
+                return {"threshold": self.threshold, "calls": self.calls}
+
+        Configurable.deploy()
+        h = Configurable.get_handle()
+        out1 = ray_tpu.get(h.remote(None))
+        assert out1["threshold"] == 1
+
+        Configurable.options(user_config={"threshold": 7}).deploy()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            out = ray_tpu.get(h.remote(None))
+            if out["threshold"] == 7:
+                break
+            time.sleep(0.05)
+        assert out["threshold"] == 7
+        # calls kept counting up => same replica object, not a restart.
+        assert out["calls"] > out1["calls"]
+
+    def test_health_check_replaces_dead_replica(self, serve_instance):
+        @serve.deployment(name="hc", num_replicas=2)
+        def hc(req):
+            return "ok"
+
+        hc.deploy()
+        controller = ray_tpu.get_actor(serve.controller.CONTROLLER_NAME)
+        handles = ray_tpu.get(
+            controller.get_replica_handles.remote("hc"))
+        assert len(handles) == 2
+        ray_tpu.kill(handles[0])
+        # The periodic health check must notice and the reconciler must
+        # restore 2 healthy replicas.
+        deadline = time.monotonic() + 30
+        ok = False
+        while time.monotonic() < deadline:
+            info = ray_tpu.get(controller.get_deployment_info.remote("hc"))
+            if info["num_running_replicas"] == 2:
+                live = ray_tpu.get(
+                    controller.get_replica_handles.remote("hc"))
+                try:
+                    assert all(ray_tpu.get(
+                        [h.check_health.remote() for h in live],
+                        timeout=5))
+                    ok = True
+                    break
+                except Exception:
+                    pass
+            time.sleep(0.25)
+        assert ok, "controller never replaced the dead replica"

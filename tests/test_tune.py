@@ -242,3 +242,123 @@ def test_searcher_sees_suggested_trial_ids(ray_8):
     assert set(searcher.completed) == set(searcher.suggested)
     assert set(searcher.resulted) <= set(searcher.suggested)
     assert searcher.resulted  # results actually flowed
+
+
+def test_hyperband_synchronous_halving(ray_8):
+    """Synchronous HyperBand: every trial in a bracket is held at the
+    rung until the cohort arrives, then only the top 1/eta continue."""
+    from ray_tpu.tune import HyperBandScheduler
+
+    def trainable(config):
+        for i in range(1, 30):
+            tune.report(score=config["q"] * i, training_iteration=i)
+
+    sched = HyperBandScheduler(metric="score", mode="max", max_t=9,
+                               reduction_factor=3)
+    analysis = tune.run(trainable,
+                        config={"q": tune.grid_search([9, 8, 1, 2, 7, 3])},
+                        scheduler=sched, metric="score", mode="max")
+    assert analysis.best_config["q"] == 9
+    assert sched.stopped >= 1 or any(
+        t.status == Trial.TERMINATED
+        and t.last_result.get("training_iteration", 0) < 9
+        for t in analysis.trials)
+    # The best trial ran at least as long as the worst.
+    iters = {t.config["q"]: t.last_result.get("training_iteration", 0)
+             for t in analysis.trials}
+    assert iters[9] >= iters[1]
+
+
+def test_hyperband_resumes_from_checkpoint(ray_8):
+    """Survivors resume from their checkpoint after the rung pause
+    instead of restarting from scratch."""
+    from ray_tpu.tune import HyperBandScheduler
+
+    def trainable(config):
+        state = tune.load_checkpoint()
+        start = state["i"] + 1 if state else 1
+        for i in range(start, 30):
+            tune.save_checkpoint(i=i)
+            tune.report(score=config["q"] + i, training_iteration=i,
+                        started_at=start)
+
+    sched = HyperBandScheduler(metric="score", mode="max", max_t=9,
+                               reduction_factor=3)
+    # Best trial first: it pauses at the rung, the straggler completes
+    # the cohort, and the winner must RESUME from its checkpoint.
+    analysis = tune.run(trainable,
+                        config={"q": tune.grid_search([30, 20, 10])},
+                        scheduler=sched, metric="score", mode="max",
+                        max_concurrent_trials=2)
+    assert analysis.best_config["q"] == 30
+    # At least one trial was paused at a rung and resumed mid-stream.
+    assert any(t.last_result.get("started_at", 1) > 1
+               for t in analysis.trials)
+
+
+def test_tpe_searcher_improves_over_random(ray_8):
+    """TPE concentrates suggestions near the optimum once the model
+    kicks in: later suggestions must on average beat the initial random
+    phase on a smooth 1-d objective."""
+    from ray_tpu.tune.suggest import TPESearcher
+
+    def trainable(config):
+        x = config["x"]
+        tune.report(score=-(x - 0.7) ** 2, training_iteration=1)
+
+    searcher = TPESearcher({"x": tune.uniform(0.0, 1.0)},
+                           metric="score", mode="max",
+                           n_initial=6, seed=7)
+    analysis = tune.run(trainable, search_alg=searcher, num_samples=24,
+                        metric="score", mode="max",
+                        max_concurrent_trials=1)
+    xs = [t.config["x"] for t in analysis.trials]
+    early = xs[:6]
+    late = xs[12:]
+    err = lambda vals: sum((v - 0.7) ** 2 for v in vals) / len(vals)
+    assert err(late) < err(early)
+    assert abs(analysis.best_config["x"] - 0.7) < 0.25
+
+
+def test_bohb_combo_runs(ray_8):
+    """TuneBOHB searcher + HyperBandScheduler together (the BOHB
+    pairing) complete and find a good config."""
+    from ray_tpu.tune import HyperBandScheduler, TuneBOHB
+
+    def trainable(config):
+        for i in range(1, 12):
+            tune.report(score=config["lr"] * i, training_iteration=i)
+
+    searcher = TuneBOHB({"lr": tune.uniform(0.1, 1.0)},
+                        metric="score", mode="max", n_initial=4, seed=3)
+    sched = HyperBandScheduler(metric="score", mode="max", max_t=9,
+                               reduction_factor=3)
+    analysis = tune.run(trainable, search_alg=searcher, scheduler=sched,
+                        num_samples=10, metric="score", mode="max",
+                        max_concurrent_trials=4)
+    assert analysis.best_config["lr"] > 0.4
+
+
+def test_hyperband_not_a_noop_at_low_concurrency(ray_8):
+    """With max_concurrent_trials=1 the bracket must still form a full
+    cohort (trials pause at the rung until everyone arrives) and
+    early-stop the losers — not degenerate into per-trial cohorts that
+    all run to max_t."""
+    from ray_tpu.tune import HyperBandScheduler
+
+    def trainable(config):
+        for i in range(1, 30):
+            tune.report(score=config["q"] * i, training_iteration=i)
+
+    sched = HyperBandScheduler(metric="score", mode="max", max_t=9,
+                               reduction_factor=3)
+    analysis = tune.run(trainable,
+                        config={"q": tune.grid_search([1, 2, 9])},
+                        scheduler=sched, metric="score", mode="max",
+                        max_concurrent_trials=1)
+    assert analysis.best_config["q"] == 9
+    iters = {t.config["q"]: t.last_result.get("training_iteration", 0)
+             for t in analysis.trials}
+    # Losers were cut at the first rung, not run to max_t.
+    assert iters[1] < 9 and iters[2] < 9
+    assert iters[9] >= 9
