@@ -151,7 +151,8 @@ class ClusterTaskManager:
         """
         from ray_tpu.scheduler import jax_backend
         if self._jax_solver is None:
-            self._jax_solver = jax_backend.DeviceRuntimeSolver()
+            self._jax_solver = jax_backend.DeviceRuntimeSolver(
+                node_label=self._raylet.node_id.hex()[:12])
         view = self._raylet.cluster_view
         with self._lock:
             work: list = []

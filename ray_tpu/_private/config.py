@@ -90,6 +90,10 @@ class Config:
     #: Hard per-node worker cap (runaway backstop; the envelope needs
     #: thousands of dedicated actor workers, reference supports 10k+).
     max_workers_per_node: int = 20_000
+    #: Mirror process-worker stdout/stderr lines onto the driver's
+    #: terminal via the worker_logs pubsub channel (reference
+    #: log_to_driver / log_monitor.py behavior).
+    log_to_driver: bool = True
 
     # ------ rpc ------
     #: Dispatch threads per RpcServer; requests beyond BOTH the pool and

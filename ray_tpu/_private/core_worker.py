@@ -49,7 +49,22 @@ class CoreWorker:
         self._put_counter = 0
         self._put_lock = threading.Lock()
         self.metrics: Dict[str, float] = {"tasks_finished": 0,
-                                          "task_exec_seconds": 0.0}
+                                          "task_exec_seconds": 0.0,
+                                          "tasks_submitted": 0,
+                                          "actor_tasks_submitted": 0}
+        # Exported at scrape time (/metrics): the hot path only bumps
+        # these plain counters.
+        from ray_tpu._private.metrics_agent import (get_metrics_registry,
+                                                    record_internal)
+
+        wlabel = {"worker": self.worker_id.hex()[:8]}
+
+        def _collect(cw):
+            for k, v in cw.metrics.items():
+                record_internal(f"ray_tpu.core_worker.{k}", v, **wlabel)
+            record_internal("ray_tpu.core_worker.objects_in_memory_store",
+                            len(cw.memory_store._entries), **wlabel)
+        get_metrics_registry().register_collector(self, _collect)
         # Free stored copies when objects go out of scope.
         self.reference_counter.subscribe_deleted(self._free_object)
 
@@ -387,6 +402,7 @@ class CoreWorker:
     def submit_task(self, spec: TaskSpec, holders=()) -> List[ObjectRef]:
         self.task_manager.add_pending_task(spec)
         del holders  # submitted-task refs now pin the promoted args
+        self.metrics["tasks_submitted"] += 1
         self.task_submitter.submit(spec)
         return [ObjectRef(oid, owner_id=self.worker_id)
                 for oid in spec.return_ids]
@@ -394,6 +410,7 @@ class CoreWorker:
     def submit_actor_task(self, spec: TaskSpec, holders=()) -> List[ObjectRef]:
         self.task_manager.add_pending_task(spec)
         del holders
+        self.metrics["actor_tasks_submitted"] += 1
         self.actor_submitter.submit(spec)
         return [ObjectRef(oid, owner_id=self.worker_id)
                 for oid in spec.return_ids]

@@ -254,6 +254,7 @@ class HeadService:
         s.register("add_location", self._handle_add_location)
         s.register("get_locations", self._handle_get_locations)
         s.register_async("wait_object", self._handle_wait_object)
+        s.register("publish", self._handle_publish)
         s.register("ping", lambda _p: "pong")
         # Chunked object plane (pull_manager/push_manager parity): any
         # object size crosses the wire as chunk frames with per-chunk
@@ -341,6 +342,12 @@ class HeadService:
     # ---- KV ------------------------------------------------------------
     def _handle_kv_get(self, key: bytes) -> Optional[bytes]:
         return self._cluster.gcs.kv.get(key)
+
+    def _handle_publish(self, payload) -> bool:
+        """Generic pubsub forward from a spoke (worker logs ride this)."""
+        self._cluster.gcs.publisher.publish(
+            payload["channel"], payload["key"], payload["message"])
+        return True
 
     # ---- object plane --------------------------------------------------
     def _owner_inline_blob(self, oid: ObjectID) -> Optional[bytes]:

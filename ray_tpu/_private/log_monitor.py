@@ -1,0 +1,178 @@
+"""Worker log capture + streaming to the driver.
+
+Parity: reference ``python/ray/_private/log_monitor.py`` — every node
+runs a log monitor that tails its workers' stdout/stderr files and
+publishes new lines to GCS pubsub; drivers subscribe and re-print the
+lines with a worker prefix, which is how a ``print()`` inside a task
+running in another OS process shows up on the driver's terminal.
+
+Here the worker-host that spawns a process worker opens
+``<temp_dir>/logs/host-<pid>/worker-<id>.{out,err}`` for the child
+(``worker_pool.py`` wires them into Popen), and a ``LogMonitor`` thread
+in that host tails the directory.  In the in-process cluster the
+monitor publishes straight into the GCS publisher; a ``NodeHost``
+publishes through its wire client (``publish_log`` on the head
+service).  The driver mirror (``mirror_worker_logs``) subscribes to
+the ``worker_logs`` channel.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Callable, Dict, Optional
+
+from ray_tpu._private.config import get_config
+
+LOG_CHANNEL = "worker_logs"
+
+
+def worker_log_dir(create: bool = True) -> str:
+    """This host process's worker-log directory.  Keyed by pid: each
+    worker-host (driver process, NodeHost) owns one directory on its
+    machine, like the reference's per-node session logs dir."""
+    d = os.path.join(get_config().temp_dir, "logs", f"host-{os.getpid()}")
+    if create:
+        os.makedirs(d, exist_ok=True)
+    return d
+
+
+def open_worker_log_files(worker_id_hex: str):
+    """(stdout, stderr) file objects for a spawning worker process."""
+    d = worker_log_dir()
+    out = open(os.path.join(d, f"worker-{worker_id_hex}.out"), "ab")
+    err = open(os.path.join(d, f"worker-{worker_id_hex}.err"), "ab")
+    return out, err
+
+
+class LogMonitor:
+    """Tails every ``worker-*.{out,err}`` file in this host's log dir
+    and ships complete new lines through ``publish(payload)``.
+
+    ``payload`` = ``{"worker": <id hex>, "is_err": bool,
+    "lines": [str, ...], "host_pid": int}``.
+    """
+
+    def __init__(self, publish: Callable[[dict], None],
+                 poll_interval_s: float = 0.2):
+        self._publish = publish
+        self._poll = poll_interval_s
+        self._dir = worker_log_dir()
+        self._offsets: Dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="ray_tpu::log_monitor")
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self.scan_once()
+            except Exception:
+                pass
+            self._stop.wait(self._poll)
+
+    def scan_once(self):
+        try:
+            names = os.listdir(self._dir)
+        except OSError:
+            return
+        for name in names:
+            if not (name.startswith("worker-")
+                    and (name.endswith(".out") or name.endswith(".err"))):
+                continue
+            path = os.path.join(self._dir, name)
+            off = self._offsets.get(path, 0)
+            try:
+                size = os.path.getsize(path)
+                if size <= off:
+                    continue
+                with open(path, "rb") as f:
+                    f.seek(off)
+                    chunk = f.read(size - off)
+            except OSError:
+                continue
+            # Ship only complete lines; a partial trailing line stays
+            # unconsumed until its newline arrives.
+            last_nl = chunk.rfind(b"\n")
+            if last_nl < 0:
+                continue
+            self._offsets[path] = off + last_nl + 1
+            lines = chunk[:last_nl].decode("utf-8", "replace").split("\n")
+            worker = name[len("worker-"):].rsplit(".", 1)[0]
+            self._publish({"worker": worker,
+                           "is_err": name.endswith(".err"),
+                           "lines": lines,
+                           "host_pid": os.getpid()})
+
+    def stop(self):
+        self._stop.set()
+        # The poll thread may be mid-scan; _offsets is unsynchronized,
+        # so wait it out before the final sweep (else the same chunk
+        # ships twice).
+        self._thread.join(timeout=5.0)
+        # Final sweep so lines written just before stop still ship.
+        try:
+            self.scan_once()
+        except Exception:
+            pass
+
+
+def start_local_monitor(publisher) -> LogMonitor:
+    """Monitor for the in-process cluster: publishes straight into the
+    GCS publisher (reference: log monitor -> GCS pubsub)."""
+    def publish(payload: dict):
+        publisher.publish(LOG_CHANNEL,
+                          payload["worker"].encode(), payload)
+    return LogMonitor(publish)
+
+
+# One monitor per OS process: the log dir is keyed by pid, so a second
+# tailer (multi-node in-process cluster = one WorkerPool per node) would
+# re-publish every line.  Refcounted so the first pool to shut down
+# doesn't silence the others.
+_local_lock = threading.Lock()
+_local_monitor: Optional[LogMonitor] = None
+_local_refs = 0
+
+
+def acquire_local_monitor(publisher) -> None:
+    global _local_monitor, _local_refs
+    with _local_lock:
+        if _local_monitor is None:
+            _local_monitor = start_local_monitor(publisher)
+        _local_refs += 1
+
+
+def release_local_monitor() -> None:
+    global _local_monitor, _local_refs
+    with _local_lock:
+        if _local_refs == 0:
+            return
+        _local_refs -= 1
+        if _local_refs > 0:
+            return
+        monitor, _local_monitor = _local_monitor, None
+    if monitor is not None:
+        monitor.stop()
+
+
+def mirror_worker_logs(publisher,
+                       out=None, err=None) -> int:
+    """Driver side: print every published worker log line with a
+    ``(worker=..., pid=...)`` prefix (reference worker.py
+    print_worker_logs).  Returns the subscription id."""
+
+    def cb(_key, msg):
+        try:
+            stream = (err or sys.stderr) if msg.get("is_err") \
+                else (out or sys.stdout)
+            prefix = f"(worker={msg.get('worker', '')[:8]} " \
+                     f"pid={msg.get('host_pid', '?')})"
+            for line in msg.get("lines", ()):
+                print(f"{prefix} {line}", file=stream, flush=True)
+        except Exception:
+            pass
+
+    return publisher.subscribe(LOG_CHANNEL, None, cb)

@@ -33,6 +33,40 @@ class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics: Dict[str, MetricRecord] = {}
+        # Scrape-time collectors: (weakref-to-owner, fn).  fn(owner)
+        # runs on every exposition and records via record_internal, so
+        # hot paths only bump plain counters on their own objects
+        # (reference: the metrics agent scrapes component stats
+        # periodically instead of locking on every event).
+        self._collectors: List = []
+
+    def register_collector(self, owner, fn) -> None:
+        """Call ``fn(owner)`` at every scrape while ``owner`` is alive;
+        the entry drops automatically once the owner is collected."""
+        import weakref
+        with self._lock:
+            self._collectors.append((weakref.ref(owner), fn))
+
+    def run_collectors(self) -> None:
+        with self._lock:
+            entries = list(self._collectors)
+        dead = []
+        for ref, fn in entries:
+            owner = ref()
+            if owner is None:
+                dead.append((ref, fn))
+                continue
+            try:
+                fn(owner)
+            except Exception:
+                pass
+        if dead:
+            # Remove ONLY the dead entries: a collector registered
+            # while the loop ran (concurrent init vs scrape) must not
+            # be lost to a wholesale list replacement.
+            with self._lock:
+                self._collectors = [c for c in self._collectors
+                                    if c not in dead]
 
     def register(self, name: str, mtype: str, description: str = "",
                  buckets=None) -> None:
@@ -67,6 +101,7 @@ class MetricsRegistry:
 
     # ---- Prometheus text format ----------------------------------------
     def render_prometheus(self) -> str:
+        self.run_collectors()
         out: List[str] = []
         for name, rec in sorted(self.snapshot().items()):
             pname = name.replace(".", "_")

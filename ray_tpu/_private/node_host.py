@@ -50,6 +50,20 @@ class _RemoteActorManager:
             lambda _r, _e: None)
 
 
+class _RemotePublisher:
+    """Fire-and-forget pubsub publish forwarded to the head's GCS
+    publisher (the worker-log stream rides this)."""
+
+    def __init__(self, host: "NodeHost"):
+        self._host = host
+
+    def publish(self, channel: str, key: bytes, message):
+        self._host.client.call_async(
+            "publish", {"channel": channel, "key": key,
+                        "message": message},
+            lambda _r, _e: None)
+
+
 class _RemoteGcs:
     """The slice of the GCS surface a raylet touches, over the wire."""
 
@@ -58,6 +72,7 @@ class _RemoteGcs:
         self.heartbeat_manager = _RemoteHeartbeats(host)
         self.actor_manager = _RemoteActorManager(host)
         self.kv = _RemoteKV(host)
+        self.publisher = _RemotePublisher(host)
 
     def raylet(self, node_id: NodeID):
         """Peer lookup for object pulls: every peer is reachable through

@@ -174,6 +174,21 @@ class NodeObjectStore:
         self.stats = {"spilled_bytes": 0, "restored_bytes": 0,
                       "spilled_objects": 0, "restored_objects": 0,
                       "evicted_objects": 0}
+        from ray_tpu._private.metrics_agent import (get_metrics_registry,
+                                                    record_internal)
+        nid = getattr(node_id, "hex", lambda: str(node_id))()[:12]
+
+        def _collect(store):
+            labels = {"node": nid}
+            record_internal("ray_tpu.object_store.used_bytes",
+                            store._used, **labels)
+            record_internal("ray_tpu.object_store.capacity_bytes",
+                            store.capacity, **labels)
+            record_internal("ray_tpu.object_store.num_objects",
+                            len(store._entries), **labels)
+            for k, v in store.stats.items():
+                record_internal(f"ray_tpu.object_store.{k}", v, **labels)
+        get_metrics_registry().register_collector(self, _collect)
 
     # ---- create/seal (plasma lifecycle) --------------------------------
     def put(self, object_id: ObjectID, data, pin: bool = True) -> int:

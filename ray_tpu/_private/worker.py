@@ -102,6 +102,12 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
         cluster.gcs.job_manager.add_job(w.job_id, job_config)
         w.connected = True
         w.mode = "local" if _cluster is None else "cluster"
+        if get_config().log_to_driver:
+            # print()s inside process-mode workers (local or on remote
+            # NodeHosts) surface on this terminal, reference
+            # log_to_driver behavior.
+            from ray_tpu._private.log_monitor import mirror_worker_logs
+            w.log_mirror_sub = mirror_worker_logs(cluster.gcs.publisher)
         if get_config().worker_process_mode == "process" and \
                 cluster.head_node is not None:
             # Hide OS-process spawn latency behind init (reference:

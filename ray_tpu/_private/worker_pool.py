@@ -317,11 +317,26 @@ class ProcessWorker:
             env = ctx.spawn_env(env)
         env["PYTHONPATH"] = runtime_env_mod.framework_import_root() + \
             os.pathsep + env.get("PYTHONPATH", "")
-        self._proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker_main",
-             "--host", "127.0.0.1", "--port", str(host.port),
-             "--worker-id", self.worker_id.hex()],
-            env=env)
+        # Unbuffered child stdio: prints must reach the tailed log file
+        # as they happen, not on 8KB block-buffer flushes at exit.
+        env["PYTHONUNBUFFERED"] = "1"
+        # Child stdout/stderr land in per-worker session log files; the
+        # pool's LogMonitor tails them and streams lines to the driver
+        # (reference log_monitor.py + worker stdout redirection).
+        from ray_tpu._private import log_monitor as log_monitor_mod
+        out_f, err_f = log_monitor_mod.open_worker_log_files(
+            self.worker_id.hex())
+        pool.ensure_log_monitor()
+        try:
+            self._proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.worker_main",
+                 "--host", "127.0.0.1", "--port", str(host.port),
+                 "--worker-id", self.worker_id.hex()],
+                env=env, stdout=out_f, stderr=err_f)
+        finally:
+            # The child owns its copies of the fds now.
+            out_f.close()
+            err_f.close()
         self._pump = threading.Thread(
             target=self._pump_loop, daemon=True,
             name=f"ray_tpu::pworker::{self.worker_id.hex()[:8]}")
@@ -542,6 +557,23 @@ class WorkerPool:
         self._soft_limit = cfg.num_workers_soft_limit
         self._process_mode = cfg.worker_process_mode == "process"
         self._host_service: Optional[WorkerHostService] = None
+        self._log_monitor = None
+
+    def ensure_log_monitor(self):
+        """Hold a reference on this process's (singleton) log-file
+        tailer, which streams worker log lines into the ``worker_logs``
+        pubsub channel.  No-op when no publisher is reachable."""
+        with self._lock:
+            if self._log_monitor:
+                return
+            gcs = getattr(getattr(self._node, "cluster", None), "gcs",
+                          None)
+            publisher = getattr(gcs, "publisher", None)
+            if publisher is None:
+                return
+            from ray_tpu._private import log_monitor as log_monitor_mod
+            log_monitor_mod.acquire_local_monitor(publisher)
+            self._log_monitor = True
 
     def host_service(self) -> WorkerHostService:
         with self._lock:
@@ -689,7 +721,11 @@ class WorkerPool:
         with self._lock:
             workers = list(self._all.values())
             host, self._host_service = self._host_service, None
+            monitor, self._log_monitor = self._log_monitor, None
         for w in workers:
             w.stop()
         if host is not None:
             host.stop()
+        if monitor:
+            from ray_tpu._private import log_monitor as log_monitor_mod
+            log_monitor_mod.release_local_monitor()

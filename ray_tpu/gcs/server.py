@@ -149,6 +149,19 @@ class GcsResourceManager:
         loop.schedule_every(
             cfg.gcs_resource_broadcast_period_milliseconds / 1000.0,
             self._poll_and_broadcast, "gcs.resource_sync")
+        from ray_tpu._private.metrics_agent import (get_metrics_registry,
+                                                    record_internal)
+
+        def _collect(mgr):
+            record_internal("ray_tpu.cluster.alive_nodes",
+                            len(mgr._raylets))
+            for name, v in mgr.view.total_cluster_resources().items():
+                record_internal("ray_tpu.cluster.total_resources", v,
+                                resource=name)
+            for name, v in mgr.view.available_cluster_resources().items():
+                record_internal("ray_tpu.cluster.available_resources", v,
+                                resource=name)
+        get_metrics_registry().register_collector(self, _collect)
 
     def register_raylet(self, node_id: NodeID, raylet, resources: NodeResources):
         self._raylets[node_id] = raylet

@@ -757,7 +757,7 @@ class DeviceRuntimeSolver:
     # the kernel's design envelope anyway.
     _MAX_CLASS_ROWS = 4096
 
-    def __init__(self):
+    def __init__(self, node_label: str = ""):
         self._state: Optional[dict] = None
         # scheduling_class -> demand row.  Rows grow as classes are
         # interned and are compacted by _evict_stale_classes when growth
@@ -771,6 +771,18 @@ class DeviceRuntimeSolver:
         self._accel_dev = None
         self.stats = {"ticks": 0, "full_syncs": 0, "row_deltas": 0,
                       "fallbacks": 0, "class_evictions": 0}
+        from ray_tpu._private.metrics_agent import (get_metrics_registry,
+                                                    record_internal)
+        # Label by owning node: one solver per raylet, and unlabeled
+        # series from several solvers would overwrite each other.
+        labels = {"node": node_label} if node_label else {}
+
+        def _collect(solver):
+            for k, v in solver.stats.items():
+                record_internal(f"ray_tpu.scheduler.{k}", v, **labels)
+            record_internal("ray_tpu.scheduler.interned_classes",
+                            len(solver._class_reqs), **labels)
+        get_metrics_registry().register_collector(self, _collect)
         # Probe once: without jax the device path is permanently off —
         # a failed import is NOT cached in sys.modules, so retrying it
         # every scheduling tick would rescan sys.path on the hot path.

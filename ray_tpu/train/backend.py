@@ -87,22 +87,38 @@ class JaxConfig(BackendConfig):
 
 @dataclass
 class TorchConfig(BackendConfig):
-    """CPU torch.distributed (gloo) parity backend (reference torch.py
-    wires DDP over TCP)."""
+    """torch.distributed parity backend (reference ``train/torch.py``
+    ``setup_torch_process_group``: MASTER_ADDR/PORT + init_process_group
+    over TCP).
+
+    When the workers are real OS processes (``worker_process_mode=
+    process``) this initializes an actual gloo process group across
+    them — ``torch.distributed.all_reduce`` et al. work natively inside
+    the train function, DDP included.  When workers are in-process
+    threads (the fast default) one shared torch runtime cannot host
+    multiple ranks, so gradient averaging routes through the host
+    collective plane like the jax backend.
+    """
 
     backend: str = "gloo"
     init_method: str = "tcp"
     group_name: str = "train"
+    timeout_s: float = 60.0
 
     def backend_name(self) -> str:
         return "torch"
 
     def on_start(self, worker_group: WorkerGroup):
-        # In-process workers share one torch runtime; a real process
-        # group is neither possible nor needed — gradient averaging goes
-        # through the host collective plane like the jax backend.
-        from ray_tpu.util.collective import collective
+        import os
+        import ray_tpu
         n = len(worker_group)
+        pids = worker_group.execute(os.getpid)
+        if len(set(pids)) == n and os.getpid() not in pids:
+            self._real_pg = True
+            self._setup_process_group(worker_group, n)
+            return
+        self._real_pg = False
+        from ray_tpu.util.collective import collective
         name = self.group_name
 
         def setup(rank):
@@ -111,10 +127,66 @@ class TorchConfig(BackendConfig):
             if base != name:
                 collective.set_group_alias(base, name)
             return True
-        import ray_tpu
         ray_tpu.get([
             worker_group.execute_single_async(i, setup, i)
             for i in range(n)])
+
+    def _setup_process_group(self, worker_group: WorkerGroup, n: int):
+        import ray_tpu
+
+        def master_endpoint():
+            import socket
+            # Rank 0's host serves the TCP rendezvous; port 0 picked
+            # here so the chosen port is free on THAT machine.
+            s = socket.socket()
+            s.bind(("0.0.0.0", 0))
+            port = s.getsockname()[1]
+            s.close()
+            host = socket.gethostbyname(socket.gethostname())
+            return host, port
+
+        host, port = worker_group.execute_single(0, master_endpoint)
+        backend, timeout_s = self.backend, self.timeout_s
+
+        def setup(rank):
+            import datetime
+            import torch.distributed as dist
+            if dist.is_initialized():
+                dist.destroy_process_group()
+            dist.init_process_group(
+                backend, init_method=f"tcp://{host}:{port}",
+                rank=rank, world_size=n,
+                timeout=datetime.timedelta(seconds=timeout_s))
+            return True
+
+        ray_tpu.get([
+            worker_group.execute_single_async(i, setup, i)
+            for i in range(n)])
+
+    def on_shutdown(self, worker_group: WorkerGroup):
+        if getattr(self, "_real_pg", False):
+            def teardown():
+                import torch.distributed as dist
+                if dist.is_initialized():
+                    dist.destroy_process_group()
+                return True
+            try:
+                worker_group.execute(teardown)
+            except Exception:
+                pass
+            return
+        from ray_tpu.util.collective import collective
+        name = self.group_name
+
+        def teardown():
+            try:
+                collective.destroy_collective_group(name)
+            except Exception:
+                pass
+        try:
+            worker_group.execute(teardown)
+        except Exception:
+            pass
 
 
 def _start_session_on_worker(run_id: str, fn: Callable, config: Dict,

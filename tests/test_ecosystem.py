@@ -114,3 +114,57 @@ class TestDashboard:
     def test_index_html(self, dash):
         html = self._get(dash, "/")
         assert "ray_tpu cluster" in html
+
+
+class TestDaskOnRayTpu:
+    """The dask-graph executor works on spec-conformant graphs without
+    dask installed (reference python/ray/util/dask/scheduler.py)."""
+
+    def test_simple_graph(self, ray_start_regular):
+        from operator import add, mul
+        from ray_tpu.util.dask import ray_tpu_dask_get
+        dsk = {
+            "a": 1,
+            "b": (add, "a", 2),          # 3
+            "c": (mul, "b", "b"),        # 9
+            "d": (sum, ["a", "b", "c"]),  # 13
+        }
+        assert ray_tpu_dask_get(dsk, "d") == 13
+        assert ray_tpu_dask_get(dsk, ["c", "d"]) == [9, 13]
+        assert ray_tpu_dask_get(dsk, [["a"], ["b", "c"]]) == [[1], [3, 9]]
+
+    def test_chunked_keys_and_fanout(self, ray_start_regular):
+        """Tuple chunk keys like ("x", i) — the dask array/dataframe
+        convention — plus a reduction over them."""
+        import numpy as np
+        from ray_tpu.util.dask import ray_tpu_dask_get
+
+        def make(i):
+            return np.full(4, float(i))
+
+        dsk = {("x", i): (make, i) for i in range(6)}
+        dsk["total"] = (sum, [(np.sum, ("x", i)) for i in range(6)])
+        assert ray_tpu_dask_get(dsk, "total") == sum(4.0 * i
+                                                     for i in range(6))
+
+    def test_cycle_detected(self, ray_start_regular):
+        from operator import add
+        from ray_tpu.util.dask import ray_tpu_dask_get
+        dsk = {"a": (add, "b", 1), "b": (add, "a", 1)}
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="cycle"):
+            ray_tpu_dask_get(dsk, "a")
+
+    def test_intermediates_stay_in_object_store(self, ray_start_regular):
+        """Upstream results flow to downstream tasks as object refs,
+        not through driver-side materialization: a graph whose
+        intermediates are large must not need the driver to touch them
+        (smoke: just verify correct chaining through 3 levels)."""
+        from ray_tpu.util.dask import ray_tpu_dask_get
+        import numpy as np
+        dsk = {
+            "base": (np.ones, 200_000),
+            "scaled": ((lambda a: a * 3), "base"),
+            "norm": ((lambda a: float(a.sum())), "scaled"),
+        }
+        assert ray_tpu_dask_get(dsk, "norm") == 600_000.0

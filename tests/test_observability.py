@@ -1,0 +1,168 @@
+"""Observability pipeline: worker log capture/streaming to the driver,
+and runtime metrics aggregation through the Prometheus endpoint.
+
+Reference models: ``python/ray/_private/log_monitor.py`` (worker
+stdout/stderr files tailed and published; driver mirrors lines) and the
+stats pipeline (``src/ray/stats/metric_defs.h`` exported via each
+node's metrics agent to ``/metrics``).
+"""
+
+import os
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def process_cluster():
+    ray_tpu.init(num_cpus=4, _system_config={
+        "worker_process_mode": "process",
+        "scheduler_backend": "native",
+    })
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def thread_cluster():
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+class TestWorkerLogs:
+    def test_worker_stdout_lands_in_session_files(self, process_cluster):
+        @ray_tpu.remote
+        def shout():
+            print("LOGLINE_FILE_MARKER_77")
+            return os.getpid()
+
+        pid = ray_tpu.get(shout.remote())
+        assert pid != os.getpid()
+        from ray_tpu._private.log_monitor import worker_log_dir
+        d = worker_log_dir(create=False)
+        deadline = time.monotonic() + 10
+        found = False
+        while time.monotonic() < deadline and not found:
+            for name in os.listdir(d):
+                if not name.endswith(".out"):
+                    continue
+                with open(os.path.join(d, name), "rb") as f:
+                    if b"LOGLINE_FILE_MARKER_77" in f.read():
+                        found = True
+                        break
+            time.sleep(0.1)
+        assert found, "worker stdout never reached its session log file"
+
+    def test_worker_print_mirrored_to_driver(self, process_cluster):
+        """print() inside a process worker surfaces on the driver via
+        the worker_logs pubsub channel (log_to_driver behavior)."""
+        from ray_tpu._private import log_monitor
+        from ray_tpu._private.worker import global_worker
+
+        seen = []
+        pub = global_worker().cluster.gcs.publisher
+        sub = pub.subscribe(log_monitor.LOG_CHANNEL, None,
+                            lambda _k, msg: seen.extend(msg["lines"]))
+
+        @ray_tpu.remote
+        def shout():
+            print("LOGLINE_MIRROR_MARKER_88")
+            return True
+
+        assert ray_tpu.get(shout.remote())
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if any("LOGLINE_MIRROR_MARKER_88" in ln for ln in seen):
+                break
+            time.sleep(0.1)
+        pub.unsubscribe(log_monitor.LOG_CHANNEL, None, sub)
+        assert any("LOGLINE_MIRROR_MARKER_88" in ln for ln in seen), \
+            "worker print never published on the worker_logs channel"
+
+    def test_stderr_flagged(self, process_cluster):
+        import sys
+        from ray_tpu._private import log_monitor
+        from ray_tpu._private.worker import global_worker
+
+        msgs = []
+        pub = global_worker().cluster.gcs.publisher
+        sub = pub.subscribe(log_monitor.LOG_CHANNEL, None,
+                            lambda _k, m: msgs.append(m))
+
+        @ray_tpu.remote
+        def complain():
+            print("ERRLINE_MARKER_99", file=sys.stderr)
+            return True
+
+        assert ray_tpu.get(complain.remote())
+        deadline = time.monotonic() + 10
+        hit = None
+        while time.monotonic() < deadline and hit is None:
+            for m in list(msgs):
+                if any("ERRLINE_MARKER_99" in ln for ln in m["lines"]):
+                    hit = m
+                    break
+            time.sleep(0.1)
+        pub.unsubscribe(log_monitor.LOG_CHANNEL, None, sub)
+        assert hit is not None and hit["is_err"] is True
+
+
+class TestMetricsPipeline:
+    def _scrape(self):
+        from ray_tpu._private.metrics_agent import get_metrics_registry
+        return get_metrics_registry().render_prometheus()
+
+    def test_runtime_metrics_populated(self, thread_cluster):
+        @ray_tpu.remote
+        def f(x):
+            return x + 1
+
+        ray_tpu.get([f.remote(i) for i in range(20)])
+        text = self._scrape()
+        assert "ray_tpu_core_worker_tasks_submitted" in text
+        assert "ray_tpu_cluster_alive_nodes" in text
+        assert "ray_tpu_object_store_used_bytes" in text
+        # The counters carry real values, not just registrations.  The
+        # registry is process-global, so earlier tests' (dead) workers
+        # may still expose series — judge the max across workers.
+        vals = [float(line.rsplit(" ", 1)[1])
+                for line in text.splitlines()
+                if line.startswith("ray_tpu_core_worker_tasks_submitted")]
+        assert vals and max(vals) >= 20
+
+    def test_scheduler_metrics_under_jax_backend(self):
+        ray_tpu.init(num_cpus=8)   # default backend = jax
+        try:
+            @ray_tpu.remote
+            def f():
+                return 1
+
+            ray_tpu.get([f.remote() for _ in range(8)])
+            text = self._scrape()
+            assert "ray_tpu_scheduler_ticks" in text
+        finally:
+            ray_tpu.shutdown()
+
+    def test_dashboard_metrics_route_serves_runtime_series(
+            self, thread_cluster):
+        from ray_tpu._private.worker import global_worker
+        from ray_tpu.dashboard.head import start_dashboard
+
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        ray_tpu.get(f.remote())
+        dash = start_dashboard(global_worker().cluster)
+        try:
+            with urllib.request.urlopen(dash.url + "/metrics",
+                                        timeout=10) as resp:
+                body = resp.read().decode()
+            assert "ray_tpu_cluster_alive_nodes" in body
+            assert "ray_tpu_core_worker_tasks_submitted" in body
+        finally:
+            dash.stop()

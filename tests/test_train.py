@@ -201,3 +201,55 @@ def test_concurrent_executors_do_not_cross_wire(ray_8):
     finally:
         ex_a.shutdown()
         ex_b.shutdown()
+
+
+@pytest.fixture
+def ray_process_mode():
+    ctx = ray_tpu.init(num_cpus=4, _system_config={
+        "worker_process_mode": "process",
+        "scheduler_backend": "native",
+    })
+    yield ctx
+    ray_tpu.shutdown()
+
+
+def test_torch_backend_real_process_group(ray_process_mode):
+    """With OS-process workers, TorchConfig must wire a REAL
+    torch.distributed gloo group: all_reduce works natively inside the
+    train function and each rank runs in its own process (reference
+    train/torch.py setup_torch_process_group)."""
+    def train_func():
+        import os
+        import torch
+        import torch.distributed as dist
+        assert dist.is_initialized()
+        t = torch.tensor([float(dist.get_rank() + 1)])
+        dist.all_reduce(t)       # 1 + 2 = 3 across the 2 ranks
+        return (os.getpid(), dist.get_world_size(), t.item())
+
+    from ray_tpu.train import TorchConfig
+    trainer = Trainer(backend=TorchConfig(), num_workers=2)
+    out = trainer.run(train_func)
+    pids = [o[0] for o in out]
+    assert len(set(pids)) == 2 and os.getpid() not in pids
+    assert all(o[1] == 2 for o in out)
+    assert all(o[2] == 3.0 for o in out)
+    trainer.shutdown()
+
+
+def test_torch_backend_thread_mode_fallback(ray_8):
+    """In thread mode one torch runtime can't host two ranks; the torch
+    backend must fall back to the host collective plane and still give
+    working gradient averaging."""
+    def train_func():
+        import numpy as _np
+        from ray_tpu.util.collective import collective
+        g = _np.array([float(train.world_rank() + 1)])
+        out = collective.allreduce(g, group_name="train")
+        return float(out[0])
+
+    from ray_tpu.train import TorchConfig
+    trainer = Trainer(backend=TorchConfig(), num_workers=2)
+    out = trainer.run(train_func)
+    assert out == [3.0, 3.0]
+    trainer.shutdown()
