@@ -144,6 +144,14 @@ class RemoteNodeProxy:
             "total": dict(resources),
             "load": {"queued": 0, "dispatch": 0},
         }
+        # Lease tokens this head currently holds on the node.  After a
+        # connection drop, a lease the node granted whose reply died
+        # with the old connection is held by NOBODY — on reconnect the
+        # head sends its held set and the node releases the rest
+        # (reference ReleaseUnusedWorkers, node_manager.proto:312).
+        self._held_tokens: set = set()
+        self._tokens_lock = threading.Lock()
+        self.client.on_reconnect = self._reconcile_leases
 
     # ---- GCS-facing (register / resource sync) -------------------------
     def node_info(self) -> dict:
@@ -181,6 +189,8 @@ class RemoteNodeProxy:
                 return
             token = result.pop("worker_token", None)
             if token is not None:
+                with self._tokens_lock:
+                    self._held_tokens.add(token)
                 result["worker"] = _RemoteWorkerHandle(self, token)
                 result["raylet"] = self
             reply(result)
@@ -188,11 +198,28 @@ class RemoteNodeProxy:
         self.client.call_async("request_worker_lease", spec, on_reply)
 
     def return_worker(self, worker, disconnect: bool = False):
+        token = worker.worker_id.binary()
+        # Mirror the node's own bookkeeping: a dedicated actor worker's
+        # token stays live across non-disconnect returns.
+        if disconnect or getattr(worker, "state", "") != "ACTOR":
+            with self._tokens_lock:
+                self._held_tokens.discard(token)
         self.client.call_async(
             "return_worker",
-            {"worker_token": worker.worker_id.binary(),
-             "disconnect": disconnect},
+            {"worker_token": token, "disconnect": disconnect},
             _ignore)
+
+    def _reconcile_leases(self):
+        """on_reconnect hook: tell the node which lease tokens this head
+        still holds so it can release grants whose replies were lost
+        with the previous connection."""
+        with self._tokens_lock:
+            held = list(self._held_tokens)
+        try:
+            self.client.call("reconcile_leases", {"held": held},
+                             timeout=30.0)
+        except Exception:
+            pass   # next reconnect retries
 
     # ---- placement-group 2PC (node_manager.proto:319-330) --------------
     def prepare_bundle_resources(self, pg_id, idx: int, req) -> bool:

@@ -340,6 +340,7 @@ class NodeHost:
         s.register_async("assign_actor", self._handle_assign_actor)
         s.register_async("push_actor_task", self._handle_push_actor_task)
         s.register("return_worker", self._handle_return_worker)
+        s.register("reconcile_leases", self._handle_reconcile_leases)
         s.register("update_resource_usage", self._handle_update_usage)
         s.register("get_resource_report",
                    lambda _p: self.raylet.get_resource_report())
@@ -434,6 +435,25 @@ class NodeHost:
                     self._workers[token] = worker
             self.raylet.return_worker(worker, disconnect=disconnect)
         return True
+
+    def _handle_reconcile_leases(self, payload) -> int:
+        """Release leased workers whose tokens the head does not hold
+        (grant replies lost on a dropped connection — reference
+        ReleaseUnusedWorkers, node_manager.proto:312).  A lease granted
+        concurrently with the reconcile can be swept by mistake; the
+        head's push then gets "lease token unknown" and its normal
+        retry machinery re-leases."""
+        held = set(payload.get("held", ()))
+        with self._workers_lock:
+            leaked = [(tok, w) for tok, w in self._workers.items()
+                      if tok not in held]
+            for tok, _w in leaked:
+                del self._workers[tok]
+        for _tok, worker in leaked:
+            # The reply never arrived, so no task/actor ever ran on it:
+            # hand it back to the pool for reuse.
+            self.raylet.return_worker(worker, disconnect=False)
+        return len(leaked)
 
     # ---- resources / objects ------------------------------------------
     def _handle_update_usage(self, batch) -> bool:

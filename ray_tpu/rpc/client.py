@@ -35,6 +35,12 @@ class RpcClient:
         self._pending: Dict[int, Future] = {}
         self._ids = itertools.count(1)
         self._closed = False
+        self._ever_connected = False
+        #: Optional hook fired (on its own thread) when a NEW connection
+        #: replaces a lost one — NOT on the first connect.  Peers use it
+        #: to reconcile state whose acks may have died with the old
+        #: connection (e.g. worker leases granted but never received).
+        self.on_reconnect: Optional[Callable[[], None]] = None
 
     # ---- public --------------------------------------------------------
     def call(self, method: str, payload: Any = None,
@@ -95,9 +101,18 @@ class RpcClient:
                 return self._sock
             sock = wire.connect(self.address, timeout=self._connect_timeout)
             self._sock = sock
+            reconnected = self._ever_connected
+            self._ever_connected = True
         threading.Thread(target=self._reader_loop, args=(sock,),
                          daemon=True,
                          name=f"ray_tpu::rpc::client::{self.address}").start()
+        hook = self.on_reconnect
+        if reconnected and hook is not None:
+            # Own thread: the hook typically calls back through this
+            # client from what may be a latency-sensitive caller.
+            threading.Thread(
+                target=hook, daemon=True,
+                name=f"ray_tpu::rpc::reconnect::{self.address}").start()
         return sock
 
     def _reader_loop(self, sock):

@@ -113,6 +113,12 @@ class RpcServer:
     def _reader_loop(self, conn: socket.socket):
         write_lock = threading.Lock()
         try:
+            try:
+                wire.expect_preamble(conn)
+            except wire.WireVersionMismatch:
+                return   # wrong-version (or non-ray_tpu) peer: drop it
+            except (wire.ConnectionClosed, OSError, EOFError):
+                return
             while not self._stopped.is_set():
                 try:
                     msg_id, method, payload = wire.recv_msg(conn)

@@ -30,12 +30,42 @@ from typing import Any, Tuple
 _LEN = struct.Struct("!I")
 # One frame must hold the largest single object transfer; the reference
 # chunks at 5 MiB but its pull manager reassembles up to object-store
-# capacity.  1 GiB is a sanity bound, not a design limit.
+# capacity.  1 GiB is a sanity bound, not a design limit.  (Bulk object
+# data rides the chunked plane — rpc/chunked.py — in 5 MiB frames.)
 MAX_FRAME = 1 << 30
+
+# Versioned connection preamble (reference: gRPC protocol negotiation /
+# the RayConfig version handshake): every client opens with
+# MAGIC+version, and the server rejects a mismatched peer with a clear
+# error instead of a pickle explosion mid-stream.
+WIRE_MAGIC = b"RTPU"
+WIRE_VERSION = 1
+_PREAMBLE = struct.Struct("!4sH")
 
 
 class ConnectionClosed(Exception):
     pass
+
+
+class WireVersionMismatch(ConnectionClosed):
+    pass
+
+
+def send_preamble(sock: socket.socket) -> None:
+    sock.sendall(_PREAMBLE.pack(WIRE_MAGIC, WIRE_VERSION))
+
+
+def expect_preamble(sock: socket.socket) -> None:
+    """Server side: validate the client's opening preamble."""
+    raw = _recv_exact(sock, _PREAMBLE.size)
+    magic, version = _PREAMBLE.unpack(raw)
+    if magic != WIRE_MAGIC:
+        raise WireVersionMismatch(
+            f"bad wire magic {magic!r} (not a ray_tpu peer?)")
+    if version != WIRE_VERSION:
+        raise WireVersionMismatch(
+            f"wire protocol version mismatch: peer={version} "
+            f"local={WIRE_VERSION}")
 
 
 def send_msg(sock: socket.socket, obj: Any, lock=None) -> None:
@@ -75,4 +105,5 @@ def connect(address: Tuple[str, int], timeout: float = 10.0
     sock = socket.create_connection(address, timeout=timeout)
     sock.settimeout(None)
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    send_preamble(sock)
     return sock

@@ -264,3 +264,43 @@ class TestNestedRemoteInProcessWorkers:
         total, n = ray_tpu.get(consume.remote(), timeout=180)
         assert n == (8 * 1024 * 1024) // 8
         assert total == float(n)
+
+
+class TestWireVersioning:
+    def test_preamble_negotiation_and_mismatch_rejected(self):
+        """Connections open with a MAGIC+version preamble; a peer
+        speaking the wrong version (or not the protocol at all) is
+        dropped before any message parsing."""
+        import socket as socket_mod
+        import struct
+
+        from ray_tpu.rpc import RpcClient, RpcServer, wire
+
+        server = RpcServer(name="verstest")
+        server.register("echo", lambda p: p)
+        try:
+            # Correct version: normal operation.
+            client = RpcClient(server.address)
+            assert client.call("echo", 7, timeout=10) == 7
+            client.close()
+
+            # Wrong version: server closes the connection; the call
+            # never completes.
+            raw = socket_mod.create_connection(server.address, timeout=5)
+            raw.sendall(struct.Struct("!4sH").pack(wire.WIRE_MAGIC, 999))
+            wire.send_msg(raw, (1, "echo", "x"))
+            raw.settimeout(5)
+            import pytest as _pytest
+            with _pytest.raises((wire.ConnectionClosed, OSError)):
+                wire.recv_msg(raw)
+            raw.close()
+
+            # Garbage magic: also dropped.
+            raw2 = socket_mod.create_connection(server.address, timeout=5)
+            raw2.sendall(b"GET / HTTP/1.1\r\n\r\n")
+            raw2.settimeout(5)
+            with _pytest.raises((wire.ConnectionClosed, OSError)):
+                wire.recv_msg(raw2)
+            raw2.close()
+        finally:
+            server.stop()
