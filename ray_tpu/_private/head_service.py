@@ -9,10 +9,13 @@ to a remote raylet exactly as they talk to an in-process one, through a
 ``RemoteNodeProxy`` that forwards every Raylet surface over the node's
 framed-RPC connection.
 
-Topology is hub-and-spoke v1: worker-host processes (``node_host.py``)
-connect to this one server; peer object fetches relay through the head
-(the reference pulls peer-to-peer over ObjectManagerService — that is the
-next refinement, not a different protocol).
+Control plane is hub-and-spoke (every node registers with and
+heartbeats this one server); the OBJECT plane is peer-to-peer: the
+directory answers location queries with dialable node addresses and
+peers pull chunked bytes directly from each other
+(``object_manager.proto:61`` ObjectManagerService parity).  The head
+relays object bytes only for ray-client drivers, whose sole connection
+is the head.
 """
 
 from __future__ import annotations
@@ -136,6 +139,7 @@ class RemoteNodeProxy:
         self.node_id = node_id
         self.node_name = node_name
         self.local_resources = NodeResources(resources, labels=labels)
+        self.address = tuple(address)    # peers dial this directly
         self.client = RpcClient(tuple(address))
         self.object_store = _ProxyObjectStore(self)
         self.is_remote_proxy = True
@@ -268,6 +272,10 @@ class HeadService:
         self._lock = threading.Lock()
         self._proxies: Dict[NodeID, RemoteNodeProxy] = {}
         self._reg_tokens: Dict[str, NodeID] = {}
+        # Object bytes relayed head-through for a peer that could have
+        # pulled directly.  The peer-to-peer plane keeps this at zero in
+        # steady state; tests assert on it.
+        self.relay_fetches = 0
         self.server = RpcServer(port=port, name="head")
         s = self.server
         s.register("register_node", self._handle_register_node)
@@ -280,6 +288,7 @@ class HeadService:
         s.register("put_inline", self._handle_put_inline)
         s.register("add_location", self._handle_add_location)
         s.register("get_locations", self._handle_get_locations)
+        s.register("get_node_address", self._handle_get_node_address)
         s.register_async("wait_object", self._handle_wait_object)
         s.register("publish", self._handle_publish)
         s.register("ping", lambda _p: "pong")
@@ -399,7 +408,10 @@ class HeadService:
         blob = self._owner_inline_blob(oid)
         if blob is not None:
             return blob
-        # Hub relay: the bytes live on some other registered node.
+        # Fallback relay: peers normally pull node-to-node directly
+        # (the directory hands them dialable addresses); this path only
+        # serves ray-client drivers — whose sole connection is the head —
+        # and peers whose direct dial failed.
         head_id = head.node_id if head is not None else None
         for node_id in self._cluster.object_directory.get_locations(oid):
             if node_id == head_id:
@@ -409,6 +421,7 @@ class HeadService:
                 continue
             serialized = raylet.object_store.get_serialized(oid)
             if serialized is not None:
+                self.relay_fetches += 1
                 return serialized.to_bytes()
         return None
 
@@ -430,9 +443,28 @@ class HeadService:
                 except Exception:
                     return ("error", pickle.dumps(
                         exceptions.RayTpuError(str(entry.error))))
-        blob = self._handle_fetch_object(payload)
-        if blob is None:
-            return None
+        head = self._cluster.head_node
+        if head is not None:
+            serialized = head.object_store.get_serialized(oid)
+            if serialized is not None:
+                return self._value_reply(serialized.to_bytes())
+        blob = self._owner_inline_blob(oid)
+        if blob is not None:
+            return self._value_reply(blob)
+        # Bytes live on some other registered node: redirect the caller
+        # to pull peer-to-peer instead of relaying head-through.
+        head_id = head.node_id if head is not None else None
+        for node_id in self._cluster.object_directory.get_locations(oid):
+            if node_id == head_id:
+                continue
+            proxy = self._proxy_for(node_id)
+            if proxy is not None:
+                return ("remote", {"node_id": node_id.binary(),
+                                   "host": proxy.address[0],
+                                   "port": proxy.address[1]})
+        return None
+
+    def _value_reply(self, blob: bytes):
         from ray_tpu._private.config import get_config
         if len(blob) > get_config().object_manager_chunk_size:
             # Hand back a session over the bytes we already hold —
@@ -441,6 +473,10 @@ class HeadService:
             meta = self.chunk_server.open_session(blob)
             return ("chunked", meta)   # meta None -> caller retries
         return ("ok", blob)
+
+    def _proxy_for(self, node_id: NodeID) -> Optional[RemoteNodeProxy]:
+        with self._lock:
+            return self._proxies.get(node_id)
 
     def _handle_put_inline(self, payload) -> bool:
         core = self._cluster.core_worker
@@ -457,13 +493,34 @@ class HeadService:
         return True
 
     def _handle_get_locations(self, payload):
+        """Locations WITH dialable addresses: peers use these to pull
+        node-to-node directly (OwnershipBasedObjectDirectory parity —
+        the directory answer is what makes the plane peer-to-peer).
+        Head-resident copies carry host=None: the asking spoke already
+        holds a head connection."""
         oid = ObjectID(payload["object_id"])
-        locs = {n.binary()
-                for n in self._cluster.object_directory.get_locations(oid)}
-        if self._owner_inline_blob(oid) is not None and \
-                self._cluster.head_node is not None:
-            locs.add(self._cluster.head_node.node_id.binary())
-        return list(locs)
+        out = []
+        seen = set()
+        for node_id in self._cluster.object_directory.get_locations(oid):
+            entry = {"node_id": node_id.binary(), "host": None,
+                     "port": None}
+            proxy = self._proxy_for(node_id)
+            if proxy is not None:
+                entry["host"], entry["port"] = proxy.address
+            out.append(entry)
+            seen.add(node_id.binary())
+        head = self._cluster.head_node
+        if head is not None and head.node_id.binary() not in seen and \
+                self._owner_inline_blob(oid) is not None:
+            out.append({"node_id": head.node_id.binary(),
+                        "host": None, "port": None})
+        return out
+
+    def _handle_get_node_address(self, payload):
+        """node_id -> (host, port) a peer can dial, or None for the head
+        node / unknown nodes (callers fall back to their head link)."""
+        proxy = self._proxy_for(NodeID(payload["node_id"]))
+        return None if proxy is None else list(proxy.address)
 
     def _handle_wait_object(self, payload, reply):
         """Block (server-side, event-driven) until the object has a
@@ -477,7 +534,7 @@ class HeadService:
         done = threading.Event()
         state: Dict = {}
 
-        def finish(node_bin):
+        def finish(node_id):
             if done.is_set():
                 return
             done.set()
@@ -489,18 +546,26 @@ class HeadService:
             core = self._cluster.core_worker
             if mem_cb is not None and core is not None:
                 core.memory_store.cancel_get_async(oid, mem_cb)
-            reply(node_bin)
+            if node_id is None:
+                reply(None)
+                return
+            entry = {"node_id": node_id.binary(), "host": None,
+                     "port": None}
+            proxy = self._proxy_for(node_id)
+            if proxy is not None:
+                entry["host"], entry["port"] = proxy.address
+            reply(entry)
 
         def on_location(node_id):
-            finish(node_id.binary() if node_id is not None else None)
+            finish(node_id)
 
         if self._owner_inline_blob(oid) is not None and head is not None:
-            finish(head.node_id.binary())
+            finish(head.node_id)
             return
         directory.subscribe_location(oid, on_location)
         core = self._cluster.core_worker
         if core is not None and head is not None:
-            mem_cb = lambda _entry: finish(head.node_id.binary())  # noqa: E731
+            mem_cb = lambda _entry: finish(head.node_id)  # noqa: E731
             state["mem_cb"] = mem_cb
             core.memory_store.get_async(oid, mem_cb)
         if not done.is_set():

@@ -10,12 +10,14 @@ NodeManager in one daemon) registering with the GCS over gRPC
 
 Design: the REAL in-process ``Raylet`` runs here unchanged — scheduler
 queues, worker pool, object store, dependency manager.  What differs is
-the *cluster adapter* handed to it: instead of direct method calls into
-a same-process GCS/directory/core-worker, every surface forwards over
-one RpcClient to the head process (hub-and-spoke v1; the reference pulls
-peer-to-peer).  The head mirrors this node as a ``RemoteNodeProxy``
-(head_service.py) that duck-types Raylet for the GCS and the driver-side
-submitters, so neither side's runtime code knows the wire exists.
+the *cluster adapter* handed to it: control-plane surfaces forward over
+one RpcClient to the head process, while OBJECT pulls dial peer
+node-hosts directly (``PeerPool``) using addresses the head's directory
+hands out — node-to-node chunked transfer exactly like the reference's
+ObjectManagerService, with the head relay kept only as a fallback.  The
+head mirrors this node as a ``RemoteNodeProxy`` (head_service.py) that
+duck-types Raylet for the GCS and the driver-side submitters, so
+neither side's runtime code knows the wire exists.
 """
 
 from __future__ import annotations
@@ -97,6 +99,12 @@ class _RemoteKV:
 
 
 class _PeerStoreReader:
+    """Reads a peer node's store.  Pulls are peer-to-peer: dial the peer
+    directly (address from the head's directory, ``PeerPool``) and pull
+    chunked from its chunk server; the head link is only the fallback
+    for peers we cannot resolve or dial (ObjectManagerService pull
+    parity, ``object_manager.proto:61``)."""
+
     def __init__(self, host: "NodeHost", node_id: NodeID):
         self._host = host
         self._node_id = node_id
@@ -104,6 +112,15 @@ class _PeerStoreReader:
     def get_serialized(self, object_id: ObjectID
                        ) -> Optional[SerializedObject]:
         from ray_tpu.rpc.chunked import fetch_chunked
+        peer = self._host.peers.client_for(self._node_id)
+        if peer is not None:
+            try:
+                blob = fetch_chunked(peer, object_id.binary(),
+                                     timeout=300.0)
+                if blob is not None:
+                    return SerializedObject.from_bytes(blob)
+            except Exception:
+                self._host.peers.drop(self._node_id)
         blob = fetch_chunked(self._host.client, object_id.binary(),
                              timeout=300.0)
         return None if blob is None else SerializedObject.from_bytes(blob)
@@ -113,6 +130,80 @@ class _PeerStoreReader:
 
     def delete(self, object_id: ObjectID):
         pass
+
+
+class PeerPool:
+    """Cache of direct connections to peer node-hosts, keyed by node id.
+
+    Addresses come from directory answers (``get_locations`` /
+    ``wait_object`` entries carry host:port) or an explicit head lookup
+    (``get_node_address``).  One RpcClient per peer, created lazily,
+    dropped on transfer failure so a restarted peer re-dials cleanly
+    (reference: ObjectManager's connection pool per remote node)."""
+
+    def __init__(self, host: "NodeHost"):
+        self._host = host
+        self._lock = threading.Lock()
+        self._addrs: Dict[NodeID, tuple] = {}
+        self._clients: Dict[NodeID, RpcClient] = {}
+
+    def note_address(self, node_id: NodeID, host_addr, port):
+        if host_addr is None or port is None:
+            return
+        with self._lock:
+            self._addrs[node_id] = (host_addr, int(port))
+
+    def client_for(self, node_id: NodeID) -> Optional[RpcClient]:
+        """Direct client to a peer, or None when the target is the head
+        / unknown (caller uses the head link)."""
+        with self._lock:
+            client = self._clients.get(node_id)
+            if client is not None:
+                return client
+            addr = self._addrs.get(node_id)
+        if addr is None:
+            try:
+                reply = self._host.client.call(
+                    "get_node_address", {"node_id": node_id.binary()},
+                    timeout=10.0)
+            except Exception:
+                return None
+            if reply is None:
+                return None
+            addr = (reply[0], int(reply[1]))
+            with self._lock:
+                self._addrs[node_id] = addr
+        if addr == self._host.server.address:
+            return None     # self-dial: bytes are local, not a pull
+        try:
+            client = RpcClient(addr)
+        except Exception:
+            return None
+        with self._lock:
+            existing = self._clients.get(node_id)
+            if existing is not None:
+                close_me, client = client, existing
+            else:
+                self._clients[node_id] = client
+                close_me = None
+        if close_me is not None:
+            close_me.close()
+        return client
+
+    def drop(self, node_id: NodeID):
+        with self._lock:
+            self._addrs.pop(node_id, None)
+            client = self._clients.pop(node_id, None)
+        if client is not None:
+            client.close()
+
+    def close_all(self):
+        with self._lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+            self._addrs.clear()
+        for c in clients:
+            c.close()
 
 
 class _PeerFetchProxy:
@@ -150,7 +241,13 @@ class _RemoteDirectory:
                 timeout=10.0)
         except Exception:
             return set()
-        return {NodeID(b) for b in locs}
+        out = set()
+        for entry in locs:
+            node_id = NodeID(entry["node_id"])
+            self._host.peers.note_address(
+                node_id, entry.get("host"), entry.get("port"))
+            out.add(node_id)
+        return out
 
     def subscribe_location(self, object_id: ObjectID, cb: Callable):
         """One async ``wait_object`` call: the head blocks event-driven
@@ -164,7 +261,10 @@ class _RemoteDirectory:
             if err is not None or result is None:
                 cb(None)     # timed out / head gone -> failed pull
             else:
-                cb(NodeID(result))
+                node_id = NodeID(result["node_id"])
+                self._host.peers.note_address(
+                    node_id, result.get("host"), result.get("port"))
+                cb(node_id)
 
         self._host.client.call_async(
             "wait_object",
@@ -222,6 +322,17 @@ class _RemoteCoreWorker:
                 kind, blob = result
                 if kind == "error":
                     raise pickle.loads(blob)
+                if kind == "remote":
+                    # Owner redirect: pull the bytes peer-to-peer.
+                    peer_id = NodeID(blob["node_id"])
+                    self._host.peers.note_address(
+                        peer_id, blob.get("host"), blob.get("port"))
+                    reader = _PeerStoreReader(self._host, peer_id)
+                    serialized = reader.get_serialized(object_id)
+                    if serialized is None:
+                        raise exceptions.ObjectLostError(
+                            object_id, "peer arg fetch failed")
+                    return deserialize(serialized)
                 if kind == "chunked":
                     from ray_tpu.rpc.chunked import (
                         fetch_chunked, fetch_session)
@@ -321,6 +432,7 @@ class NodeHost:
         from ray_tpu._private.raylet import Raylet
         self.stopped = False
         self.client = RpcClient(tuple(head_address))
+        self.peers = PeerPool(self)
         self.adapter = _RemoteClusterAdapter(self)
         store_bytes = resources.get("object_store_memory")
         self.raylet = Raylet(
@@ -500,6 +612,7 @@ class NodeHost:
             self.raylet.shutdown()
         except Exception:
             pass
+        self.peers.close_all()
         self.server.stop()
         self.client.close()
 
