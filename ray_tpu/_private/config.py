@@ -42,6 +42,10 @@ class Config:
     #: GCS-side actor scheduling (ray_config_def.h:463).
     gcs_actor_scheduling_enabled: bool = False
 
+    #: Reconnect-reconcile sweep exempts lease grants younger than this
+    #: (their grant reply may legitimately still be in flight).
+    lease_reconcile_grace_s: float = 5.0
+
     # ------ failure detection (ray_config_def.h:51-55) ------
     raylet_heartbeat_period_milliseconds: int = 100
     num_heartbeats_timeout: int = 30

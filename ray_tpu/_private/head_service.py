@@ -85,10 +85,10 @@ class _RemoteWorkerHandle:
         self._push("push_actor_task", spec, on_done)
 
     def kill_actor(self):
-        self._proxy.client.call_async(
-            "return_worker",
-            {"worker_token": self.worker_id.binary(), "disconnect": True},
-            _ignore)
+        # Route through the proxy so the lease token leaves
+        # _held_tokens — a direct return_worker send would leak it into
+        # every future reconcile payload.
+        self._proxy.return_worker(self, disconnect=True)
 
     def stop(self):
         self.kill_actor()
@@ -216,7 +216,22 @@ class RemoteNodeProxy:
     def _reconcile_leases(self):
         """on_reconnect hook: tell the node which lease tokens this head
         still holds so it can release grants whose replies were lost
-        with the previous connection."""
+        with the previous connection.
+
+        The node exempts grants younger than its grace window (their
+        reply may be in flight on the new connection) — but the lost
+        grant this hook exists for is usually itself younger than the
+        window at reconnect time, so one sweep is not enough: schedule
+        a follow-up after the window has passed, when every genuinely
+        leaked token has aged into sweepable range."""
+        self._send_reconcile()
+        from ray_tpu._private.config import get_config
+        delay = get_config().lease_reconcile_grace_s * 1.5 + 0.1
+        timer = threading.Timer(delay, self._send_reconcile)
+        timer.daemon = True
+        timer.start()
+
+    def _send_reconcile(self):
         with self._tokens_lock:
             held = list(self._held_tokens)
         try:

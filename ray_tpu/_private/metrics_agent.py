@@ -39,27 +39,37 @@ class MetricsRegistry:
         # (reference: the metrics agent scrapes component stats
         # periodically instead of locking on every event).
         self._collectors: List = []
+        # While a collector fn runs, every series it writes is recorded
+        # here (thread-local) so the series can be deleted when the
+        # owner dies — otherwise per-worker label cardinality grows
+        # without bound under worker churn.
+        self._tracking = threading.local()
 
     def register_collector(self, owner, fn) -> None:
         """Call ``fn(owner)`` at every scrape while ``owner`` is alive;
-        the entry drops automatically once the owner is collected."""
+        the entry — and every series it wrote — drops automatically
+        once the owner is collected."""
         import weakref
         with self._lock:
-            self._collectors.append((weakref.ref(owner), fn))
+            self._collectors.append((weakref.ref(owner), fn, set()))
 
     def run_collectors(self) -> None:
         with self._lock:
             entries = list(self._collectors)
         dead = []
-        for ref, fn in entries:
+        for entry in entries:
+            ref, fn, written = entry
             owner = ref()
             if owner is None:
-                dead.append((ref, fn))
+                dead.append(entry)
                 continue
+            self._tracking.keys = written
             try:
                 fn(owner)
             except Exception:
                 pass
+            finally:
+                self._tracking.keys = None
         if dead:
             # Remove ONLY the dead entries: a collector registered
             # while the loop ran (concurrent init vs scrape) must not
@@ -67,6 +77,16 @@ class MetricsRegistry:
             with self._lock:
                 self._collectors = [c for c in self._collectors
                                     if c not in dead]
+                for _ref, _fn, written in dead:
+                    for name, labels in written:
+                        rec = self._metrics.get(name)
+                        if rec is not None:
+                            rec.series.pop(labels, None)
+
+    def _note_write(self, name: str, labels: _LabelKey) -> None:
+        sink = getattr(self._tracking, "keys", None)
+        if sink is not None:
+            sink.add((name, labels))
 
     def register(self, name: str, mtype: str, description: str = "",
                  buckets=None) -> None:
@@ -75,15 +95,18 @@ class MetricsRegistry:
                 self._metrics[name] = MetricRecord(mtype, description, buckets)
 
     def inc(self, name: str, value: float, labels: _LabelKey) -> None:
+        self._note_write(name, labels)
         with self._lock:
             rec = self._metrics[name]
             rec.series[labels] = rec.series.get(labels, 0.0) + value
 
     def set(self, name: str, value: float, labels: _LabelKey) -> None:
+        self._note_write(name, labels)
         with self._lock:
             self._metrics[name].series[labels] = value
 
     def observe(self, name: str, value: float, labels: _LabelKey) -> None:
+        self._note_write(name, labels)
         with self._lock:
             rec = self._metrics[name]
             rec.series.setdefault(labels, []).append(value)

@@ -442,6 +442,7 @@ class NodeHost:
         self.raylet.core_worker = self.core_shim
         self.adapter.core_worker = self.core_shim
         self._workers: Dict[bytes, object] = {}   # lease token -> Worker
+        self._grant_times: Dict[bytes, float] = {}
         self._workers_lock = threading.Lock()
 
         self.server = RpcServer(
@@ -486,9 +487,11 @@ class NodeHost:
             worker = result.pop("worker", None)
             result.pop("raylet", None)
             if worker is not None:
+                import time
                 token = worker.worker_id.binary()
                 with self._workers_lock:
                     self._workers[token] = worker
+                    self._grant_times[token] = time.monotonic()
                 result["worker_token"] = token
                 result["node_id"] = self.raylet.node_id.binary()
             reply(result)
@@ -540,6 +543,7 @@ class NodeHost:
         disconnect = payload.get("disconnect", False)
         with self._workers_lock:
             worker = self._workers.pop(token, None)
+            self._grant_times.pop(token, None)
         if worker is not None:
             if worker.state == "ACTOR" and not disconnect:
                 # Dedicated actor workers keep their lease token alive.
@@ -551,20 +555,36 @@ class NodeHost:
     def _handle_reconcile_leases(self, payload) -> int:
         """Release leased workers whose tokens the head does not hold
         (grant replies lost on a dropped connection — reference
-        ReleaseUnusedWorkers, node_manager.proto:312).  A lease granted
-        concurrently with the reconcile can be swept by mistake; the
-        head's push then gets "lease token unknown" and its normal
-        retry machinery re-leases."""
+        ReleaseUnusedWorkers, node_manager.proto:312).  Fresh grants are
+        exempt (RECONCILE_GRACE_S) — sweeping a grant whose reply is
+        concurrently in flight would strand the lease the head is about
+        to use; actors are additionally protected by the actor
+        manager's creation retry on WorkerCrashedError."""
+        import time
+
+        from ray_tpu._private.config import get_config
         held = set(payload.get("held", ()))
+        # Grants younger than the grace window are exempt: their reply
+        # may still be in flight, so the head legitimately not holding
+        # them yet does not mean the reply was lost.  A genuinely
+        # leaked token ages past the window and the next reconcile
+        # (heads reconcile on every reconnect) sweeps it.
+        cutoff = time.monotonic() - get_config().lease_reconcile_grace_s
         with self._workers_lock:
             leaked = [(tok, w) for tok, w in self._workers.items()
-                      if tok not in held]
+                      if tok not in held and
+                      self._grant_times.get(tok, 0.0) < cutoff]
             for tok, _w in leaked:
                 del self._workers[tok]
+                self._grant_times.pop(tok, None)
         for _tok, worker in leaked:
-            # The reply never arrived, so no task/actor ever ran on it:
-            # hand it back to the pool for reuse.
-            self.raylet.return_worker(worker, disconnect=False)
+            # An idle grant never ran anything: back to the pool.  A
+            # worker in ACTOR state DID run a creation (the reply was
+            # lost) — destroy it, or a ghost instance would survive in
+            # the pool; the owner's creation retry makes a fresh one.
+            self.raylet.return_worker(
+                worker,
+                disconnect=getattr(worker, "state", "") == "ACTOR")
         return len(leaked)
 
     # ---- resources / objects ------------------------------------------

@@ -41,6 +41,7 @@ class GcsActor:
         self.namespace = namespace
         self.max_restarts = max_restarts
         self.num_restarts = 0
+        self.creation_retries = 0
         self.detached = detached
         self.state = ActorState.DEPENDENCIES_UNREADY
         self.node_id: Optional[NodeID] = None
@@ -59,6 +60,12 @@ class GcsActor:
             "death_cause": self.death_cause,
             "class_name": getattr(self.creation_spec, "function_name", ""),
         }
+
+
+# Creation retries (lease lost before the actor ran) are cheap and
+# outside max_restarts, but must terminate: a ctor that reliably
+# crashes its worker would otherwise hot-loop forever.
+_MAX_CREATION_RETRIES = 20
 
 
 class GcsActorManager:
@@ -131,6 +138,30 @@ class GcsActorManager:
 
         raylet.request_worker_lease(spec, on_lease)
 
+    def _retry_schedule(self, actor: GcsActor, ready_cb):
+        """Re-enter scheduling from an event-loop callback.  _schedule
+        raises when the cluster has no nodes; inside the loop that
+        would be swallowed and strand the actor PENDING forever, so
+        convert it into a DEAD transition that unblocks waiters."""
+        try:
+            self._schedule(actor, ready_cb)
+        except Exception as e:      # noqa: BLE001
+            self._creation_failed(actor, f"creation failed: {e}", ready_cb)
+
+    def _creation_failed(self, actor: GcsActor, cause: str, ready_cb):
+        with self._lock:
+            if actor.state == ActorState.DEAD:
+                return
+            actor.state = ActorState.DEAD
+            actor.death_cause = cause
+            actor.worker = None
+            if actor.name:
+                self._named.pop((actor.namespace, actor.name), None)
+            self._persist(actor)
+        self._publish(actor)
+        if ready_cb:
+            ready_cb(actor, exceptions.ActorError(reason=cause))
+
     def _on_actor_created(self, actor: GcsActor, worker, ready_cb):
         with self._lock:
             actor.worker = worker
@@ -138,12 +169,43 @@ class GcsActorManager:
         # Push the creation task to the leased worker; the worker becomes
         # dedicated to this actor (CoreWorkerService.PushTask parity).
         def on_done(error):
+            if isinstance(error, exceptions.WorkerCrashedError):
+                # The lease evaporated around creation (worker crash,
+                # connection loss, or a reconnect-reconcile sweeping a
+                # fresh grant).  Retry scheduling instead of declaring
+                # DEAD — but the error is ambiguous (assign_actor may
+                # have been DELIVERED and only its reply lost), so
+                # first best-effort kill the old worker: that discards
+                # the head-held token and destroys any instance whose
+                # ctor did run, keeping at most one live copy.
+                with self._lock:
+                    if actor.state == ActorState.DEAD:
+                        return
+                    old_worker, actor.worker = actor.worker, None
+                    actor.creation_retries += 1
+                    attempt = actor.creation_retries
+                if old_worker is not None:
+                    try:
+                        old_worker.kill_actor()
+                    except Exception:
+                        pass
+                if attempt > _MAX_CREATION_RETRIES:
+                    self._creation_failed(
+                        actor, f"creation failed after {attempt} "
+                               f"lease losses: {error}", ready_cb)
+                    return
+                delay = min(2.0, 0.05 * (2 ** min(attempt, 6)))
+                self._gcs.loop.schedule_after(
+                    delay, lambda: self._retry_schedule(actor, ready_cb),
+                    "actor.recreate")
+                return
             with self._lock:
                 if error is not None:
                     actor.state = ActorState.DEAD
                     actor.death_cause = f"creation failed: {error}"
                 else:
                     actor.state = ActorState.ALIVE
+                    actor.creation_retries = 0
                 self._persist(actor)
             self._publish(actor)
             if ready_cb:

@@ -236,21 +236,42 @@ class ServeController:
         self._cv.notify_all()
 
     # ---- reconciliation ------------------------------------------------
-    def _target_replicas(self, info: DeploymentInfo) -> int:
+    def _probe_inflight(self) -> Dict[str, Optional[int]]:
+        """Queue-depth probes for autoscaled deployments, issued OUTSIDE
+        ``self._lock`` — a slow replica must stall only the reconcile
+        loop, never deploy/get_deployment_info/long-poll entry points.
+        None = probe failed (caller keeps the current replica count)."""
+        with self._lock:
+            targets = {
+                name: [r.handle for r in self._replicas.get(name, [])]
+                for name, info in self._deployments.items()
+                if info.autoscaling_config}
+        # All probes issued up front against ONE shared deadline, so N
+        # deployments with hung replicas cost one timeout, not N
+        # (same shape as _maybe_health_check).
+        futures = {name: [h.get_num_inflight.remote() for h in handles]
+                   for name, handles in targets.items() if handles}
+        deadline = time.monotonic() + 5.0
+        out: Dict[str, Optional[int]] = {}
+        for name, futs in futures.items():
+            try:
+                out[name] = sum(ray_tpu.get(
+                    futs, timeout=max(0.1, deadline - time.monotonic())))
+            except Exception:
+                out[name] = None
+        return out
+
+    def _target_replicas(self, info: DeploymentInfo,
+                         probed: Optional[int] = None) -> int:
         cfg = info.autoscaling_config
         if not cfg:
             return info.num_replicas
-        handles = [r.handle for r in self._replicas.get(info.name, [])]
-        if not handles:
+        n_current = len(self._replicas.get(info.name, []))
+        if not n_current:
             return max(1, cfg.get("min_replicas", 1))
-        try:
-            # Bounded: this runs under self._lock — an untimed get on a
-            # hung replica would freeze every controller entry point.
-            inflight = sum(ray_tpu.get(
-                [h.get_num_inflight.remote() for h in handles],
-                timeout=5.0))
-        except Exception:
-            return len(handles)
+        if probed is None:
+            return n_current      # probe failed: hold steady
+        inflight = probed
         target_per = cfg.get("target_num_ongoing_requests_per_replica", 1)
         want = math.ceil(inflight / max(target_per, 1e-9)) if inflight \
             else cfg.get("min_replicas", 1)
@@ -348,6 +369,7 @@ class ServeController:
             self._reconcile_locked()
 
     def _reconcile_locked(self):
+        probes = self._probe_inflight()    # blocking gets, lock NOT held
         with self._lock:
             if self._shutdown:
                 return
@@ -356,7 +378,7 @@ class ServeController:
             retire: List[_Replica] = []
             for name, info in self._deployments.items():
                 reps = self._replicas.setdefault(name, [])
-                want = self._target_replicas(info)
+                want = self._target_replicas(info, probes.get(name))
                 old = [r for r in reps if r.version != info.version]
                 if len(reps) < want:
                     scale_up.append((name, info, want - len(reps)))

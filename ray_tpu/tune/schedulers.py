@@ -161,7 +161,16 @@ class HyperBandScheduler(TrialScheduler):
     each bracket waits (PAUSE) for all members to reach the current
     milestone, keeps the top 1/eta, and multiplies the milestone by eta.
     Unlike ASHA the halving decision sees the whole cohort, so stragglers
-    are held at the rung instead of racing ahead."""
+    are held at the rung instead of racing ahead.
+
+    Pausing stops the trial's actor; survivors resume from
+    ``trial.checkpoint``.  ``Trainable`` subclasses checkpoint every
+    step automatically, so resumption is free.  FUNCTION trainables must
+    call ``tune.save_checkpoint(...)`` (and restore via
+    ``tune.load_checkpoint()``) to resume from the rung — otherwise a
+    paused survivor re-runs from iteration 1 (correct result, duplicated
+    compute, and regressed ``training_iteration`` values re-reported to
+    the searcher)."""
 
     def __init__(self, metric: str = "score", mode: str = "max",
                  time_attr: str = "training_iteration",

@@ -27,6 +27,9 @@ _WIRE_CONFIG = {
     "raylet_heartbeat_period_milliseconds": 50,
     "num_heartbeats_timeout": 20,
     "gcs_resource_broadcast_period_milliseconds": 50,
+    # Short sweep grace so the leaked-lease test can age a grant past
+    # it without a 5 s sleep.
+    "lease_reconcile_grace_s": 0.8,
 }
 
 
@@ -309,7 +312,19 @@ class TestLeaseReconciliation:
         assert result.get("worker_token"), f"lease not granted: {result}"
         leaked_token = result["worker_token"]
 
-        # The head holds no token for it; reconcile must release it.
+        # The head holds no token for it; reconcile must release it —
+        # but only after the grant ages past the sweep grace window
+        # (a FRESH grant is exempt: its reply may still be in flight).
+        import pickle as _pickle
+        proxy._reconcile_leases()
+        reply = proxy.client.call(
+            "push_task", {"worker_token": leaked_token,
+                          "spec": _make_task_spec(probe)}, timeout=30.0)
+        err = reply.get("error")
+        assert err is None or \
+            "lease token unknown" not in repr(_pickle.loads(err)), \
+            "grant inside the grace window must survive the sweep"
+        time.sleep(1.0)      # age past lease_reconcile_grace_s=0.8
         proxy._reconcile_leases()
 
         # The leaked worker's token must be unknown node-side now.

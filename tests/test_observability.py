@@ -166,3 +166,39 @@ class TestMetricsPipeline:
             assert "ray_tpu_core_worker_tasks_submitted" in body
         finally:
             dash.stop()
+
+
+class TestCollectorSeriesPruning:
+    def test_dead_collector_series_removed(self):
+        """Series written by a scrape collector vanish when its owner is
+        collected — per-worker label cardinality must not grow without
+        bound under worker churn (ADVICE r4: metrics_agent series never
+        pruned)."""
+        import gc
+
+        from ray_tpu._private.metrics_agent import MetricsRegistry
+
+        reg = MetricsRegistry()
+        reg.register("churn.gauge", "gauge", "per-worker gauge")
+
+        class Owner:
+            def __init__(self, wid):
+                self.wid = wid
+
+        def collect(owner):
+            reg.set("churn.gauge", 1.0, (("worker_id", owner.wid),))
+
+        owner = Owner("w1")
+        reg.register_collector(owner, collect)
+        reg.run_collectors()
+        assert reg.get_value("churn.gauge", (("worker_id", "w1"),)) == 1.0
+
+        # Survivor keeps its series while the dead owner's are pruned.
+        keeper = Owner("w2")
+        reg.register_collector(keeper, collect)
+        reg.run_collectors()
+        del owner
+        gc.collect()
+        reg.run_collectors()
+        assert reg.get_value("churn.gauge", (("worker_id", "w1"),)) is None
+        assert reg.get_value("churn.gauge", (("worker_id", "w2"),)) == 1.0
