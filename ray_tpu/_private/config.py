@@ -36,6 +36,10 @@ class Config:
     #: Hybrid policy considers the top-k best nodes and picks randomly among
     #: them (reference: hybrid_scheduling_policy.cc top-k behavior).
     scheduler_top_k_fraction: float = 0.2
+
+    #: Fuse the per-class waterfill into one Mosaic (Pallas) kernel on
+    #: TPU; falls back to the jnp scan path automatically on failure.
+    scheduler_pallas_fill: bool = True
     #: Max lease requests in flight per scheduling class
     #: (ray_config_def.h:342).
     max_pending_lease_requests_per_scheduling_category: int = 10

@@ -87,8 +87,11 @@ class CoreWorker:
 
     # ---- Put / Get / Wait (core_worker.cc:878,1081) --------------------
     def put(self, value: Any, _owner=None) -> ObjectRef:
+        from ray_tpu.util import tracing
         object_id = self._next_put_id()
-        self.put_value(object_id, value)
+        with tracing.span("put", category="object",
+                          object_id=object_id.hex()):
+            self.put_value(object_id, value)
         return ObjectRef(object_id, owner_id=self.worker_id)
 
     def put_value(self, object_id: ObjectID, value: Any):
@@ -141,13 +144,15 @@ class CoreWorker:
 
     def get(self, refs: Sequence[ObjectRef],
             timeout: Optional[float] = None) -> List[Any]:
+        from ray_tpu.util import tracing
         deadline = None if timeout is None else time.monotonic() + timeout
-        out = []
-        for ref in refs:
-            remaining = None if deadline is None else \
-                max(0.0, deadline - time.monotonic())
-            out.append(self._get_one(ref, remaining))
-        return out
+        with tracing.span("get", category="object", n=len(refs)):
+            out = []
+            for ref in refs:
+                remaining = None if deadline is None else \
+                    max(0.0, deadline - time.monotonic())
+                out.append(self._get_one(ref, remaining))
+            return out
 
     def _get_one(self, ref: ObjectRef, timeout: Optional[float]) -> Any:
         object_id = ref.object_id()
@@ -400,18 +405,28 @@ class CoreWorker:
         return out, dep_ids, holders, borrowed
 
     def submit_task(self, spec: TaskSpec, holders=()) -> List[ObjectRef]:
+        from ray_tpu.util import tracing
         self.task_manager.add_pending_task(spec)
         del holders  # submitted-task refs now pin the promoted args
         self.metrics["tasks_submitted"] += 1
-        self.task_submitter.submit(spec)
+        with tracing.span(f"submit:{spec.function_name}",
+                          category="submit",
+                          task_id=spec.task_id.hex()) as sp:
+            spec.trace_ctx = sp.context()
+            self.task_submitter.submit(spec)
         return [ObjectRef(oid, owner_id=self.worker_id)
                 for oid in spec.return_ids]
 
     def submit_actor_task(self, spec: TaskSpec, holders=()) -> List[ObjectRef]:
+        from ray_tpu.util import tracing
         self.task_manager.add_pending_task(spec)
         del holders
         self.metrics["actor_tasks_submitted"] += 1
-        self.actor_submitter.submit(spec)
+        with tracing.span(f"submit:{spec.function_name}",
+                          category="submit",
+                          task_id=spec.task_id.hex()) as sp:
+            spec.trace_ctx = sp.context()
+            self.actor_submitter.submit(spec)
         return [ObjectRef(oid, owner_id=self.worker_id)
                 for oid in spec.return_ids]
 

@@ -64,6 +64,7 @@ def execute_task(spec: TaskSpec, node, core_worker, actor_instance=None):
     On success return values are already stored.  On failure the caller
     (TaskManager) decides between retry and storing error objects.
     """
+    from ray_tpu.util import tracing
     ctx = worker_context.ExecutionContext(
         task_spec=spec, node=node,
         worker=worker_context.get_context().worker,
@@ -71,20 +72,30 @@ def execute_task(spec: TaskSpec, node, core_worker, actor_instance=None):
     prev = worker_context.get_context()
     worker_context.set_context(ctx)
     t0 = time.monotonic()
+    trace_ctx = getattr(spec, "trace_ctx", None)
     try:
-        args, kwargs = _split_args(resolve_args(spec, node, core_worker))
-        with _applied_runtime_env(spec, node):
-            if spec.task_type == TaskType.ACTOR_CREATION_TASK:
-                fn = core_worker.function_manager.load(spec.function_id)
-                instance = fn(*args, **kwargs)
-                return True, instance
-            elif spec.task_type == TaskType.ACTOR_TASK:
-                method = getattr(actor_instance, spec.actor_method_name)
-                result = method(*args, **kwargs)
-            else:
-                fn = core_worker.function_manager.load(spec.function_id)
-                result = fn(*args, **kwargs)
-        store_returns(spec, result, node, core_worker)
+        # ``force=bool(trace_ctx)``: a traced submit makes the execute
+        # span recorded even in a worker process that never enabled
+        # capture itself — the events ride the reply back to the driver
+        # (ProfileEvent batching parity, profiling.h:64).
+        with tracing.span(f"execute:{spec.function_name}",
+                          category="execute", parent=trace_ctx,
+                          force=bool(trace_ctx),
+                          task_id=spec.task_id.hex()):
+            args, kwargs = _split_args(resolve_args(spec, node, core_worker))
+            with _applied_runtime_env(spec, node):
+                if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+                    fn = core_worker.function_manager.load(spec.function_id)
+                    instance = fn(*args, **kwargs)
+                    return True, instance
+                elif spec.task_type == TaskType.ACTOR_TASK:
+                    method = getattr(actor_instance,
+                                     spec.actor_method_name)
+                    result = method(*args, **kwargs)
+                else:
+                    fn = core_worker.function_manager.load(spec.function_id)
+                    result = fn(*args, **kwargs)
+            store_returns(spec, result, node, core_worker)
         return True, None
     except Exception as e:  # noqa: BLE001 — user exceptions cross the boundary
         return False, exceptions.TaskError(

@@ -57,6 +57,9 @@ class _RemoteWorkerHandle:
                 on_done(exceptions.WorkerCrashedError(
                     f"worker host connection lost: {err}"))
                 return
+            if result.get("trace"):
+                from ray_tpu.util import tracing
+                tracing.ingest(result["trace"])
             blob = result.get("error")
             if blob is None:
                 on_done(None)

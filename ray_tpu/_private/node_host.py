@@ -502,6 +502,17 @@ class NodeHost:
         with self._workers_lock:
             return self._workers.get(token)
 
+    @staticmethod
+    def _task_reply(spec, err) -> dict:
+        """Completion reply; a traced spec drains this process's spans
+        onto it so the head's timeline sees the execute side."""
+        import pickle
+        out = {"error": None if err is None else pickle.dumps(err)}
+        if getattr(spec, "trace_ctx", None):
+            from ray_tpu.util import tracing
+            out["trace"] = tracing.drain()
+        return out
+
     def _handle_push(self, payload, reply):
         import pickle
         worker = self._worker(payload["worker_token"])
@@ -509,10 +520,9 @@ class NodeHost:
             reply({"error": pickle.dumps(
                 exceptions.WorkerCrashedError("lease token unknown"))})
             return
+        spec = payload["spec"]
         worker.push_task(
-            payload["spec"],
-            lambda err: reply(
-                {"error": None if err is None else pickle.dumps(err)}))
+            spec, lambda err: reply(self._task_reply(spec, err)))
 
     def _handle_assign_actor(self, payload, reply):
         import pickle
@@ -521,10 +531,9 @@ class NodeHost:
             reply({"error": pickle.dumps(
                 exceptions.WorkerCrashedError("lease token unknown"))})
             return
+        spec = payload["spec"]
         worker.assign_actor(
-            payload["spec"],
-            lambda err: reply(
-                {"error": None if err is None else pickle.dumps(err)}))
+            spec, lambda err: reply(self._task_reply(spec, err)))
 
     def _handle_push_actor_task(self, payload, reply):
         import pickle
@@ -533,10 +542,9 @@ class NodeHost:
             reply({"error": pickle.dumps(exceptions.ActorError(
                 reason="actor worker gone"))})
             return
+        spec = payload["spec"]
         worker.submit_actor_task(
-            payload["spec"],
-            lambda err: reply(
-                {"error": None if err is None else pickle.dumps(err)}))
+            spec, lambda err: reply(self._task_reply(spec, err)))
 
     def _handle_return_worker(self, payload) -> bool:
         token = payload["worker_token"]

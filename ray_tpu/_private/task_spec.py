@@ -106,6 +106,9 @@ class TaskSpec:
     # Refs nested inside inlined args: borrowed for the task's lifetime
     # (reference: borrower registration, reference_count.h:61).
     borrowed_ids: List[ObjectID] = field(default_factory=list)
+    # Tracing context propagated submit -> execute (the reference
+    # injects a ``_ray_trace_ctx`` kwarg, tracing_helper.py:157,314).
+    trace_ctx: Optional[dict] = None
     # Dynamic/streaming returns
     returns_dynamic: bool = False
     # Actor creation only: resources held while the actor is alive.  The

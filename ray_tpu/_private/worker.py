@@ -72,6 +72,9 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
             raise RuntimeError("ray_tpu.init() called twice; pass "
                               "ignore_reinit_error=True to ignore.")
         initialize_config(_system_config)
+        if get_config().tracing_enabled:
+            from ray_tpu.util import tracing
+            tracing.enable()
         if address and str(address).startswith("ray-tpu://"):
             # Remote-driver path (Ray Client parity): connect to a
             # running head's wire service and drive it from here.

@@ -128,29 +128,39 @@ class _WorkerRuntime:
 
     def _execute(self, payload) -> dict:
         from ray_tpu._private import worker_context
+        from ray_tpu.util import tracing
         prev_ctx = worker_context.get_context()
         worker_context.set_context(worker_context.ExecutionContext(
             task_spec=_CtxSpec(payload), node=None, worker=None))
+        trace_ctx = payload.get("trace_ctx")
+        out: dict
         try:
-            args, kwargs = self._resolve_args(payload["args"])
-            kind = payload["kind"]
-            if kind == "create_actor":
-                cls = self._load_function(payload["function_key"])
-                self.actor_instance = cls(*args, **kwargs)
-                n = max(1, int(payload.get("max_concurrency", 1)))
-                self._sema = threading.Semaphore(n)
-                return {"error": None, "returns": []}
-            if kind == "actor_task":
-                if self.actor_instance is None:
-                    raise exceptions.RayTpuError("actor not initialized")
-                method = getattr(self.actor_instance,
-                                 payload["actor_method_name"])
-                result = method(*args, **kwargs)
-            else:
-                fn = self._load_function(payload["function_key"])
-                result = fn(*args, **kwargs)
-            return {"error": None,
-                    "returns": self._pack_returns(payload, result)}
+            with tracing.span(
+                    f"execute:{payload.get('function_name', '?')}",
+                    category="execute", parent=trace_ctx,
+                    force=bool(trace_ctx)):
+                args, kwargs = self._resolve_args(payload["args"])
+                kind = payload["kind"]
+                if kind == "create_actor":
+                    cls = self._load_function(payload["function_key"])
+                    self.actor_instance = cls(*args, **kwargs)
+                    n = max(1, int(payload.get("max_concurrency", 1)))
+                    self._sema = threading.Semaphore(n)
+                    out = {"error": None, "returns": []}
+                elif kind == "actor_task":
+                    if self.actor_instance is None:
+                        raise exceptions.RayTpuError(
+                            "actor not initialized")
+                    method = getattr(self.actor_instance,
+                                     payload["actor_method_name"])
+                    result = method(*args, **kwargs)
+                    out = {"error": None,
+                           "returns": self._pack_returns(payload, result)}
+                else:
+                    fn = self._load_function(payload["function_key"])
+                    result = fn(*args, **kwargs)
+                    out = {"error": None,
+                           "returns": self._pack_returns(payload, result)}
         except Exception as e:  # noqa: BLE001 — user errors cross the wire
             err = exceptions.TaskError(
                 e, task_desc=f"{payload.get('function_name', '?')}"
@@ -160,9 +170,14 @@ class _WorkerRuntime:
             except Exception:
                 blob = pickle.dumps(exceptions.RayTpuError(
                     "".join(traceback.format_exception(e))))
-            return {"error": blob, "returns": []}
+            out = {"error": blob, "returns": []}
         finally:
             worker_context.set_context(prev_ctx)
+        if trace_ctx:
+            # Ship locally-recorded spans back on the reply (ProfileEvent
+            # batching parity) — the driver's pool ingests them.
+            out["trace"] = tracing.drain()
+        return out
 
     def _resolve_args(self, packed):
         from ray_tpu._private.executor import _split_args

@@ -430,6 +430,9 @@ class ProcessWorker:
 
     def _handle_reply(self, reply, spec, on_done, kind):
         import pickle
+        if reply.get("trace"):
+            from ray_tpu.util import tracing
+            tracing.ingest(reply["trace"])
         err_blob = reply.get("error")
         if err_blob is not None:
             try:
@@ -458,6 +461,7 @@ class ProcessWorker:
             fn_key = _KV_PREFIX + spec.function_id.binary()
         return {
             "kind": kind,
+            "trace_ctx": getattr(spec, "trace_ctx", None),
             "function_key": fn_key,
             "function_name": spec.function_name,
             "actor_method_name": spec.actor_method_name,

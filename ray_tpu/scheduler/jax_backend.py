@@ -113,6 +113,7 @@ def _bucket_fill_step(av, total, d, cnt, is_accel, shift, accel_node, empty,
     running prefix is < 2^24, beyond which the prefix already dwarfs any
     class count so take clamps to 0.
     """
+    import jax
     import jax.numpy as jnp
 
     eps = 1e-6
@@ -142,25 +143,37 @@ def _bucket_fill_step(av, total, d, cnt, is_accel, shift, accel_node, empty,
     bucket = jnp.where(empty, float(_NUM_BUCKETS - 1), bucket)
     bucket = bucket.astype(jnp.int32)
     # Prefix capacity in (bucket, rotated node-id) order — sort-free,
-    # [B, N].  The roll puts node ``shift`` first within every bucket;
-    # prefix sums are computed in rolled space and rolled back so the
-    # per-node ``take`` lines up with real node positions.
+    # [B, N], and roll-free: instead of materializing the rolled tensor
+    # (two full [B, N] memory passes), compute the NATURAL-order
+    # per-bucket exclusive prefix P and decompose the rotation
+    # analytically.  With Q[b] = P[b, shift] (capacity in bucket b
+    # before the rotation start) and S[b] the bucket total, a node n's
+    # within-bucket prefix in rotated order is
+    #     n >= shift:  P[b, n] - Q[b]          (nodes [shift, n))
+    #     n <  shift:  S[b] - Q[b] + P[b, n]   (wrap: [shift, N) + [0, n))
     onehot = (bucket[None, :] ==
               jnp.arange(_NUM_BUCKETS, dtype=jnp.int32)[:, None])
     cap_oh = jnp.where(onehot, cap[None, :], 0.0)          # [B, N]
-    cap_oh_r = jnp.roll(cap_oh, -shift, axis=1)
-    g = cap_oh_r.reshape(_NUM_BUCKETS, n_pad // _GROUP, _GROUP)
+    g = cap_oh.reshape(_NUM_BUCKETS, n_pad // _GROUP, _GROUP)
     gsum = jnp.sum(g, axis=2)                              # [B, G]
     gprefix = jnp.cumsum(gsum, axis=1) - gsum              # excl. over groups
-    within = jnp.cumsum(g, axis=2) - g                     # excl. in group
-    prefix_bn = jnp.roll(
-        (within + gprefix[:, :, None]).reshape(_NUM_BUCKETS, n_pad),
-        shift, axis=1)
-    btotal = jnp.sum(gsum, axis=1)                         # [B]
+    # Within-group exclusive prefix as ONE strictly-lower-triangular
+    # matmul on the MXU (f32-exact below 2^24) instead of log2(128)
+    # VPU shift passes over the [B, N] tensor.
+    tri = jnp.triu(jnp.ones((_GROUP, _GROUP), jnp.float32), k=1)
+    within = jax.lax.dot_general(
+        g, tri, (((2,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST)               # [B, G, GROUP]
+    p_nat = (within + gprefix[:, :, None]).reshape(_NUM_BUCKETS, n_pad)
+    btotal = jnp.sum(gsum, axis=1)                         # [B]  (= S)
+    q_at_shift = jax.lax.dynamic_slice_in_dim(
+        p_nat, shift, 1, axis=1)[:, 0]                     # [B]  (= Q)
     bprefix = jnp.cumsum(btotal) - btotal                  # excl. over buckets
+    wrap = jnp.where(jnp.arange(n_pad) < shift,
+                     btotal[:, None], 0.0)                 # [B, N]
+    prefix_bn = p_nat - q_at_shift[:, None] + wrap + bprefix[:, None]
     # Select each node's own-bucket entry (masked sum avoids a gather).
-    prefix = jnp.sum(jnp.where(onehot, prefix_bn + bprefix[:, None], 0.0),
-                     axis=0)
+    prefix = jnp.sum(jnp.where(onehot, prefix_bn, 0.0), axis=0)
     take = jnp.clip(cnt - prefix, 0.0, cap)
     av = av - take[None, :] * d[:, None]
     return av, take
@@ -170,6 +183,193 @@ def _class_shifts(c_pad: int, n_pad: int):
     """Per-class within-bucket rotation offsets (device)."""
     import jax.numpy as jnp
     return (jnp.arange(c_pad, dtype=jnp.int32) * _ROT_STRIDE) % n_pad
+
+
+# Set True after a runtime Pallas failure; solvers rebuild on the jnp
+# path (the lru caches key on use_pallas, so the rebuild is a new jit).
+_PALLAS_BROKEN = False
+
+
+def _pallas_enabled() -> bool:
+    """Fuse the per-class fill into one Mosaic kernel?  TPU-only (tests
+    run the jnp path on CPU; equivalence is covered by an interpret-mode
+    test), opt-out via config, auto-off after a runtime failure."""
+    if _PALLAS_BROKEN or not get_config().scheduler_pallas_fill:
+        return False
+    try:
+        import jax
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+@functools.lru_cache(maxsize=16)
+def _pallas_class_fill(c_pad: int, n_pad: int, r_pad: int,
+                       interpret: bool = False):
+    """The whole class scan as ONE Mosaic kernel: grid over classes,
+    availability carried in VMEM scratch across grid steps.
+
+    The jnp path lowers each class step to ~10 fused XLA kernels; at
+    256 classes x 40 ticks that is ~10^5 sequential kernel launches
+    whose fixed overheads dominate the tick (the arrays are far too
+    small to be bandwidth-bound).  Here one kernel invocation per class
+    does everything in VMEM — the [B, N] bucket tensors never touch
+    HBM, and per-class HBM traffic is one [1, N] allocs row out.
+
+    Same math as ``_bucket_fill_step`` with two kernel-friendly
+    substitutions (both f32-exact for integer capacities < 2^24):
+      * the within-bucket exclusive prefix is a lane-axis Hillis-Steele
+        scan (``pltpu.roll`` + iota mask) instead of the blocked
+        reshape/cumsum;
+      * the bucket-prefix cumsum over B=19 entries is a strictly-lower
+        triangular matmul at Precision.HIGHEST (MXU bf16 passes round
+        integers like 265 — HIGHEST is required for exactness).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B = _NUM_BUCKETS
+    eps = 1e-6
+
+    def kernel(counts_ref, accel_ref, shifts_ref, thr_ref,
+               demand_ref, total_ref, accel_node_ref, av0_ref,
+               av_out_ref, allocs_ref, av_s):
+        c = pl.program_id(0)
+
+        @pl.when(c == 0)
+        def _init():
+            av_s[...] = av0_ref[...]
+
+        av = av_s[...]                                     # [R, N]
+        total = total_ref[...]                             # [R, N]
+        cnt = counts_ref[c]
+        is_accel = accel_ref[c] > 0
+        shift = shifts_ref[c]
+        thr = thr_ref[0]
+        d = demand_ref[0]                                  # [R, 1]
+        demanded = d > 0
+        any_demand = jnp.any(demanded)
+        ratios = jnp.where(demanded, av / jnp.maximum(d, eps), _BIG)
+        cap = jnp.floor(jnp.min(ratios, axis=0, keepdims=True) + eps)
+        cap = jnp.clip(cap, 0.0, cnt)                      # [1, N]
+        util = jnp.where(total > 0,
+                         (total - av) / jnp.maximum(total, eps), 0.0)
+        score_d = jnp.max(jnp.where(demanded, util, -_BIG),
+                          axis=0, keepdims=True)
+        score_o = jnp.max(util, axis=0, keepdims=True)
+        score = jnp.where(any_demand, score_d, score_o)    # [1, N]
+        empty = jnp.max(total, axis=0, keepdims=True) <= 0.0
+        accel_node = accel_node_ref[...] > 0.0             # [1, N]
+        scale = _UTIL_LEVELS / jnp.maximum(1.0 - thr, eps)
+        lvl = jnp.clip(jnp.floor((score - thr) * scale) + 1.0,
+                       1.0, float(_UTIL_LEVELS))
+        bucket = jnp.where(score < thr, 0.0, lvl)
+        bucket = jnp.where(
+            jnp.logical_and(accel_node, jnp.logical_not(is_accel)),
+            float(_UTIL_LEVELS + 1), bucket)
+        bucket = jnp.where(empty, float(B - 1), bucket).astype(jnp.int32)
+        onehot = bucket == jax.lax.broadcasted_iota(
+            jnp.int32, (B, n_pad), 0)
+        cap_oh = jnp.where(onehot, cap, 0.0)               # [B, N]
+        lane = jax.lax.broadcasted_iota(jnp.int32, (B, n_pad), 1)
+        p = cap_oh
+        k = 1
+        while k < n_pad:
+            p = p + jnp.where(lane >= k, pltpu.roll(p, k, 1), 0.0)
+            k *= 2
+        p_nat = p - cap_oh                                 # excl. prefix
+        btotal = jnp.max(p, axis=1, keepdims=True)         # [B, 1]
+        before = lane < shift
+        q = jnp.sum(jnp.where(before, cap_oh, 0.0),
+                    axis=1, keepdims=True)                 # [B, 1]
+        row = jax.lax.broadcasted_iota(jnp.int32, (B, B), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (B, B), 1)
+        tri_excl = (col < row).astype(jnp.float32)
+        bprefix = jax.lax.dot_general(
+            tri_excl, btotal, (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)            # [B, 1]
+        # Rotation decomposed analytically (see _bucket_fill_step).
+        prefix_bn = p_nat - q + jnp.where(before, btotal, 0.0) + bprefix
+        prefix = jnp.sum(jnp.where(onehot, prefix_bn, 0.0),
+                         axis=0, keepdims=True)            # [1, N]
+        take = jnp.clip(cnt - prefix, 0.0, cap)
+        av_s[...] = av - d * take
+        allocs_ref[...] = take[None]
+
+        @pl.when(c == c_pad - 1)
+        def _fin():
+            av_out_ref[...] = av_s[...]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(c_pad,),
+        in_specs=[
+            pl.BlockSpec((1, r_pad, 1), lambda c, *_: (c, 0, 0)),
+            pl.BlockSpec((r_pad, n_pad), lambda c, *_: (0, 0)),
+            pl.BlockSpec((1, n_pad), lambda c, *_: (0, 0)),
+            pl.BlockSpec((r_pad, n_pad), lambda c, *_: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((r_pad, n_pad), lambda c, *_: (0, 0)),
+            pl.BlockSpec((1, 1, n_pad), lambda c, *_: (c, 0, 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((r_pad, n_pad), jnp.float32)],
+    )
+    fn = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((r_pad, n_pad), jnp.float32),
+            jax.ShapeDtypeStruct((c_pad, 1, n_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )
+
+    def fill(av_t, total_t, demand, counts, accel_class, accel_node,
+             spread_threshold):
+        import jax.numpy as jnp
+        av_out, allocs = fn(
+            counts.astype(jnp.float32),
+            accel_class.astype(jnp.int32),
+            _class_shifts(c_pad, n_pad),
+            jnp.reshape(jnp.asarray(spread_threshold, jnp.float32), (1,)),
+            demand[:, :, None].astype(jnp.float32),
+            total_t,
+            accel_node.astype(jnp.float32)[None, :],
+            av_t)
+        return av_out, allocs[:, 0, :]
+
+    return fill
+
+
+def _class_fill(av_t, total_t, demand, counts, accel_class, accel_node,
+                spread_threshold, *, c_pad: int, n_pad: int, r_pad: int,
+                use_pallas: bool):
+    """Run the per-class waterfill over all classes against ``av_t``.
+
+    Returns (av_after [R, N], allocs [C, N]).  One fused Mosaic kernel
+    on TPU; the jnp scan elsewhere (both oracle-exact)."""
+    import jax
+    import jax.numpy as jnp
+
+    if use_pallas:
+        fill = _pallas_class_fill(c_pad, n_pad, r_pad)
+        return fill(av_t, total_t, demand, counts, accel_class,
+                    accel_node, spread_threshold)
+    empty = jnp.max(total_t, axis=0) <= 0
+    shifts = _class_shifts(c_pad, n_pad)
+
+    def body(av, inputs):
+        d, cnt, is_accel, shift = inputs
+        return _bucket_fill_step(av, total_t, d, cnt, is_accel, shift,
+                                 accel_node, empty, spread_threshold)
+
+    av_after, allocs = jax.lax.scan(
+        body, av_t, (demand, counts, accel_class, shifts), unroll=8)
+    return av_after, allocs
 
 
 def _pack_tick(allocs, counts_k, av_pre, demand, nnz_max):
@@ -211,25 +411,18 @@ def _pack_tick(allocs, counts_k, av_pre, demand, nnz_max):
 # ---------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=16)
-def _jit_waterfill(c_pad: int, n_pad: int, r_pad: int):
+def _jit_waterfill(c_pad: int, n_pad: int, r_pad: int,
+                   use_pallas: bool = False):
     import jax
-    import jax.numpy as jnp
 
     def solve(avail, total, demand, counts, accel_node, accel_class,
               spread_threshold):
         # avail/total: [N, R]; demand: [C, R]; counts: [C].  Transposed
         # once to the TPU-native [R, N] layout (see _bucket_fill_step).
-        av_t, total_t = avail.T, total.T
-        empty = jnp.max(total_t, axis=0) <= 0
-        shifts = _class_shifts(c_pad, n_pad)
-
-        def body(av, inputs):
-            d, cnt, is_accel, shift = inputs
-            return _bucket_fill_step(av, total_t, d, cnt, is_accel, shift,
-                                     accel_node, empty, spread_threshold)
-
-        final_avail, allocs = jax.lax.scan(
-            body, av_t, (demand, counts, accel_class, shifts))
+        final_avail, allocs = _class_fill(
+            avail.T, total.T, demand, counts, accel_class, accel_node,
+            spread_threshold, c_pad=c_pad, n_pad=n_pad, r_pad=r_pad,
+            use_pallas=use_pallas)
         return allocs, final_avail.T
 
     return jax.jit(solve)
@@ -237,7 +430,8 @@ def _jit_waterfill(c_pad: int, n_pad: int, r_pad: int):
 
 @functools.lru_cache(maxsize=8)
 def _jit_waterfill_stream(c_pad: int, n_pad: int, r_pad: int,
-                          ticks: int, nnz_max: int):
+                          ticks: int, nnz_max: int,
+                          use_pallas: bool = False):
     """K scheduler ticks in one device program, closed-loop in STATE.
 
     All world state is device-resident scan carry:
@@ -262,8 +456,6 @@ def _jit_waterfill_stream(c_pad: int, n_pad: int, r_pad: int,
     def solve(avail0, total, demand, pending0, arrivals, rho, accel_node,
               accel_class, spread_threshold):
         av0_t, total_t = avail0.T, total.T                 # [R, N]
-        empty = jnp.max(total_t, axis=0) <= 0
-        shifts = _class_shifts(c_pad, n_pad)
         inflight0 = jnp.zeros((c_pad, n_pad), jnp.float32)
 
         def one_tick(carry, arrivals_k):
@@ -275,15 +467,10 @@ def _jit_waterfill_stream(c_pad: int, n_pad: int, r_pad: int,
                 av + jnp.einsum("cn,cr->rn", release, demand), total_t)
             inflight = inflight - release
             counts_k = pending + arrivals_k
-
-            def body(av_in, inputs):
-                d, cnt, is_accel, shift = inputs
-                return _bucket_fill_step(av_in, total_t, d, cnt, is_accel,
-                                         shift, accel_node, empty,
-                                         spread_threshold)
-
-            av_after, allocs = jax.lax.scan(
-                body, av, (demand, counts_k, accel_class, shifts), unroll=8)
+            av_after, allocs = _class_fill(
+                av, total_t, demand, counts_k, accel_class, accel_node,
+                spread_threshold, c_pad=c_pad, n_pad=n_pad, r_pad=r_pad,
+                use_pallas=use_pallas)
             packed, placed_c = _pack_tick(allocs, counts_k, av, demand,
                                           nnz_max)
             pending_next = jnp.maximum(counts_k - placed_c, 0.0)
@@ -298,7 +485,8 @@ def _jit_waterfill_stream(c_pad: int, n_pad: int, r_pad: int,
 
 
 @functools.lru_cache(maxsize=16)
-def _jit_solve_tick(c_pad: int, n_pad: int, r_pad: int, nnz_max: int):
+def _jit_solve_tick(c_pad: int, n_pad: int, r_pad: int, nnz_max: int,
+                    use_pallas: bool = False):
     """One runtime scheduling tick against DEVICE-RESIDENT world state.
 
     Unlike ``_jit_waterfill`` this takes the transposed [R, N] matrices a
@@ -313,16 +501,10 @@ def _jit_solve_tick(c_pad: int, n_pad: int, r_pad: int, nnz_max: int):
 
     def solve(avail_t, total_t, demand, counts, accel_node, accel_class,
               spread_threshold):
-        empty = jnp.max(total_t, axis=0) <= 0
-        shifts = _class_shifts(c_pad, n_pad)
-
-        def body(av, inputs):
-            d, cnt, is_accel, shift = inputs
-            return _bucket_fill_step(av, total_t, d, cnt, is_accel, shift,
-                                     accel_node, empty, spread_threshold)
-
-        _, allocs = jax.lax.scan(
-            body, avail_t, (demand, counts, accel_class, shifts))
+        _, allocs = _class_fill(
+            avail_t, total_t, demand, counts, accel_class, accel_node,
+            spread_threshold, c_pad=c_pad, n_pad=n_pad, r_pad=r_pad,
+            use_pallas=use_pallas)
         packed, _ = _pack_tick(allocs, counts, avail_t, demand, nnz_max)
         return packed
 
@@ -534,6 +716,30 @@ def stream_oracle(avail: np.ndarray, total: np.ndarray, demand: np.ndarray,
 # Host-side driver.
 # ---------------------------------------------------------------------------
 
+def _call_with_pallas_fallback(build_fn, args):
+    """Invoke ``build_fn(use_pallas)(*args)``; on a Mosaic failure flip
+    the module kill-switch and re-run on the jnp path (the jit caches
+    key on use_pallas, so the rebuild is a distinct program).
+
+    The result is blocked on INSIDE the try: TPU dispatch is
+    asynchronous, so an execution-time kernel fault would otherwise
+    surface at the caller's np.asarray, outside any fallback."""
+    global _PALLAS_BROKEN
+    import jax
+    use = _pallas_enabled()
+    try:
+        return jax.block_until_ready(build_fn(use)(*args))
+    except Exception:
+        if not use:
+            raise
+        import logging
+        logging.getLogger(__name__).exception(
+            "Pallas scheduler kernel failed; falling back to the jnp "
+            "path for the rest of this process")
+        _PALLAS_BROKEN = True
+        return build_fn(False)(*args)
+
+
 class BatchSolver:
     """Groups pending specs by scheduling class, runs the device solve,
     expands the allocation back to per-task node targets."""
@@ -569,8 +775,9 @@ class BatchSolver:
             allocs, _ = fn(*args, np.float32(spread_threshold),
                            np.float32(0.1))
         else:
-            fn = _jit_waterfill(c_pad, n_pad, r_pad)
-            allocs, _ = fn(*args, np.float32(spread_threshold))
+            allocs, _ = _call_with_pallas_fallback(
+                lambda use: _jit_waterfill(c_pad, n_pad, r_pad, use),
+                (*args, np.float32(spread_threshold)))
         allocs = np.asarray(jax.device_get(allocs))[:C, :N]
         return np.rint(allocs).astype(np.int64)
 
@@ -629,15 +836,16 @@ class BatchSolver:
         K = arrivals.shape[0]
         if pending0 is None:
             pending0 = np.zeros(C, dtype=np.float32)
-        fn = _jit_waterfill_stream(c_pad, n_pad, r_pad, K, nnz_max)
         arr = _pad_to(arrivals.astype(np.float32), (K, c_pad))
         pen = _pad_to(pending0.astype(np.float32), (c_pad,))
         rho_vec = _pad_to(
             np.broadcast_to(np.asarray(rho, dtype=np.float32), (C,)).copy(),
             (c_pad,))
-        packed = np.asarray(fn(
-            dev["avail"], dev["total"], dev["demand"], pen, arr, rho_vec,
-            dev["accel_node"], dev["accel_class"], dev["thr"]))
+        packed = np.asarray(_call_with_pallas_fallback(
+            lambda use: _jit_waterfill_stream(c_pad, n_pad, r_pad, K,
+                                              nnz_max, use),
+            (dev["avail"], dev["total"], dev["demand"], pen, arr, rho_vec,
+             dev["accel_node"], dev["accel_class"], dev["thr"])))
         return {
             "idx": np.rint(packed[:, :nnz_max]).astype(np.int64),
             "vals": packed[:, nnz_max:2 * nnz_max],
@@ -881,11 +1089,12 @@ class DeviceRuntimeSolver:
         if nnz_max is None:
             return False
         cfg = get_config()
-        fn = _jit_solve_tick(c_cap, st["n_pad"], st["r_pad"], nnz_max)
-        packed = np.asarray(fn(
-            st["avail_t"], st["total_t"], self._demand_dev, counts,
-            st["accel_node"], self._accel_dev,
-            np.float32(cfg.scheduler_spread_threshold)))
+        packed = np.asarray(_call_with_pallas_fallback(
+            lambda use: _jit_solve_tick(c_cap, st["n_pad"], st["r_pad"],
+                                        nnz_max, use),
+            (st["avail_t"], st["total_t"], self._demand_dev, counts,
+             st["accel_node"], self._accel_dev,
+             np.float32(cfg.scheduler_spread_threshold))))
         ok = packed[2 * nnz_max + 1] > 0.5
         if not ok:
             return False

@@ -1,20 +1,30 @@
 """Task-level tracing / timeline profiling.
 
-Parity: reference OpenTelemetry tracing (``tracing_helper.py`` — spans
-around submit/execute with context propagation) and the C++ ``ProfileEvent``
-timeline (``src/ray/core_worker/profiling.h:64``) dumped as chrome://tracing
-JSON via ``ray.timeline()`` (``python/ray/state.py:843``).
+Parity: reference OpenTelemetry tracing (``tracing_helper.py:157,314`` —
+spans around submit/execute, context propagated by injecting a
+``_ray_trace_ctx`` into every traced remote call; here the context rides
+a ``TaskSpec.trace_ctx`` field) and the C++ ``ProfileEvent`` timeline
+(``src/ray/core_worker/profiling.h:64``) batched back to the driver and
+dumped as chrome://tracing JSON via ``ray.timeline()``
+(``python/ray/state.py:843``).
+
+Workers in other OS processes record spans locally and piggyback them on
+task replies (``drain``/``ingest``), the in-process analogue of the
+reference's ProfileEvent batching to GCS.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
+import uuid
 from typing import Dict, List, Optional
 
 _lock = threading.Lock()
 _events: List[dict] = []
 _enabled = False
+_tls = threading.local()
 
 
 def enable(flag: bool = True):
@@ -26,22 +36,66 @@ def is_enabled() -> bool:
     return _enabled
 
 
-class span:
-    """RAII profile span (ProfileEvent parity)."""
+def current_context() -> Optional[Dict]:
+    """The innermost active span's propagatable context, if any."""
+    stack = getattr(_tls, "stack", None)
+    return dict(stack[-1]) if stack else None
 
-    def __init__(self, name: str, category: str = "task", **meta):
+
+class span:
+    """RAII profile span (ProfileEvent parity).
+
+    ``parent`` is an explicit trace context dict (e.g. a TaskSpec's
+    ``trace_ctx`` on the executor side); without one, the thread's
+    innermost active span is the parent.  ``force`` records the span
+    even when process-wide capture is off — executors use it so a
+    traced task from a remote driver is captured in a worker process
+    that never called :func:`enable`.
+    """
+
+    def __init__(self, name: str, category: str = "task",
+                 parent: Optional[Dict] = None, force: bool = False,
+                 **meta):
         self.name = name
         self.category = category
         self.meta = meta
         self.t0 = 0.0
+        self._force = force
+        self._parent = parent
+        self._ctx: Optional[Dict] = None
+
+    @property
+    def active(self) -> bool:
+        return _enabled or self._force
+
+    def context(self) -> Optional[Dict]:
+        """Propagatable context (inject into TaskSpec.trace_ctx)."""
+        return dict(self._ctx) if self._ctx else None
 
     def __enter__(self):
+        if not self.active:
+            return self
         self.t0 = time.time()
+        parent = self._parent or current_context()
+        self._ctx = {
+            "trace_id": (parent or {}).get("trace_id") or uuid.uuid4().hex,
+            "span_id": uuid.uuid4().hex[:16],
+            "parent_id": (parent or {}).get("span_id"),
+        }
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        stack.append(self._ctx)
         return self
 
     def __exit__(self, *exc):
-        if not _enabled:
+        if self._ctx is None:
             return
+        stack = getattr(_tls, "stack", None)
+        if stack and stack[-1] is self._ctx:
+            stack.pop()
+        args = dict(self.meta)
+        args.update(self._ctx)
         with _lock:
             _events.append({
                 "name": self.name,
@@ -49,9 +103,9 @@ class span:
                 "ph": "X",
                 "ts": self.t0 * 1e6,
                 "dur": (time.time() - self.t0) * 1e6,
-                "pid": 0,
+                "pid": os.getpid(),
                 "tid": threading.get_ident() % 2**31,
-                "args": self.meta,
+                "args": args,
             })
 
 
@@ -60,13 +114,31 @@ def record_instant(name: str, **meta):
         return
     with _lock:
         _events.append({"name": name, "ph": "i", "ts": time.time() * 1e6,
-                        "pid": 0, "tid": threading.get_ident() % 2**31,
+                        "pid": os.getpid(),
+                        "tid": threading.get_ident() % 2**31,
                         "s": "g", "args": meta})
 
 
 def chrome_tracing_dump() -> List[dict]:
     with _lock:
         return list(_events)
+
+
+def drain() -> List[dict]:
+    """Atomically remove and return buffered events (worker side: ship
+    them back on the task reply)."""
+    with _lock:
+        out = list(_events)
+        _events.clear()
+    return out
+
+
+def ingest(events: Optional[List[dict]]):
+    """Merge events recorded in another process into this timeline."""
+    if not events:
+        return
+    with _lock:
+        _events.extend(events)
 
 
 def clear():

@@ -202,3 +202,72 @@ class TestCollectorSeriesPruning:
         reg.run_collectors()
         assert reg.get_value("churn.gauge", (("worker_id", "w1"),)) is None
         assert reg.get_value("churn.gauge", (("worker_id", "w2"),)) == 1.0
+
+
+class TestTracing:
+    """Spans around submit/execute with context propagation
+    (tracing_helper.py:157,314 parity; trace ctx rides TaskSpec)."""
+
+    def test_remote_call_produces_linked_spans(self):
+        from ray_tpu.util import tracing
+        ray_tpu.init(num_cpus=2, _system_config={"tracing_enabled": True})
+        try:
+            tracing.clear()
+
+            @ray_tpu.remote
+            def traced(x):
+                return x + 1
+
+            assert ray_tpu.get(traced.remote(1), timeout=30) == 2
+            events = ray_tpu.timeline()
+            submits = [e for e in events if e["cat"] == "submit"]
+            executes = [e for e in events if e["cat"] == "execute"]
+            assert submits and executes
+            sub, ex = submits[0], executes[0]
+            # Same trace; execute's parent is the submit span.
+            assert ex["args"]["trace_id"] == sub["args"]["trace_id"]
+            assert ex["args"]["parent_id"] == sub["args"]["span_id"]
+            # get/put spans exist too.
+            assert any(e["cat"] == "object" and e["name"] == "get"
+                       for e in events)
+            # Renders as chrome://tracing JSON (required keys).
+            for e in events:
+                assert {"name", "ph", "ts", "pid", "tid"} <= set(e)
+        finally:
+            ray_tpu.shutdown()
+            tracing.enable(False)
+            tracing.clear()
+
+    def test_spans_cross_the_process_boundary(self):
+        """Execute spans recorded in a worker OS process must appear in
+        the driver's timeline with the worker's pid (ProfileEvent
+        batching parity)."""
+        from ray_tpu.util import tracing
+        ray_tpu.init(num_cpus=2, _system_config={
+            "worker_process_mode": "process",
+            "scheduler_backend": "native",
+            "tracing_enabled": True,
+        })
+        try:
+            tracing.clear()
+
+            @ray_tpu.remote
+            def where():
+                return os.getpid()
+
+            worker_pid = ray_tpu.get(where.remote(), timeout=60)
+            assert worker_pid != os.getpid()
+            events = ray_tpu.timeline()
+            executes = [e for e in events if e["cat"] == "execute"]
+            submits = [e for e in events if e["cat"] == "submit"]
+            assert submits and executes
+            assert any(e["pid"] == worker_pid for e in executes), \
+                "execute span from the worker process missing"
+            assert any(e["pid"] == os.getpid() for e in submits)
+            ex = next(e for e in executes if e["pid"] == worker_pid)
+            sub = submits[0]
+            assert ex["args"]["trace_id"] == sub["args"]["trace_id"]
+        finally:
+            ray_tpu.shutdown()
+            tracing.enable(False)
+            tracing.clear()
