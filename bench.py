@@ -140,6 +140,7 @@ def main():
         "vs_baseline": round(baseline_ms / ms_per_tick, 2),
         "placed_tasks": placed,
         "ticks_per_program": ticks,
+        "nnz_max_per_tick": int(out["nnz"].max()),
         "classes": int(demand.shape[0]),
         "nodes": int(avail.shape[0]),
         "backend": jax.default_backend(),

@@ -172,6 +172,55 @@ def bench_object_gb(gib):
                 get_gbps=round(gib / get_dt, 2))
 
 
+def bench_process_mode_objects(mb, rounds):
+    """Process-mode worker object path: big args down + big returns
+    back.  With the shm client surface both directions go through the
+    mapped segment (zero-copy reads, create/seal writes) instead of
+    pickle-over-socket — this row tracks that throughput."""
+    import subprocess
+
+    import numpy as np
+    script = f"""
+import os, time, json
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import ray_tpu
+ray_tpu.init(num_cpus=2, _system_config={{
+    "worker_process_mode": "process",
+    "scheduler_backend": "native",
+}})
+
+@ray_tpu.remote
+def bounce(a):
+    return a * 2.0
+
+arr = np.ones({mb} * 1024 * 128, dtype=np.float64)   # {mb} MB
+ref = ray_tpu.put(arr)
+ray_tpu.get(bounce.remote(ref), timeout=120)          # warm worker
+t0 = time.monotonic()
+for _ in range({rounds}):
+    out = ray_tpu.get(bounce.remote(ref), timeout=120)
+dt = time.monotonic() - t0
+assert float(out[0]) == 2.0
+print(json.dumps({{"mb_per_s": {mb} * 2 * {rounds} / dt,
+                   "seconds": dt}}))
+ray_tpu.shutdown()
+"""
+    out = subprocess.run([sys.executable, "-c", script],
+                         capture_output=True, text=True, timeout=600)
+    if out.returncode != 0 or not out.stdout.strip():
+        raise RuntimeError(
+            f"process-mode bench child failed (rc={out.returncode}):\n"
+            f"{out.stderr[-2000:]}")
+    import json as json_mod
+    line = out.stdout.strip().splitlines()[-1]
+    res = json_mod.loads(line)
+    return emit("process_mode_object_throughput",
+                res["mb_per_s"], "MB/s",
+                payload_mb=mb, rounds=rounds,
+                seconds=round(res["seconds"], 2))
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true",
@@ -198,6 +247,8 @@ def main():
     rows.append(bench_returns(300 if quick else 3_000))
     rows.append(bench_get_many(1_000 if quick else 10_000))
     rows.append(bench_object_gb(0.25 if quick else 1.0))
+    rows.append(bench_process_mode_objects(8 if quick else 32,
+                                           3 if quick else 10))
     queued = args.queued if args.queued is not None else \
         (20_000 if quick else 1_000_000)
     rows.append(bench_queued(queued, num_blockers=cpus))

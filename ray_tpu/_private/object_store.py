@@ -201,15 +201,70 @@ class NodeObjectStore:
             e.pin_count = 1 if pin else 0
             if self._native is not None and isinstance(data, SerializedObject) \
                     and not e.is_device:
-                try:
-                    self._native.put(object_id.binary(), data.to_bytes())
-                    e.data = _NativeHandle(self._native, object_id.binary(), size)
-                except Exception:
-                    pass  # fall back to holding the python-side buffers
+                handle = self._native_put(object_id, data.to_bytes())
+                if handle is not None:
+                    e.data = handle
             self._entries[object_id] = e
             self._used += size
             self._lock.notify_all()
             return size
+
+    def _native_put(self, object_id: ObjectID, blob: bytes):
+        """Native put with the create-request retry flow
+        (create_request_queue.h parity): on OOM, ask the native LRU for
+        victims, spill them through the Python IO path, and retry;
+        returns None (python-held buffers, the fallback allocation)
+        only when the segment genuinely cannot fit the object.  Must
+        hold the store lock."""
+        key = object_id.binary()
+        need = len(blob) + 128
+        for attempt in range(4):   # 3 escalations + final retry
+            try:
+                self._native.put(key, blob)
+                return _NativeHandle(self._native, key, len(blob))
+            except MemoryError:
+                free = self._native.capacity - self._native.used_bytes()
+                # Escalating eviction: first the byte shortfall, then a
+                # full object's worth of LRU neighbours (total free can
+                # exceed the request while no HOLE fits it), finally
+                # everything evictable — coalescing then yields the
+                # largest hole the pinned islands allow.
+                if attempt == 0:
+                    shortfall = max(1, need - free)
+                elif attempt == 1:
+                    shortfall = need
+                else:
+                    shortfall = self._native.capacity
+                victims = self._native.choose_victims(shortfall)
+                if not victims:
+                    return None
+                for vkey in victims:
+                    voi = ObjectID(vkey)
+                    ve = self._entries.get(voi)
+                    if ve is not None and isinstance(ve.data, _NativeHandle):
+                        self._spill(voi, ve)     # reads + frees native
+                        self.stats["evicted_objects"] += 1
+                    else:
+                        self._native.delete(vkey)
+            except Exception:
+                return None
+        return None
+
+    def register_native_entry(self, object_id: ObjectID, size: int):
+        """Adopt an object a CLIENT created+sealed directly in the
+        native segment (worker-written return): table entry wrapping
+        the native handle, owner-pinned like any primary copy."""
+        with self._lock:
+            if object_id in self._entries:
+                return
+            self._ensure_capacity(size)
+            e = _Entry(data=_NativeHandle(self._native,
+                                          object_id.binary(), size),
+                       size=size)
+            e.pin_count = 1
+            self._entries[object_id] = e
+            self._used += size
+            self._lock.notify_all()
 
     def contains(self, object_id: ObjectID) -> bool:
         with self._lock:
@@ -238,6 +293,9 @@ class NodeObjectStore:
         return data
 
     def pin(self, object_id: ObjectID):
+        """Store-level pin: protects from Python-side spill selection.
+        Native pins are CLIENT pins only (shm surface) — they defer the
+        native free while a worker reads through its mapping."""
         with self._lock:
             e = self._entries.get(object_id)
             if e is not None:
@@ -256,6 +314,7 @@ class NodeObjectStore:
                 return
             self._used -= e.size if e.data is not None else 0
             if isinstance(e.data, _NativeHandle):
+                # Client (worker-held) pins defer the actual free.
                 e.data.delete()
             if e.spilled_path:
                 try:
@@ -286,7 +345,11 @@ class NodeObjectStore:
     def _spill(self, object_id: ObjectID, e: _Entry):
         data = e.data
         if isinstance(data, _NativeHandle):
-            blob = data.read()
+            # Materialize before freeing: read() is a view into the
+            # segment, invalid once the allocator reuses the block.
+            # (A client-pinned object's native free defers to its last
+            # release; the spill copy is taken regardless.)
+            blob = bytes(data.read())
             data.delete()
         elif isinstance(data, DeviceObject):
             blob = data.to_serialized().to_bytes()

@@ -38,6 +38,11 @@ from ray_tpu._private.serialization import (
     SerializedObject, deserialize, loads_function, serialize)
 from ray_tpu.rpc import RpcClient, RpcServer
 
+_SHM_MISS = object()
+# Returns below this ride the reply socket (the owner memory-store
+# inline path wants them anyway); above it they go through the segment.
+_SHM_RETURN_MIN = 100 * 1024
+
 
 class _CtxSpec:
     """Task-context slice for runtime_context inside the child (the host
@@ -78,6 +83,19 @@ class _WorkerRuntime:
         self._sema: Optional[threading.Semaphore] = None
         self._order_lock = threading.Lock()
         self._stop_event = threading.Event()
+        # Plasma-client mapping of the node's shm segment (metadata via
+        # node_client RPC, bytes through this mmap — zero-copy).
+        self._shm = None
+
+    def _attach_shm(self):
+        try:
+            info = self.node_client.call("shm_info", None, timeout=10.0)
+            if info:
+                from ray_tpu.native.shm_store import AttachedSegment
+                self._shm = AttachedSegment(info["name"],
+                                            info["capacity"])
+        except Exception:
+            self._shm = None
 
     def run(self):
         # Nested-.remote support: wire this process's global worker to
@@ -87,6 +105,9 @@ class _WorkerRuntime:
         from ray_tpu._private import client_runtime
         client_runtime.install(self.node_client,
                                client_worker_id=self.worker_id)
+        # Attach the segment BEFORE registering: a task can be pushed
+        # the moment registration lands, and it must find the mapping.
+        self._attach_shm()
         self.node_client.call("register_worker", {
             "worker_id": self.worker_id,
             "port": self.server.address[1],
@@ -133,13 +154,14 @@ class _WorkerRuntime:
         worker_context.set_context(worker_context.ExecutionContext(
             task_spec=_CtxSpec(payload), node=None, worker=None))
         trace_ctx = payload.get("trace_ctx")
+        pinned: list = []
         out: dict
         try:
             with tracing.span(
                     f"execute:{payload.get('function_name', '?')}",
                     category="execute", parent=trace_ctx,
                     force=bool(trace_ctx)):
-                args, kwargs = self._resolve_args(payload["args"])
+                args, kwargs = self._resolve_args(payload["args"], pinned)
                 kind = payload["kind"]
                 if kind == "create_actor":
                     cls = self._load_function(payload["function_key"])
@@ -173,25 +195,66 @@ class _WorkerRuntime:
             out = {"error": blob, "returns": []}
         finally:
             worker_context.set_context(prev_ctx)
+            # Normal tasks: args died with the frame; drop their pins.
+            # Actor creation/tasks keep theirs — args may live on as
+            # actor state referencing the mapping.
+            if pinned and payload["kind"] == "task":
+                self._release_pins(pinned)
         if trace_ctx:
             # Ship locally-recorded spans back on the reply (ProfileEvent
             # batching parity) — the driver's pool ingests them.
             out["trace"] = tracing.drain()
         return out
 
-    def _resolve_args(self, packed):
+    def _resolve_args(self, packed, pinned):
         from ray_tpu._private.executor import _split_args
         flat = []
         for kind, data in packed:
             if kind == "inline":
                 flat.append(deserialize(SerializedObject.from_bytes(data)))
-            else:
-                blob = self.node_client.call("get_object", data, timeout=30.0)
-                if blob is None:
-                    raise exceptions.ObjectLostError(
-                        data.hex(), "arg not available on host node")
-                flat.append(deserialize(SerializedObject.from_bytes(blob)))
+                continue
+            value = self._shm_get(data, pinned)
+            if value is not _SHM_MISS:
+                flat.append(value)
+                continue
+            blob = self.node_client.call("get_object", data, timeout=30.0)
+            if blob is None:
+                raise exceptions.ObjectLostError(
+                    data.hex(), "arg not available on host node")
+            flat.append(deserialize(SerializedObject.from_bytes(blob)))
         return _split_args(flat)
+
+    def _shm_get(self, oid_bin: bytes, pinned: list):
+        """Zero-copy arg read (plasma client Get): locate pins the
+        object host-side, bytes come straight from the read-only
+        mapping and the deserialized arrays reference it.  The pin key
+        is recorded in ``pinned``; normal tasks release at task end,
+        actor tasks hold for the worker's lifetime (their args become
+        actor state)."""
+        if self._shm is None:
+            return _SHM_MISS
+        try:
+            loc = self.node_client.call(
+                "shm_locate", {"object_id": oid_bin,
+                               "worker_id": self.worker_id},
+                timeout=30.0)
+        except Exception:
+            return _SHM_MISS
+        if loc is None:
+            return _SHM_MISS
+        pinned.append(oid_bin)
+        view = self._shm.read(int(loc[0]), int(loc[1]))
+        return deserialize(SerializedObject.from_bytes(view))
+
+    def _release_pins(self, pinned: list):
+        for oid_bin in pinned:
+            try:
+                self.node_client.call_async(
+                    "shm_release", {"object_id": oid_bin,
+                                    "worker_id": self.worker_id},
+                    lambda _r, _e: None)
+            except Exception:
+                pass
 
     def _pack_returns(self, payload, result):
         num = payload["num_returns"]
@@ -203,7 +266,36 @@ class _WorkerRuntime:
                 f"task returned {len(values)} values, expected {num}")
         out = []
         for oid_bin, value in zip(payload["return_ids"], values):
-            out.append((oid_bin, serialize(value).to_bytes()))
+            blob = serialize(value).to_bytes()
+            if self._shm is not None and len(blob) > _SHM_RETURN_MIN:
+                # Write-through-shm return (plasma Create/Seal): reserve
+                # host-side, fill via this mapping, seal registers the
+                # entry — the bytes never cross the socket.
+                off = None
+                try:
+                    off = self.node_client.call(
+                        "shm_create", {"object_id": oid_bin,
+                                       "size": len(blob)}, timeout=30.0)
+                    if off is not None:
+                        self._shm.write(int(off), blob)
+                        if self.node_client.call(
+                                "shm_seal", {"object_id": oid_bin,
+                                             "size": len(blob)},
+                                timeout=30.0):
+                            out.append((oid_bin, None))   # sealed in shm
+                            continue
+                except Exception:
+                    pass
+                if off is not None:
+                    # Write/seal failed mid-way: the reservation is
+                    # invisible to eviction — abort it or it leaks.
+                    try:
+                        self.node_client.call_async(
+                            "shm_abort", {"object_id": oid_bin},
+                            lambda _r, _e: None)
+                    except Exception:
+                        pass
+            out.append((oid_bin, blob))
         return out
 
     def _load_function(self, key: bytes):

@@ -185,12 +185,22 @@ class WorkerHostService:
         self._ports: Dict[str, int] = {}
         self._events: Dict[str, threading.Event] = {}
         self._worker_pins: Dict[str, list] = {}
+        self._shm_pins: Dict[str, list] = {}
+        self.shm_locate_count = 0    # observability/tests
         self.server = RpcServer(
             name=f"workerhost-{node.node_id.hex()[:6]}")
         self.server.register("register_worker", self._register_worker)
         self.server.register("ping", lambda _p: "pong")
         self.server.register("get_object", self._get_object)
         self.server.register("kv_get", self._kv_get)
+        # Plasma-client surface (plasma/client.cc parity): metadata over
+        # RPC, bytes through the worker's own mmap of the segment.
+        self.server.register("shm_info", self._shm_info)
+        self.server.register("shm_locate", self._shm_locate)
+        self.server.register("shm_release", self._shm_release)
+        self.server.register("shm_create", self._shm_create)
+        self.server.register("shm_seal", self._shm_seal)
+        self.server.register("shm_abort", self._shm_abort)
         # Client-runtime surface: process-mode workers drive the full
         # public API (nested .remote, put/get/wait, actors) through the
         # SAME handlers remote drivers use (client_service.py), with
@@ -254,6 +264,111 @@ class WorkerHostService:
 
     def _kv_get(self, key: bytes) -> Optional[bytes]:
         return self._node.cluster.gcs.kv.get(key)
+
+    # ---- shm client surface (plasma/client.cc parity) ------------------
+    def _native_store(self):
+        store = self._node.object_store
+        native = getattr(store, "_native", None)
+        return store, native
+
+    def _shm_info(self, _payload):
+        _store, native = self._native_store()
+        if native is None:
+            return None
+        return {"name": native.name, "capacity": native.capacity}
+
+    def _shm_locate(self, payload):
+        """(offset, size) of a sealed object; pins it (store-level AND
+        native) against eviction/spill while the worker reads through
+        its mapping.  Pin BEFORE reading the offset: native.pin fails
+        if the object was just freed, and once it succeeds the block
+        cannot move — so the returned (offset, size) can never be
+        stale.  Pins are released per-task (normal tasks) or on worker
+        death (actors / crashed workers)."""
+        store, native = self._native_store()
+        if native is None:
+            return None
+        oid = ObjectID(payload["object_id"])
+        entry = store.get(oid)
+        from ray_tpu._private.object_store import _NativeHandle
+        if entry is None or not isinstance(entry.data, _NativeHandle):
+            return None
+        store.pin(oid)                       # blocks python-side spill
+        if not native.pin(payload["object_id"]):
+            store.unpin(oid)                 # freed in the window
+            return None
+        loc = native.locate(payload["object_id"])
+        if loc is None:
+            native.unpin(payload["object_id"])
+            store.unpin(oid)
+            return None
+        with self._lock:
+            self._shm_pins.setdefault(payload["worker_id"], []).append(oid)
+            self.shm_locate_count += 1
+        return list(loc)
+
+    def _shm_release(self, payload):
+        store, native = self._native_store()
+        oid = ObjectID(payload["object_id"])
+        with self._lock:
+            pins = self._shm_pins.get(payload["worker_id"])
+            if not pins or oid not in pins:
+                return False      # not pinned by this worker: no-op
+            pins.remove(oid)
+        store.unpin(oid)
+        if native is not None:
+            native.unpin(payload["object_id"])
+        return True
+
+    def _shm_abort(self, payload):
+        """Drop a create-reservation whose write/seal failed — unsealed
+        entries are invisible to eviction and would leak forever."""
+        _store, native = self._native_store()
+        if native is not None:
+            native.delete(payload["object_id"])
+        return True
+
+    def _shm_create(self, payload):
+        """Reserve space for a worker-written return value; the worker
+        fills the bytes through its own mapping, then shm_seal."""
+        _store, native = self._native_store()
+        if native is None:
+            return None
+        off = native.create(payload["object_id"], int(payload["size"]))
+        return off
+
+    def _shm_seal(self, payload):
+        """Seal a worker-written object and register it in the node
+        store with owner semantics (the big-return path of
+        _store_returns, minus the socket copy)."""
+        from ray_tpu._private.object_store import InPlasmaMarker
+        store, native = self._native_store()
+        if native is None:
+            return False
+        key = payload["object_id"]
+        if not native.seal(key):
+            return False
+        oid = ObjectID(key)
+        size = int(payload["size"])
+        store.register_native_entry(oid, size)
+        self._node.cluster.object_directory.add_location(
+            oid, self._node.node_id)
+        core = self._node.core_worker
+        if core is not None:
+            core.memory_store.put(oid, InPlasmaMarker(self._node.node_id))
+        return True
+
+    def release_worker_shm_pins(self, worker_id_hex: str):
+        store, native = self._native_store()
+        with self._lock:
+            oids = self._shm_pins.pop(worker_id_hex, [])
+        for oid in oids:
+            try:
+                store.unpin(oid)
+                if native is not None:
+                    native.unpin(oid.binary())
+            except Exception:
+                pass
 
     def _core(self):
         core = self._node.core_worker
@@ -489,6 +604,11 @@ class ProcessWorker:
         core = self.node.core_worker
         for oid_bin, blob in returns:
             oid = ObjectID(oid_bin)
+            if blob is None:
+                # Worker wrote the value through the shm segment; the
+                # host's shm_seal handler already registered the store
+                # entry, directory location and memory-store marker.
+                continue
             serialized = SerializedObject.from_bytes(blob)
             if core is not None and \
                     serialized.total_bytes <= cfg.max_direct_call_object_size:
@@ -519,6 +639,7 @@ class ProcessWorker:
         if host is not None:
             try:
                 host.release_worker_pins(self.worker_id.hex())
+                host.release_worker_shm_pins(self.worker_id.hex())
             except Exception:
                 pass
         if self._client is not None:

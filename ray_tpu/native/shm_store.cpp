@@ -6,11 +6,13 @@
 //   * one flat shm segment with a first-fit free-list allocator
 //     (coalescing on free) instead of vendored dlmalloc;
 //   * the object table lives in process memory (the store is owned by the
-//     node daemon; clients in this runtime are threads, and future
-//     multi-process clients mmap the same segment read-only and receive
-//     (offset, size) handles — zero-copy reads, like plasma's clients);
-//   * eviction/spilling policy stays in the Python LocalObjectManager;
-//     this layer only reports usage.
+//     node daemon); process-mode worker clients mmap the same segment and
+//     receive (offset, size) handles over their RPC channel — zero-copy
+//     reads/writes, the plasma client model (plasma/client.cc);
+//   * LRU eviction policy (pin counts, victim selection,
+//     delete-while-pinned deferred free) is native
+//     (eviction_policy.h parity); the spill IO callback stays in the
+//     Python LocalObjectManager.
 //
 // Exposed as a C ABI for ctypes (no pybind11 in this image).
 
@@ -25,6 +27,8 @@
 #include <sys/stat.h>
 #include <unistd.h>
 #include <unordered_map>
+#include <vector>
+#include <algorithm>
 
 namespace {
 
@@ -37,6 +41,9 @@ struct ObjectEntry {
   uint64_t offset;
   uint64_t size;
   bool sealed;
+  uint32_t pin_count;
+  uint64_t lru_tick;  // global counter value at last touch
+  bool deleted;       // delete-while-pinned: freed on last unpin
 };
 
 class ShmStore {
@@ -66,14 +73,27 @@ class ShmStore {
     shm_unlink(name_.c_str());
   }
 
-  // Returns offset or -1 if out of memory / duplicate.
+  // Returns offset, -1 on OOM, -2 if already present, -3 if the key is
+  // in deleted-pending state (freed on last unpin; not re-usable yet).
   int64_t Put(const std::string& key, const uint8_t* data, uint64_t size) {
     std::lock_guard<std::mutex> g(mu_);
-    if (objects_.count(key)) return -2;  // already present
+    auto it = objects_.find(key);
+    if (it != objects_.end()) {
+      if (it->second.deleted) return -3;
+      if (!it->second.sealed && it->second.pin_count == 0) {
+        // Stale create-reservation (client write/seal failed): the
+        // bytes were never valid — reclaim and write fresh.
+        EraseLocked(it);
+      } else {
+        return -2;
+      }
+    }
     int64_t off = Allocate(Align(size));
     if (off < 0) return -1;
     std::memcpy(base_ + off, data, size);
-    objects_[key] = ObjectEntry{static_cast<uint64_t>(off), size, true};
+    objects_[key] =
+        ObjectEntry{static_cast<uint64_t>(off), size, true, 0, ++tick_,
+                    false};
     used_ += Align(size);
     return off;
   }
@@ -82,10 +102,13 @@ class ShmStore {
   // then seals) — the plasma create/seal lifecycle.
   int64_t Create(const std::string& key, uint64_t size) {
     std::lock_guard<std::mutex> g(mu_);
-    if (objects_.count(key)) return -2;
+    auto eit = objects_.find(key);
+    if (eit != objects_.end()) return eit->second.deleted ? -3 : -2;
     int64_t off = Allocate(Align(size));
     if (off < 0) return -1;
-    objects_[key] = ObjectEntry{static_cast<uint64_t>(off), size, false};
+    objects_[key] =
+        ObjectEntry{static_cast<uint64_t>(off), size, false, 0, ++tick_,
+                    false};
     used_ += Align(size);
     return off;
   }
@@ -99,22 +122,85 @@ class ShmStore {
   }
 
   // Returns (offset, size) through out params; -1 if missing/unsealed.
+  // Touches the LRU clock (eviction_policy.h parity: reads refresh).
   int Get(const std::string& key, uint64_t* offset, uint64_t* size) {
     std::lock_guard<std::mutex> g(mu_);
     auto it = objects_.find(key);
-    if (it == objects_.end() || !it->second.sealed) return -1;
+    if (it == objects_.end() || !it->second.sealed ||
+        it->second.deleted) {
+      return -1;
+    }
+    it->second.lru_tick = ++tick_;
     *offset = it->second.offset;
     *size = it->second.size;
     return 0;
+  }
+
+  int Pin(const std::string& key) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = objects_.find(key);
+    if (it == objects_.end() || it->second.deleted) return -1;
+    it->second.pin_count++;
+    return 0;
+  }
+
+  int Unpin(const std::string& key) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = objects_.find(key);
+    if (it == objects_.end() || it->second.pin_count == 0) return -1;
+    it->second.pin_count--;
+    if (it->second.pin_count == 0 && it->second.deleted) {
+      EraseLocked(it);
+    }
+    return 0;
+  }
+
+  // LRU victim selection (eviction_policy.h ChooseObjectsToEvict
+  // parity): pick least-recently-touched sealed+unpinned objects until
+  // >= needed bytes are covered (best effort — fewer bytes when little
+  // is evictable; the caller inspects covered_out).  Writes
+  // [u32 len][key bytes]* into out; returns #victims, or -2 if the
+  // out buffer is too small.
+  int ChooseVictims(uint64_t needed, uint8_t* out, uint32_t out_cap,
+                    uint64_t* covered_out) {
+    std::lock_guard<std::mutex> g(mu_);
+    std::vector<std::pair<uint64_t, const std::string*>> cand;
+    for (auto& kv : objects_) {
+      if (kv.second.sealed && kv.second.pin_count == 0 &&
+          !kv.second.deleted) {
+        cand.emplace_back(kv.second.lru_tick, &kv.first);
+      }
+    }
+    std::sort(cand.begin(), cand.end());
+    uint64_t covered = 0;
+    uint32_t pos = 0;
+    int n = 0;
+    for (auto& c : cand) {
+      if (covered >= needed) break;
+      const std::string& k = *c.second;
+      if (pos + 4 + k.size() > out_cap) return -2;
+      uint32_t len = static_cast<uint32_t>(k.size());
+      std::memcpy(out + pos, &len, 4);
+      std::memcpy(out + pos + 4, k.data(), k.size());
+      pos += 4 + len;
+      covered += Align(objects_[k].size);
+      n++;
+    }
+    *covered_out = covered;
+    return n;
   }
 
   int Delete(const std::string& key) {
     std::lock_guard<std::mutex> g(mu_);
     auto it = objects_.find(key);
     if (it == objects_.end()) return -1;
-    Free(it->second.offset, Align(it->second.size));
-    used_ -= Align(it->second.size);
-    objects_.erase(it);
+    if (it->second.pin_count > 0) {
+      // Deferred free (plasma release semantics): a client still reads
+      // through its mapping; hide the object and free on last unpin.
+      it->second.deleted = true;
+      return 0;
+    }
+    EraseLocked(it);
     return 0;
   }
 
@@ -129,6 +215,12 @@ class ShmStore {
 
  private:
   static uint64_t Align(uint64_t n) { return (n + 63) & ~uint64_t(63); }
+
+  void EraseLocked(std::unordered_map<std::string, ObjectEntry>::iterator it) {
+    Free(it->second.offset, Align(it->second.size));
+    used_ -= Align(it->second.size);
+    objects_.erase(it);
+  }
 
   // First-fit over the offset-ordered free map; splits the block.
   int64_t Allocate(uint64_t size) {
@@ -172,6 +264,7 @@ class ShmStore {
   std::unordered_map<std::string, ObjectEntry> objects_;
   std::map<uint64_t, uint64_t> free_by_offset_;  // offset -> size
   uint64_t used_ = 0;
+  uint64_t tick_ = 0;  // LRU clock
 };
 
 std::string MakeKey(const uint8_t* key, uint32_t keylen) {
@@ -223,6 +316,20 @@ uint64_t store_capacity(void* s) {
 
 uint64_t store_num_objects(void* s) {
   return static_cast<ShmStore*>(s)->NumObjects();
+}
+
+int store_pin(void* s, const uint8_t* key, uint32_t keylen) {
+  return static_cast<ShmStore*>(s)->Pin(MakeKey(key, keylen));
+}
+
+int store_unpin(void* s, const uint8_t* key, uint32_t keylen) {
+  return static_cast<ShmStore*>(s)->Unpin(MakeKey(key, keylen));
+}
+
+int store_choose_victims(void* s, uint64_t needed, uint8_t* out,
+                         uint32_t out_cap, uint64_t* covered) {
+  return static_cast<ShmStore*>(s)->ChooseVictims(needed, out, out_cap,
+                                                  covered);
 }
 
 }  // extern "C"

@@ -57,6 +57,22 @@ def _load() -> ctypes.CDLL:
     lib.store_capacity.argtypes = [ctypes.c_void_p]
     lib.store_num_objects.restype = ctypes.c_uint64
     lib.store_num_objects.argtypes = [ctypes.c_void_p]
+    lib.store_create.restype = ctypes.c_int64
+    lib.store_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_uint32, ctypes.c_uint64]
+    lib.store_seal.restype = ctypes.c_int
+    lib.store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_uint32]
+    lib.store_pin.restype = ctypes.c_int
+    lib.store_pin.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                              ctypes.c_uint32]
+    lib.store_unpin.restype = ctypes.c_int
+    lib.store_unpin.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_uint32]
+    lib.store_choose_victims.restype = ctypes.c_int
+    lib.store_choose_victims.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
+        ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint64)]
     return lib
 
 
@@ -84,6 +100,10 @@ class NativeShmStore:
                                  len(data))
         if rc == -1:
             raise MemoryError("native store full")
+        if rc == -3:
+            # Deleted-pending: a client still holds the old bytes
+            # pinned; the key is unusable until the last release.
+            raise KeyError("object key awaiting deferred free")
         if rc == -2:
             return  # idempotent re-put
 
@@ -98,6 +118,52 @@ class NativeShmStore:
 
     def delete(self, key: bytes) -> bool:
         return self._lib.store_delete(self._handle, key, len(key)) == 0
+
+    # ---- plasma create/seal lifecycle (client writes through shm) -----
+    def create(self, key: bytes, size: int) -> Optional[int]:
+        """Reserve `size` bytes; returns the offset the writer fills
+        through its own mapping, or None on OOM/duplicate."""
+        off = self._lib.store_create(self._handle, key, len(key), size)
+        return None if off < 0 else int(off)
+
+    def seal(self, key: bytes) -> bool:
+        return self._lib.store_seal(self._handle, key, len(key)) == 0
+
+    def locate(self, key: bytes) -> Optional[tuple]:
+        """(offset, size) of a sealed object, touching its LRU slot."""
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        rc = self._lib.store_get(self._handle, key, len(key),
+                                 ctypes.byref(off), ctypes.byref(size))
+        return None if rc != 0 else (off.value, size.value)
+
+    def pin(self, key: bytes) -> bool:
+        return self._lib.store_pin(self._handle, key, len(key)) == 0
+
+    def unpin(self, key: bytes) -> bool:
+        return self._lib.store_unpin(self._handle, key, len(key)) == 0
+
+    def choose_victims(self, needed: int):
+        """Best-effort LRU victims toward freeing >= needed bytes;
+        empty when nothing is evictable."""
+        cap = 1 << 20
+        buf = ctypes.create_string_buffer(cap)
+        covered = ctypes.c_uint64()
+        n = self._lib.store_choose_victims(
+            self._handle, needed, buf, cap, ctypes.byref(covered))
+        if n < 0:
+            return []
+        keys, pos = [], 0
+        raw = buf.raw
+        for _ in range(n):
+            ln = int.from_bytes(raw[pos:pos + 4], "little")
+            keys.append(raw[pos + 4:pos + 4 + ln])
+            pos += 4 + ln
+        return keys
+
+    @property
+    def name(self) -> str:
+        return self._name
 
     def used_bytes(self) -> int:
         return self._lib.store_used(self._handle)
@@ -123,3 +189,40 @@ class NativeShmStore:
 
 def open_store(capacity: int = 256 * 1024 * 1024) -> NativeShmStore:
     return NativeShmStore(capacity=capacity)
+
+
+class AttachedSegment:
+    """Client-side mapping of a store segment owned by another process
+    (plasma client model, ``plasma/client.cc``): metadata — offsets,
+    pins, create/seal — travels over the worker's RPC channel to the
+    node; the BYTES are read and written directly through mmaps,
+    zero-copy.
+
+    Two mappings: reads go through a READ-ONLY map, so deserialized
+    arrays are read-only views (plasma maps client reads read-only for
+    the same reason — an in-place ``a += 1`` on a task arg must raise,
+    not silently corrupt the shared object); create/seal writes go
+    through a separate read-write map."""
+
+    def __init__(self, name: str, capacity: int):
+        self.name = name
+        self.capacity = capacity
+        fd = os.open(f"/dev/shm{name}", os.O_RDWR)
+        try:
+            self._ro = mmap.mmap(fd, capacity, prot=mmap.PROT_READ)
+            self._rw = mmap.mmap(fd, capacity)
+        finally:
+            os.close(fd)
+
+    def read(self, offset: int, size: int) -> memoryview:
+        return memoryview(self._ro)[offset:offset + size]
+
+    def write(self, offset: int, data) -> None:
+        self._rw[offset:offset + len(data)] = data
+
+    def close(self):
+        for mm in (self._ro, self._rw):
+            try:
+                mm.close()
+            except (BufferError, ValueError):
+                pass
