@@ -8,10 +8,17 @@ shipping, actor-fleet sampling).  Algorithms beyond PPO follow the same
 WorkerSet + jit-learner shape.
 """
 
+from ray_tpu.rllib.dqn import DQNTrainer, QPolicy, TransitionWorker
 from ray_tpu.rllib.env import CartPole
+from ray_tpu.rllib.impala import IMPALATrainer, TrajectoryWorker
 from ray_tpu.rllib.policy import ActorCritic, compute_gae
 from ray_tpu.rllib.ppo import DEFAULT_CONFIG, PPOTrainer
+from ray_tpu.rllib.replay_buffer import (PrioritizedReplayBuffer,
+                                         ReplayBuffer)
 from ray_tpu.rllib.rollout_worker import RolloutWorker, WorkerSet
 
 __all__ = ["CartPole", "ActorCritic", "compute_gae", "PPOTrainer",
-           "DEFAULT_CONFIG", "RolloutWorker", "WorkerSet"]
+           "DEFAULT_CONFIG", "RolloutWorker", "WorkerSet",
+           "DQNTrainer", "QPolicy", "TransitionWorker",
+           "IMPALATrainer", "TrajectoryWorker",
+           "ReplayBuffer", "PrioritizedReplayBuffer"]

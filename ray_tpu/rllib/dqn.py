@@ -1,0 +1,271 @@
+"""DQNTrainer: double DQN with (prioritized) replay over the fleet.
+
+Parity: reference ``rllib/agents/dqn/dqn.py`` (Trainer: epsilon-greedy
+exploration schedule, replay buffer, target network sync, the
+store->replay->train execution plan) — jax-first: the TD update is one
+jit program (double-DQN targets, Huber loss, IS weights), transitions
+are columnar numpy, and sampling scales as framework actors.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.policy import _jx, init_mlp_params, mlp_apply
+from ray_tpu.rllib.replay_buffer import (PrioritizedReplayBuffer,
+                                         ReplayBuffer)
+
+DEFAULT_CONFIG: Dict = {
+    "num_workers": 2,
+    "rollout_fragment_length": 64,     # steps per worker per round
+    "buffer_size": 50_000,
+    "prioritized_replay": True,
+    "learning_starts": 500,            # min transitions before SGD
+    "train_batch_size": 64,
+    "sgd_rounds_per_iter": 32,         # minibatches per train()
+    "target_network_update_freq": 300,  # SGD steps between target syncs
+    "gamma": 0.99,
+    "lr": 1e-3,
+    "hidden": (64, 64),
+    "epsilon_initial": 1.0,
+    "epsilon_final": 0.05,
+    "epsilon_timesteps": 4_000,        # linear decay horizon
+    "double_q": True,
+    "seed": 0,
+}
+
+
+class QPolicy:
+    """Q-network with jit-compiled epsilon-greedy action selection and
+    double-DQN TD update (dqn_tf_policy.py / dqn_torch_policy.py
+    parity, as pure jax functions)."""
+
+    def __init__(self, obs_size: int, num_actions: int,
+                 hidden=(64, 64), lr: float = 1e-3, gamma: float = 0.99,
+                 double_q: bool = True, seed: int = 0):
+        import optax
+        jax, jnp = _jx()
+        self.num_actions = num_actions
+        self.params = init_mlp_params(seed, [obs_size, *hidden,
+                                             num_actions])
+        self.target_params = jax.tree_util.tree_map(lambda x: x,
+                                                    self.params)
+        self._opt = optax.adam(lr)
+        self.opt_state = self._opt.init(self.params)
+
+        @jax.jit
+        def act(params, obs, epsilon, key):
+            q = mlp_apply(params, obs)                 # [B, A]
+            greedy = jnp.argmax(q, axis=-1)
+            k1, k2 = jax.random.split(key)
+            random_a = jax.random.randint(
+                k1, greedy.shape, 0, num_actions)
+            explore = jax.random.uniform(k2, greedy.shape) < epsilon
+            return jnp.where(explore, random_a, greedy)
+
+        @jax.jit
+        def update(params, target_params, opt_state, batch):
+            def loss_fn(p):
+                q = mlp_apply(p, batch["obs"])
+                q_sa = q[jnp.arange(q.shape[0]), batch["actions"]]
+                q_next_t = mlp_apply(target_params, batch["next_obs"])
+                if double_q:
+                    # Online net selects, target net evaluates.
+                    a_star = jnp.argmax(
+                        mlp_apply(p, batch["next_obs"]), axis=-1)
+                    q_next = q_next_t[jnp.arange(q.shape[0]), a_star]
+                else:
+                    q_next = jnp.max(q_next_t, axis=-1)
+                target = batch["rewards"] + gamma * \
+                    (1.0 - batch["dones"]) * q_next
+                td = q_sa - jax.lax.stop_gradient(target)
+                huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td ** 2,
+                                  jnp.abs(td) - 0.5)
+                w = batch.get("weights", jnp.ones_like(huber))
+                return jnp.mean(w * huber), td
+
+            (loss, td), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = self._opt.update(grads, opt_state)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, td
+
+        self._act = act
+        self._update = update
+        self._key = jax.random.PRNGKey(seed + 2)
+
+    def compute_actions(self, obs: np.ndarray,
+                        epsilon: float = 0.0) -> np.ndarray:
+        jax, _ = _jx()
+        self._key, sub = jax.random.split(self._key)
+        return np.asarray(self._act(self.params, obs,
+                                    np.float32(epsilon), sub))
+
+    def sgd_step(self, batch: Dict[str, np.ndarray]):
+        import jax.numpy as jnp
+        jb = {k: jnp.asarray(v) for k, v in batch.items()
+              if k != "indices"}
+        self.params, self.opt_state, loss, td = self._update(
+            self.params, self.target_params, self.opt_state, jb)
+        return float(loss), np.asarray(td)
+
+    def sync_target(self):
+        import jax
+        self.target_params = jax.tree_util.tree_map(lambda x: x,
+                                                    self.params)
+
+    def get_weights(self) -> Dict:
+        import jax
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
+    def set_weights(self, weights: Dict):
+        self.params = weights
+
+
+@ray_tpu.remote
+class TransitionWorker:
+    """Sampler for off-policy learners: steps its env epsilon-greedily
+    and returns raw transition batches (obs, action, reward, next_obs,
+    done) — the store->replay half of the DQN execution plan."""
+
+    def __init__(self, env_fn: Callable, policy_config: Dict,
+                 seed: int = 0):
+        from ray_tpu.rllib.rollout_worker import EnvLoop
+        self.loop = EnvLoop(env_fn())
+        self.policy = QPolicy(seed=seed, **policy_config)
+
+    def set_weights(self, weights: Dict):
+        self.policy.set_weights(weights)
+        return True
+
+    def sample(self, num_steps: int, epsilon: float
+               ) -> Dict[str, np.ndarray]:
+        obs_dim = len(self.loop.obs)
+        cols = {
+            "obs": np.zeros((num_steps, obs_dim), np.float32),
+            "actions": np.zeros(num_steps, np.int32),
+            "rewards": np.zeros(num_steps, np.float32),
+            "next_obs": np.zeros((num_steps, obs_dim), np.float32),
+            "dones": np.zeros(num_steps, np.float32),
+        }
+
+        def policy_step(obs):
+            return int(self.policy.compute_actions(
+                obs[None, :], epsilon)[0]), None
+
+        def record(t, obs, action, reward, nxt, done, _extras):
+            cols["obs"][t] = obs
+            cols["actions"][t] = action
+            cols["rewards"][t] = reward
+            cols["next_obs"][t] = nxt
+            cols["dones"][t] = float(done)
+
+        self.loop.run(num_steps, policy_step, record)
+        cols["episode_rewards"] = self.loop.drain_episode_rewards()
+        return cols
+
+
+class DQNTrainer:
+    """The collect -> replay -> train loop (dqn.py execution plan)."""
+
+    def __init__(self, env_fn: Callable, config: Optional[Dict] = None):
+        self.config = dict(DEFAULT_CONFIG)
+        self.config.update(config or {})
+        cfg = self.config
+        probe = env_fn()
+        self._policy_config = {
+            "obs_size": probe.observation_size,
+            "num_actions": probe.num_actions,
+            "hidden": tuple(cfg["hidden"]),
+            "lr": cfg["lr"],
+            "gamma": cfg["gamma"],
+            "double_q": cfg["double_q"],
+        }
+        self.policy = QPolicy(seed=cfg["seed"], **self._policy_config)
+        self.workers = [
+            TransitionWorker.remote(env_fn, self._policy_config,
+                                    seed=2000 + i)
+            for i in range(cfg["num_workers"])]
+        if cfg["prioritized_replay"]:
+            self.buffer = PrioritizedReplayBuffer(
+                cfg["buffer_size"], seed=cfg["seed"])
+        else:
+            self.buffer = ReplayBuffer(cfg["buffer_size"],
+                                       seed=cfg["seed"])
+        self.iteration = 0
+        self.timesteps_total = 0
+        self._sgd_steps = 0
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self.timesteps_total / cfg["epsilon_timesteps"])
+        return cfg["epsilon_initial"] + frac * (
+            cfg["epsilon_final"] - cfg["epsilon_initial"])
+
+    def train(self) -> Dict:
+        cfg = self.config
+        eps = self._epsilon()
+        weights = self.policy.get_weights()
+        ray_tpu.get([w.set_weights.remote(weights)
+                     for w in self.workers])
+        batches = ray_tpu.get([
+            w.sample.remote(cfg["rollout_fragment_length"], eps)
+            for w in self.workers])
+        episode_rewards = np.concatenate(
+            [b.pop("episode_rewards") for b in batches])
+        for b in batches:
+            n = len(b["obs"])
+            self.buffer.add_batch(b)
+            self.timesteps_total += n
+
+        loss = float("nan")
+        if len(self.buffer) >= cfg["learning_starts"]:
+            for _ in range(cfg["sgd_rounds_per_iter"]):
+                batch = self.buffer.sample(cfg["train_batch_size"])
+                loss, td = self.policy.sgd_step(batch)
+                if isinstance(self.buffer, PrioritizedReplayBuffer):
+                    self.buffer.update_priorities(batch["indices"], td)
+                self._sgd_steps += 1
+                if self._sgd_steps % \
+                        cfg["target_network_update_freq"] == 0:
+                    self.policy.sync_target()
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "timesteps_total": self.timesteps_total,
+            "buffer_size": len(self.buffer),
+            "epsilon": eps,
+            "loss": loss,
+            "episodes_this_iter": len(episode_rewards),
+            "episode_reward_mean": float(episode_rewards.mean())
+            if len(episode_rewards) else float("nan"),
+        }
+
+    def compute_action(self, obs: np.ndarray) -> int:
+        return int(self.policy.compute_actions(
+            np.asarray(obs, np.float32)[None, :], epsilon=0.0)[0])
+
+    def save(self, path: str) -> str:
+        with open(path, "wb") as f:
+            pickle.dump({"weights": self.policy.get_weights(),
+                         "iteration": self.iteration,
+                         "timesteps_total": self.timesteps_total,
+                         "config": self.config}, f)
+        return path
+
+    def restore(self, path: str):
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        self.policy.set_weights(state["weights"])
+        self.policy.sync_target()
+        self.iteration = state["iteration"]
+        self.timesteps_total = state["timesteps_total"]
+
+    def stop(self):
+        for w in self.workers:
+            ray_tpu.kill(w)
+        self.workers = []
