@@ -10,8 +10,11 @@ the env's stable hash (``src/ray/raylet/worker_pool.h:428``).
 
 Supported fields: ``env_vars`` (dict), ``working_dir`` (local directory,
 packaged + materialized), ``py_modules`` (list of local dirs, packaged +
-put on the import path).  ``pip``/``conda`` are validated but rejected —
-this image has no network egress; environments must be pre-baked.
+put on the import path), ``pip`` (requirement list; local wheel paths
+are shipped through the KV and installed into a cached per-hash venv on
+the executing node — reference ``runtime_env/pip.py``.  Name-only
+requirements need network egress, which this image lacks: use local
+wheels).  ``conda`` is rejected.
 
 Isolation depends on the worker mode: ``process`` workers get env vars /
 cwd / import path injected at spawn (full isolation); ``thread`` workers
@@ -50,10 +53,19 @@ def validate(spec: dict) -> dict:
             out["env_vars"] = dict(value)
         elif key in ("working_dir", "py_modules"):
             out[key] = value
-        elif key in ("pip", "conda"):
+        elif key == "pip":
+            if isinstance(value, dict):
+                value = value.get("packages", [])
+            if not isinstance(value, (list, tuple)) or not all(
+                    isinstance(r, str) for r in value):
+                raise RuntimeEnvError(
+                    "pip must be a list of requirement strings")
+            out["pip"] = sorted(value)
+        elif key == "conda":
             raise RuntimeEnvError(
-                f"runtime_env[{key!r}] is not supported: no network egress; "
-                "bake dependencies into the image")
+                "runtime_env['conda'] is not supported: no network "
+                "egress; use pip with local wheels, or bake "
+                "dependencies into the image")
         else:
             raise RuntimeEnvError(f"Unknown runtime_env field {key!r}")
     return out
@@ -125,6 +137,17 @@ def framework_import_root() -> str:
         os.path.abspath(ray_tpu.__file__)))
 
 
+def package_file(path: str, kv) -> str:
+    """Store a single local file (e.g. a wheel) in the GCS KV; returns
+    ``pkg://<digest>/<basename>`` so the materializing node can restore
+    it under its original filename (pip needs the wheel name intact)."""
+    with open(path, "rb") as f:
+        blob = f.read()
+    digest = hashlib.sha256(blob).hexdigest()[:20]
+    kv.put(_PKG_PREFIX + digest.encode(), blob, overwrite=False)
+    return f"pkg://{digest}/{os.path.basename(path)}"
+
+
 def normalize(spec: Optional[dict], kv) -> Optional[dict]:
     """Validate + package local paths into URIs + stamp the stable hash
     the worker pool keys on.  Call once at submission time."""
@@ -139,6 +162,24 @@ def normalize(spec: Optional[dict], kv) -> Optional[dict]:
         out["py_modules"] = [
             m if str(m).startswith("pkg://") else package_dir(m, kv)
             for m in mods]
+    if out.get("pip"):
+        # Requirements that are local wheel files exist only on the
+        # SUBMITTING machine — ship them through the KV so the
+        # executing node can install offline (reference pip.py ships a
+        # requirements file; local wheels are this image's only
+        # network-free install source).
+        packed = []
+        for r in out["pip"]:
+            if r.endswith(".whl") and not r.startswith("pkg://"):
+                if not os.path.isfile(r):
+                    # Fail at SUBMISSION, naming the file — deferring
+                    # ships the bad path and errors in a remote worker.
+                    raise RuntimeEnvError(
+                        f"pip wheel not found: {r!r}")
+                packed.append(package_file(r, kv))
+            else:
+                packed.append(r)
+        out["pip"] = sorted(packed)
     out["_hash"] = env_hash(out)
     return out
 
@@ -205,6 +246,83 @@ def _extract_uri(uri: str, kv, dest_root: str) -> str:
             fcntl.flock(lock_f, fcntl.LOCK_UN)
 
 
+def _restore_wheel(uri: str, kv, dest_root: str) -> str:
+    """pkg://digest/name.whl -> local wheel path under dest_root."""
+    rest = uri[len("pkg://"):]
+    digest, _, name = rest.partition("/")
+    # Digest as a subdirectory: pip requires the wheel FILENAME intact.
+    wheel_dir = os.path.join(dest_root, "wheels", digest)
+    os.makedirs(wheel_dir, exist_ok=True)
+    dest = os.path.join(wheel_dir, name)
+    if not os.path.exists(dest):
+        import uuid
+        blob = kv.get(_PKG_PREFIX + digest.encode())
+        if blob is None:
+            raise RuntimeEnvError(f"wheel {uri} not found in GCS KV")
+        # Unique tmp name: two pip specs sharing a wheel can restore
+        # it concurrently (their flocks are keyed by DIFFERENT
+        # req-hashes); os.replace makes the landing atomic either way.
+        tmp = f"{dest}.{os.getpid()}.{uuid.uuid4().hex[:8]}.tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, dest)
+    return dest
+
+
+def materialize_pip(requirements: List[str], kv,
+                    dest_root: str) -> str:
+    """Cached env dir per requirements-hash (reference pip.py: one
+    virtual env per runtime_env, reused across tasks): pip-install the
+    requirements into a private site dir (``--no-index`` when every
+    requirement is a shipped wheel — this image has no egress) and
+    return it for PYTHONPATH injection.
+
+    Idempotent + cross-process locked like package extraction."""
+    import fcntl
+    import subprocess
+    import sys
+
+    req_hash = hashlib.sha256(
+        json.dumps(sorted(requirements)).encode()).hexdigest()[:16]
+    venv_root = os.path.join(dest_root, "venvs")
+    venv_dir = os.path.join(venv_root, req_hash)
+    marker = os.path.join(venv_dir, ".materialized")
+    site = os.path.join(
+        venv_dir, "lib",
+        f"python{sys.version_info.major}.{sys.version_info.minor}",
+        "site-packages")
+    if os.path.exists(marker):
+        return site
+    os.makedirs(venv_root, exist_ok=True)
+    with open(os.path.join(venv_root, f".{req_hash}.lock"), "w") as lf:
+        fcntl.flock(lf, fcntl.LOCK_EX)
+        try:
+            if os.path.exists(marker):
+                return site
+            local = [
+                _restore_wheel(r, kv, dest_root)
+                if r.startswith("pkg://") else r for r in requirements]
+            all_wheels = all(r.endswith(".whl") for r in local)
+            os.makedirs(site, exist_ok=True)
+            # Install with THIS interpreter's pip targeted at the env's
+            # own site dir (cheaper than a full `python -m venv` +
+            # ensurepip bootstrap, identical import-path result).
+            cmd = [sys.executable, "-m", "pip", "install", "--quiet",
+                   "--target", site]
+            if all_wheels:
+                cmd += ["--no-index", "--no-deps"]
+            cmd += local
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeEnvError(
+                    f"pip install failed for runtime_env: "
+                    f"{proc.stderr[-1500:]}")
+            open(marker, "w").close()
+            return site
+        finally:
+            fcntl.flock(lf, fcntl.LOCK_UN)
+
+
 def materialize(spec: Optional[dict], kv,
                 dest_root: Optional[str] = None) -> RuntimeEnvContext:
     """Download + extract the env's packages on this node; idempotent
@@ -222,6 +340,9 @@ def materialize(spec: Optional[dict], kv,
         import_paths.append(cwd)
     for uri in spec.get("py_modules") or []:
         import_paths.append(_extract_uri(uri, kv, dest_root))
+    if spec.get("pip"):
+        import_paths.append(
+            materialize_pip(list(spec["pip"]), kv, dest_root))
     return RuntimeEnvContext(dict(spec.get("env_vars") or {}), cwd,
                              import_paths)
 

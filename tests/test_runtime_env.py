@@ -17,9 +17,13 @@ class TestValidation:
         with pytest.raises(RuntimeEnvError, match="Dict\\[str, str\\]"):
             validate({"env_vars": {"A": 1}})
 
-    def test_pip_rejected(self):
-        with pytest.raises(RuntimeEnvError, match="no network egress"):
-            validate({"pip": ["requests"]})
+    def test_pip_accepted_conda_rejected(self):
+        assert validate({"pip": ["b", "a"]})["pip"] == ["a", "b"]
+        assert validate({"pip": {"packages": ["x"]}})["pip"] == ["x"]
+        with pytest.raises(RuntimeEnvError, match="requirement strings"):
+            validate({"pip": [1]})
+        with pytest.raises(RuntimeEnvError, match="no network"):
+            validate({"conda": {"dependencies": ["x"]}})
 
     def test_unknown_field_rejected(self):
         with pytest.raises(RuntimeEnvError, match="Unknown"):
@@ -134,3 +138,76 @@ class TestProcessModeEnv:
         # Same env reuses the worker.
         t1b, p1b = ray_tpu.get(tag_one.remote(), timeout=60)
         assert t1b == "one" and p1b == p1
+
+
+def _make_wheel(tmp_path, name="tinydep", version="1.0",
+                payload="VALUE = 42\n"):
+    """Hermetic wheel construction (a wheel is a zip with dist-info) —
+    no network, no build backend."""
+    import zipfile
+    whl = tmp_path / f"{name}-{version}-py3-none-any.whl"
+    dist = f"{name}-{version}.dist-info"
+    with zipfile.ZipFile(whl, "w") as zf:
+        zf.writestr(f"{name}/__init__.py", payload)
+        zf.writestr(f"{dist}/METADATA",
+                    f"Metadata-Version: 2.1\nName: {name}\n"
+                    f"Version: {version}\n")
+        zf.writestr(f"{dist}/WHEEL",
+                    "Wheel-Version: 1.0\nGenerator: test\nRoot-Is-"
+                    "Purelib: true\nTag: py3-none-any\n")
+        zf.writestr(f"{dist}/RECORD", "")
+    return str(whl)
+
+
+class TestPipRuntimeEnv:
+    """pip: via cached per-hash venv on the executing node (reference
+    runtime_env/pip.py).  Wheels ship through the GCS KV, so the task
+    imports a package that exists NOWHERE on the host import path."""
+
+    def test_task_imports_wheel_absent_from_host_env(
+            self, ray_start_regular, tmp_path):
+        whl = _make_wheel(tmp_path, payload="VALUE = 42\n")
+
+        @ray_tpu.remote(runtime_env={"pip": [whl]})
+        def use_dep():
+            import tinydep
+            return tinydep.VALUE
+
+        with pytest.raises(ImportError):
+            import tinydep  # noqa: F401 — must NOT exist host-side
+        assert ray_tpu.get(use_dep.remote(), timeout=120) == 42
+
+    def test_venv_cached_per_hash(self, ray_start_regular, tmp_path):
+        from ray_tpu._private import runtime_env as re_mod
+        from ray_tpu._private.worker import global_worker
+        kv = global_worker().cluster.gcs.kv
+        whl = _make_wheel(tmp_path, name="cachedep",
+                          payload="VALUE = 7\n")
+        spec = re_mod.normalize({"pip": [whl]}, kv)
+        dest = str(tmp_path / "envroot")
+        site1 = re_mod.materialize_pip(list(spec["pip"]), kv, dest)
+        import os as os_mod
+        marker = os_mod.path.join(os_mod.path.dirname(
+            os_mod.path.dirname(os_mod.path.dirname(site1))),
+            ".materialized")
+        mtime = os_mod.path.getmtime(site1)
+        site2 = re_mod.materialize_pip(list(spec["pip"]), kv, dest)
+        assert site1 == site2
+        assert os_mod.path.getmtime(site1) == mtime   # no re-install
+        assert os_mod.path.isdir(os_mod.path.join(site1, "cachedep"))
+        _ = marker
+
+    def test_process_mode_worker_gets_pip_env(self, process_cluster,
+                                              tmp_path):
+        whl = _make_wheel(tmp_path, name="procdep",
+                          payload="VALUE = 'proc'\n")
+
+        @ray_tpu.remote(runtime_env={"pip": [whl]})
+        def use_dep():
+            import os
+            import procdep
+            return procdep.VALUE, os.getpid()
+
+        value, pid = ray_tpu.get(use_dep.remote(), timeout=120)
+        assert value == "proc"
+        assert pid != __import__("os").getpid()
