@@ -120,7 +120,6 @@ class CoreWorker:
     def put_return_value(self, object_id: ObjectID, value: Any, node) -> int:
         """Store a task return (small -> owner memory store 'inline reply';
         big -> executing node's store + directory)."""
-        cfg = get_config()
         if _is_device_array(value):
             data = DeviceObject(value)
             node.object_store.put(object_id, data)
@@ -132,15 +131,23 @@ class CoreWorker:
         if contained:
             self.reference_counter.add_owned_object(
                 object_id, contained_ids=contained)
-        if serialized.total_bytes <= cfg.max_direct_call_object_size:
+        self.put_serialized_return(object_id, serialized, node)
+        return serialized.total_bytes
+
+    def put_serialized_return(self, object_id: ObjectID, serialized,
+                              node):
+        """Owner-side landing of an already-serialized return: small
+        values seal the memory store directly; big ones go to the
+        executing node's store, the directory, and an InPlasmaMarker so
+        owner-side gets unblock quickly."""
+        if serialized.total_bytes <= \
+                get_config().max_direct_call_object_size:
             self.memory_store.put(object_id, serialized)
         else:
             node.object_store.put(object_id, serialized)
             self.cluster.object_directory.add_location(object_id,
                                                        node.node_id)
-            # Seal a location marker so owner-side gets unblock quickly.
             self.memory_store.put(object_id, InPlasmaMarker(node.node_id))
-        return serialized.total_bytes
 
     def get(self, refs: Sequence[ObjectRef],
             timeout: Optional[float] = None) -> List[Any]:

@@ -308,8 +308,12 @@ class HeadService:
         s.register("get_locations", self._handle_get_locations)
         s.register("get_node_address", self._handle_get_node_address)
         s.register_async("wait_object", self._handle_wait_object)
-        s.register("publish", self._handle_publish)
         s.register("ping", lambda _p: "pong")
+        # Long-poll batched pubsub (src/ray/pubsub parity): remote
+        # subscribers long-poll one mailbox each; remote publishers
+        # (worker-log streams from spokes) arrive as batches.
+        from ray_tpu.gcs.wire_pubsub import WirePubsubService
+        self.pubsub_service = WirePubsubService(cluster.gcs.publisher, s)
         # Chunked object plane (pull_manager/push_manager parity): any
         # object size crosses the wire as chunk frames with per-chunk
         # acks and sender-side admission control.
@@ -396,12 +400,6 @@ class HeadService:
     # ---- KV ------------------------------------------------------------
     def _handle_kv_get(self, key: bytes) -> Optional[bytes]:
         return self._cluster.gcs.kv.get(key)
-
-    def _handle_publish(self, payload) -> bool:
-        """Generic pubsub forward from a spoke (worker logs ride this)."""
-        self._cluster.gcs.publisher.publish(
-            payload["channel"], payload["key"], payload["message"])
-        return True
 
     # ---- object plane --------------------------------------------------
     def _owner_inline_blob(self, oid: ObjectID) -> Optional[bytes]:

@@ -158,11 +158,11 @@ def release_local_monitor() -> None:
         monitor.stop()
 
 
-def mirror_worker_logs(publisher,
-                       out=None, err=None) -> int:
-    """Driver side: print every published worker log line with a
-    ``(worker=..., pid=...)`` prefix (reference worker.py
-    print_worker_logs).  Returns the subscription id."""
+def make_log_mirror_callback(out=None, err=None):
+    """The driver-side mirror: prints a published worker log message
+    with a ``(worker=..., pid=...)`` prefix (reference worker.py
+    print_worker_logs).  Shared by in-process subscriptions and the
+    remote driver's long-poll subscriber."""
 
     def cb(_key, msg):
         try:
@@ -175,4 +175,10 @@ def mirror_worker_logs(publisher,
         except Exception:
             pass
 
-    return publisher.subscribe(LOG_CHANNEL, None, cb)
+    return cb
+
+
+def mirror_worker_logs(publisher, out=None, err=None) -> int:
+    """In-process driver: subscribe the mirror to the GCS publisher."""
+    return publisher.subscribe(LOG_CHANNEL, None,
+                               make_log_mirror_callback(out, err))

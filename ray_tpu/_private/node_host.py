@@ -53,17 +53,18 @@ class _RemoteActorManager:
 
 
 class _RemotePublisher:
-    """Fire-and-forget pubsub publish forwarded to the head's GCS
-    publisher (the worker-log stream rides this)."""
+    """Pubsub publishes forwarded to the head's GCS publisher with
+    long-poll-style batching: at most one RPC in flight, everything
+    behind it rides the next flush (the worker-log stream spams this —
+    reference publisher.h O(#subscribers) property, mirrored on the
+    publish side)."""
 
     def __init__(self, host: "NodeHost"):
-        self._host = host
+        from ray_tpu.gcs.wire_pubsub import BatchingPublisher
+        self._batcher = BatchingPublisher(host.client)
 
     def publish(self, channel: str, key: bytes, message):
-        self._host.client.call_async(
-            "publish", {"channel": channel, "key": key,
-                        "message": message},
-            lambda _r, _e: None)
+        self._batcher.publish(channel, key, message)
 
 
 class _RemoteGcs:
@@ -358,10 +359,16 @@ class _RemoteCoreWorker:
                 timeout=remaining + 10.0)
 
     def put_return_value(self, object_id: ObjectID, value, node) -> int:
-        from ray_tpu._private.config import get_config
         serialized = serialize(value)
+        self.put_serialized_return(object_id, serialized, node)
+        return serialized.total_bytes
+
+    def put_serialized_return(self, object_id: ObjectID, serialized,
+                              node):
+        """Owner lives on the head: ship small returns to its memory
+        store (inline reply), register big ones in the directory."""
+        from ray_tpu._private.config import get_config
         if serialized.total_bytes <= get_config().max_direct_call_object_size:
-            # Small: ship to the owner's memory store (inline reply).
             self._host.client.call(
                 "put_inline",
                 {"object_id": object_id.binary(),
@@ -374,7 +381,6 @@ class _RemoteCoreWorker:
                 {"object_id": object_id.binary(),
                  "node_id": node.node_id.binary()},
                 timeout=30.0)
-        return serialized.total_bytes
 
     def recover_object(self, object_id) -> bool:
         return False

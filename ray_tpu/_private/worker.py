@@ -84,6 +84,16 @@ def init(address: Optional[str] = None, *, num_cpus: Optional[float] = None,
             w.client_connection = RpcClient((host, int(port)))
             client_runtime.install(w.client_connection)
             w.namespace = namespace or w.namespace
+            if get_config().log_to_driver:
+                # Worker log lines reach the remote driver over the
+                # long-poll batched pubsub (one outstanding poll).
+                from ray_tpu._private.log_monitor import LOG_CHANNEL
+                from ray_tpu.gcs.wire_pubsub import SubscriberClient
+                from ray_tpu._private import log_monitor as lm
+                sub = SubscriberClient(w.client_connection)
+                sub.subscribe(LOG_CHANNEL, None,
+                              lm.make_log_mirror_callback())
+                w.client_log_sub = sub
             atexit.register(_atexit_shutdown)
             return RuntimeContextInfo(w)
         from ray_tpu._private.cluster import Cluster
@@ -128,6 +138,13 @@ def shutdown():
         return
     with _init_lock:
         if w.mode == "client":
+            sub = getattr(w, "client_log_sub", None)
+            if sub is not None:
+                try:
+                    sub.close()
+                except Exception:
+                    pass
+                w.client_log_sub = None
             try:
                 w.client_connection.close()
             except Exception:

@@ -598,9 +598,7 @@ class ProcessWorker:
         }
 
     def _store_returns(self, returns):
-        from ray_tpu._private.object_store import InPlasmaMarker
         from ray_tpu._private.serialization import SerializedObject
-        cfg = get_config()
         core = self.node.core_worker
         for oid_bin, blob in returns:
             oid = ObjectID(oid_bin)
@@ -609,16 +607,12 @@ class ProcessWorker:
                 # host's shm_seal handler already registered the store
                 # entry, directory location and memory-store marker.
                 continue
-            serialized = SerializedObject.from_bytes(blob)
-            if core is not None and \
-                    serialized.total_bytes <= cfg.max_direct_call_object_size:
-                core.memory_store.put(oid, serialized)
-            else:
-                self.node.object_store.put(oid, serialized)
-                self.node.cluster.object_directory.add_location(
-                    oid, self.node_id)
-                if core is not None:
-                    core.memory_store.put(oid, InPlasmaMarker(self.node_id))
+            # Owner-correct return storage for BOTH node flavors: the
+            # head's CoreWorker seals its own memory store; a spoke's
+            # core shim ships small returns to the owner over the wire
+            # (put_inline) and directory-registers big ones.
+            core.put_serialized_return(
+                oid, SerializedObject.from_bytes(blob), self.node)
 
     def _fail_until_exit(self, reason: str):
         while not self._killed.is_set():

@@ -1,0 +1,284 @@
+"""Wire pubsub: long-poll batching over the framed RPC.
+
+Parity: reference ``src/ray/pubsub/`` (``publisher.h`` /
+``subscriber.h`` and the protocol described in ``pubsub/README.md``):
+the publisher keeps ONE mailbox per remote subscriber and answers ONE
+outstanding long-poll per subscriber with every buffered message at
+once — connection and message count are O(#subscribers), not
+O(#events).  The remote-PUBLISHER direction (a spoke's worker-log
+stream) batches symmetrically: at most one publish RPC in flight per
+node, everything that accumulates behind it rides the next flush.
+
+Server side registers on any RpcServer via :class:`WirePubsubService`;
+clients use :class:`SubscriberClient` (one poll loop per connection,
+any number of channel subscriptions) and :class:`BatchingPublisher`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+# Keepalive: a parked poll is answered empty after this long so the
+# subscriber's RPC future never looks wedged (reference long-poll
+# timeout behavior).
+_POLL_PARK_S = 30.0
+
+
+# A subscriber that has not polled for this long is presumed dead and
+# evicted (reference: the publisher drops subscribers whose long-poll
+# goes silent) — fire-and-forget unsubscribes can be lost on abrupt
+# disconnect, and an undrained mailbox must not grow forever.
+_SUBSCRIBER_TTL_S = 120.0
+
+
+class _RemoteSub:
+    __slots__ = ("mailbox", "pending", "pub_sub_ids", "timer",
+                 "last_seen")
+
+    def __init__(self):
+        import time
+        self.mailbox: List[dict] = []
+        self.pending: Optional[Callable] = None     # parked poll reply
+        self.pub_sub_ids: Dict[Tuple[str, Optional[bytes]], int] = {}
+        self.timer: Optional[threading.Timer] = None
+        self.last_seen = time.monotonic()
+
+
+class WirePubsubService:
+    """Publisher half: bridges a wire surface onto the in-process
+    :class:`ray_tpu.gcs.pubsub.Publisher`."""
+
+    def __init__(self, publisher, server):
+        self._publisher = publisher
+        self._lock = threading.Lock()
+        self._subs: Dict[int, _RemoteSub] = {}
+        self._next_id = 0
+        self.batches_received = 0      # publish_batch calls (tests)
+        self.messages_received = 0
+        server.register("pubsub_subscribe", self._handle_subscribe)
+        server.register("pubsub_unsubscribe", self._handle_unsubscribe)
+        server.register_async("pubsub_poll", self._handle_poll)
+        server.register("publish_batch", self._handle_publish_batch)
+
+    # ---- remote-subscriber direction -----------------------------------
+    def _handle_subscribe(self, payload) -> int:
+        channel = payload["channel"]
+        key = payload.get("key")
+        with self._lock:
+            sid = payload.get("sub_id")
+            if sid is None:
+                self._next_id += 1
+                sid = self._next_id
+                self._subs[sid] = _RemoteSub()
+            sub = self._subs.get(sid)
+            if sub is None:
+                raise KeyError(f"unknown pubsub subscriber {sid}")
+            if (channel, key) not in sub.pub_sub_ids:
+                pub_id = self._publisher.subscribe(
+                    channel, key,
+                    lambda k, msg, s=sid, c=channel: self._enqueue(
+                        s, c, k, msg))
+                sub.pub_sub_ids[(channel, key)] = pub_id
+        return sid
+
+    def _handle_unsubscribe(self, payload) -> bool:
+        sid = payload["sub_id"]
+        with self._lock:
+            sub = self._subs.pop(sid, None)
+        if sub is None:
+            return False
+        for (channel, key), pub_id in sub.pub_sub_ids.items():
+            self._publisher.unsubscribe(channel, key, pub_id)
+        if sub.timer is not None:
+            sub.timer.cancel()
+        if sub.pending is not None:
+            try:
+                sub.pending([])
+            except Exception:
+                pass
+        return True
+
+    def _enqueue(self, sid: int, channel: str, key, message):
+        import time
+        evict = None
+        with self._lock:
+            sub = self._subs.get(sid)
+            if sub is None:
+                return
+            if sub.pending is None and \
+                    time.monotonic() - sub.last_seen > _SUBSCRIBER_TTL_S:
+                # Long-silent subscriber: presumed dead, evict instead
+                # of buffering into its mailbox forever.
+                evict = self._subs.pop(sid)
+            else:
+                sub.mailbox.append(
+                    {"channel": channel, "key": key, "message": message})
+                reply, batch = self._take_pending_locked(sub)
+        if evict is not None:
+            for (ch, k), pub_id in evict.pub_sub_ids.items():
+                self._publisher.unsubscribe(ch, k, pub_id)
+            return
+        if reply is not None:
+            reply(batch)
+
+    def _take_pending_locked(self, sub: _RemoteSub):
+        if sub.pending is None or not sub.mailbox:
+            return None, None
+        reply, sub.pending = sub.pending, None
+        batch, sub.mailbox = sub.mailbox, []
+        if sub.timer is not None:
+            sub.timer.cancel()
+            sub.timer = None
+        return reply, batch
+
+    def _handle_poll(self, payload, reply):
+        import time
+        sid = payload["sub_id"]
+        with self._lock:
+            sub = self._subs.get(sid)
+            if sub is None:
+                reply(None)     # unknown/closed subscriber
+                return
+            sub.last_seen = time.monotonic()
+            if sub.mailbox:
+                batch, sub.mailbox = sub.mailbox, []
+                reply(batch)
+                return
+            # Park; supersede any previous outstanding poll (the
+            # reference allows exactly one).
+            old, sub.pending = sub.pending, reply
+            if sub.timer is not None:
+                sub.timer.cancel()
+
+            def keepalive():
+                with self._lock:
+                    s = self._subs.get(sid)
+                    if s is None or s.pending is not reply:
+                        return
+                    s.pending = None
+                    s.timer = None
+                reply([])
+
+            sub.timer = threading.Timer(_POLL_PARK_S, keepalive)
+            sub.timer.daemon = True
+            sub.timer.start()
+        if old is not None:
+            old([])
+
+    # ---- remote-publisher direction ------------------------------------
+    def _handle_publish_batch(self, batch) -> bool:
+        self.batches_received += 1
+        self.messages_received += len(batch)
+        for item in batch:
+            self._publisher.publish(item["channel"], item["key"],
+                                    item["message"])
+        return True
+
+
+class SubscriberClient:
+    """Subscriber half: one long-poll loop on an existing RpcClient
+    serving any number of (channel, key) callbacks."""
+
+    def __init__(self, rpc_client):
+        self._client = rpc_client
+        self._lock = threading.Lock()
+        self._cbs: Dict[Tuple[str, Optional[bytes]], List[Callable]] = {}
+        self._sub_id: Optional[int] = None
+        self._closed = False
+        self._polling = False
+
+    def subscribe(self, channel: str, key: Optional[bytes],
+                  callback: Callable[[bytes, Any], None]):
+        self._sub_id = self._client.call(
+            "pubsub_subscribe",
+            {"sub_id": self._sub_id, "channel": channel, "key": key},
+            timeout=30.0)
+        with self._lock:
+            self._cbs.setdefault((channel, key), []).append(callback)
+            if not self._polling:
+                self._polling = True
+                start = True
+            else:
+                start = False
+        if start:
+            self._poll()
+
+    def _poll(self):
+        if self._closed:
+            return
+        try:
+            self._client.call_async(
+                "pubsub_poll", {"sub_id": self._sub_id}, self._on_batch)
+        except Exception:
+            self._retry_later()
+
+    def _on_batch(self, result, err):
+        if self._closed:
+            return
+        if err is not None:
+            self._retry_later()
+            return
+        if result is None:       # subscriber evicted server-side
+            return
+        for item in result:
+            with self._lock:
+                cbs = list(self._cbs.get(
+                    (item["channel"], item["key"]), ())) + \
+                    list(self._cbs.get((item["channel"], None), ()))
+            for cb in cbs:
+                try:
+                    cb(item["key"], item["message"])
+                except Exception:
+                    pass
+        self._poll()
+
+    def _retry_later(self):
+        timer = threading.Timer(1.0, self._poll)
+        timer.daemon = True
+        timer.start()
+
+    def close(self):
+        self._closed = True
+        if self._sub_id is not None:
+            try:
+                self._client.call_async(
+                    "pubsub_unsubscribe", {"sub_id": self._sub_id},
+                    lambda _r, _e: None)
+            except Exception:
+                pass
+
+
+class BatchingPublisher:
+    """Publisher-side batching for a spoke: at most ONE publish RPC in
+    flight; events accumulating behind it ride the next flush (the
+    log-spam path stays O(1) outstanding messages per node)."""
+
+    def __init__(self, rpc_client):
+        self._client = rpc_client
+        self._lock = threading.Lock()
+        self._buf: List[dict] = []
+        self._inflight = False
+
+    def publish(self, channel: str, key, message):
+        with self._lock:
+            self._buf.append({"channel": channel, "key": key,
+                              "message": message})
+            if self._inflight:
+                return
+            self._inflight = True
+        self._flush()
+
+    def _flush(self):
+        with self._lock:
+            if not self._buf:
+                self._inflight = False
+                return
+            batch, self._buf = self._buf, []
+        try:
+            self._client.call_async("publish_batch", batch,
+                                    lambda _r, _e: self._flush())
+        except Exception:
+            # Connection down: drop this batch (logs are lossy on node
+            # death in the reference too) but keep the pump alive.
+            self._flush()
