@@ -30,6 +30,11 @@ class Cluster:
         self.object_directory = ObjectDirectory()
         self._lock = threading.Lock()
         self._raylets: List[Raylet] = []
+        # EVERY in-process raylet ever created, including ones later
+        # declared dead (heartbeat timeout) and dropped from
+        # membership: shutdown must still stop their worker pools /
+        # monitors, or process workers and log-monitor refs leak.
+        self._ever_raylets: List[Raylet] = []
         self.head_node: Optional[Raylet] = None
         self.core_worker = None
         self.head_service = None          # wire front, started on demand
@@ -72,6 +77,7 @@ class Cluster:
         raylet.core_worker = self.core_worker
         with self._lock:
             self._raylets.append(raylet)
+            self._ever_raylets.append(raylet)
         self.gcs.register_raylet(raylet)
         return raylet
 
@@ -81,6 +87,7 @@ class Cluster:
         head-side half of NodeInfoGcsService.RegisterNode."""
         with self._lock:
             self._raylets.append(raylet)
+            self._ever_raylets.append(raylet)
         self.gcs.register_raylet(raylet)
 
     def start_head_service(self, port: int = 0):
@@ -183,8 +190,13 @@ class Cluster:
             self.core_worker.on_node_death(node_id, lost)
 
     def shutdown(self):
-        for r in self.raylets():
-            r.shutdown()
+        with self._lock:
+            everyone = list(self._ever_raylets)
+        for r in everyone:          # Raylet.shutdown is idempotent
+            try:
+                r.shutdown()
+            except Exception:
+                pass
         with self._lock:
             handles, self._remote_procs = self._remote_procs, []
         for h in handles:

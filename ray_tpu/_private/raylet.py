@@ -193,6 +193,8 @@ class Raylet:
         self.loop.stop()
 
     def shutdown(self):
+        if self._dead:
+            return
         self._dead = True
         self.cluster.gcs.unregister_raylet(self.node_id)
         self.worker_pool.shutdown()

@@ -129,3 +129,73 @@ def test_actor_holds_resources(ray_start_regular):
     ray_tpu.get(a1.read.remote())
     avail = ray_tpu.available_resources()
     assert avail["CPU"] <= 2.0
+
+
+class TestActorSchedulingModes:
+    """Both actor schedulers (gcs_actor_scheduler.cc:459 raylet-forward
+    default; gcs_actor_distribution.h:66 GCS-based behind
+    RAY_gcs_actor_scheduling_enabled) drive the same lifecycle."""
+
+    @pytest.mark.parametrize("gcs_mode", [False, True])
+    def test_lifecycle_under_both_modes(self, gcs_mode):
+        ray_tpu.init(num_cpus=4, _system_config={
+            "gcs_actor_scheduling_enabled": gcs_mode})
+        try:
+            @ray_tpu.remote(max_restarts=1)
+            class Counter:
+                def __init__(self):
+                    self.n = 0
+
+                def bump(self):
+                    self.n += 1
+                    return self.n
+
+            c = Counter.options(name="mode_counter").remote()
+            assert ray_tpu.get([c.bump.remote() for _ in range(3)],
+                               timeout=30) == [1, 2, 3]
+            again = ray_tpu.get_actor("mode_counter")
+            assert ray_tpu.get(again.bump.remote(), timeout=30) == 4
+            ray_tpu.kill(c)
+        finally:
+            ray_tpu.shutdown()
+
+    def test_gcs_mode_converges_past_stale_view(self):
+        """GCS-based scheduling decides from the HEAD's resource view,
+        which lags the raylets' truth between polls (ray_syncer).  With
+        real node-host processes the views are genuinely separate:
+        poison the head's row for a node that cannot host the actor —
+        the target raylet's authoritative decision (spillback to the
+        capable peer) must still land the actor correctly."""
+        import time as time_mod
+
+        from ray_tpu._private.worker import global_worker
+        ray_tpu.init(num_cpus=1, _system_config={
+            "gcs_actor_scheduling_enabled": True,
+            "scheduler_backend": "native",
+            "raylet_heartbeat_period_milliseconds": 50,
+            "num_heartbeats_timeout": 20,
+            "gcs_resource_broadcast_period_milliseconds": 50,
+        })
+        try:
+            cluster = global_worker().cluster
+            ha = cluster.add_remote_node(num_cpus=1,
+                                         resources={"special": 1.0})
+            hb = cluster.add_remote_node(num_cpus=1)
+            # Let the spokes learn the cluster topology (broadcasts).
+            time_mod.sleep(0.3)
+            # Stale head view: claim B has plenty of everything.
+            cluster.gcs.resource_manager.view.update_available(
+                hb.node_id, {"CPU": 8.0, "special": 8.0})
+
+            @ray_tpu.remote(resources={"special": 1.0})
+            class Pinned:
+                def where(self):
+                    import os
+                    return os.getpid()
+
+            p = Pinned.remote()
+            where = ray_tpu.get(p.where.remote(), timeout=60)
+            assert where == ha.proc.pid, \
+                "actor did not converge onto the capable node"
+        finally:
+            ray_tpu.shutdown()
