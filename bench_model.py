@@ -1,0 +1,123 @@
+"""Single-chip model benchmark: flagship transformer train step MFU.
+
+The scheduler bench (bench.py) covers the runtime's TPU kernel; this
+covers the MODEL compute path — ``ray_tpu.models.transformer`` with
+flash attention and rematerialisation — at a realistic single-chip size,
+reporting step time, achieved FLOP/s and MFU against the chip's peak.
+
+FLOP accounting (standard: Chowdhery et al. PaLM appendix B):
+  train_step ≈ 6 * n_params * n_tokens      (fwd 2x + bwd 4x matmuls)
+             + 12 * n_layers * B * S^2 * d  (attention scores+values,
+                                             fwd+bwd, causal halves it)
+
+Prints ONE JSON line:
+  {"metric": "transformer_train_step_mfu", "value": <mfu %>, ...}
+"""
+
+import json
+import sys
+import time
+
+
+# Peak dense bf16 FLOP/s per CHIP by device kind (public spec sheets).
+_PEAK_TFLOPS = {
+    "TPU v2": 45.0,
+    "TPU v3": 123.0,
+    "TPU v4": 275.0,
+    "TPU v4 lite": 137.0,
+    "TPU v5 lite": 197.0,
+    "TPU v5e": 197.0,
+    "TPU v5p": 459.0,
+    "TPU v6e": 918.0,
+    "TPU v6 lite": 918.0,
+}
+
+
+def _chip_peak_tflops(device) -> float:
+    kind = getattr(device, "device_kind", "")
+    for name, peak in _PEAK_TFLOPS.items():
+        if kind.startswith(name):
+            return peak
+    # Unknown kind: report against v4 so the number is comparable,
+    # and include the kind in the output for the reader.
+    return 275.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models.transformer import (TransformerConfig,
+                                            make_train_state,
+                                            make_train_step)
+
+    on_tpu = jax.default_backend() == "tpu"
+    # Realistic single-chip size on TPU; tiny shape elsewhere so the
+    # script stays runnable (and testable) on CPU.
+    if on_tpu:
+        cfg = TransformerConfig(
+            vocab_size=32_000, d_model=1024, n_layers=8, n_heads=16,
+            d_ff=4096, max_seq_len=1024, dtype=jnp.bfloat16, remat=True)
+        batch_size, seq_len, reps = 8, 1024, 10
+    else:
+        cfg = TransformerConfig(
+            vocab_size=512, d_model=128, n_layers=2, n_heads=4,
+            d_ff=384, max_seq_len=256, dtype=jnp.float32, remat=False)
+        batch_size, seq_len, reps = 2, 128, 2
+
+    state, tx = make_train_state(jax.random.PRNGKey(0), cfg)
+    train_step = make_train_step(cfg, tx)    # jitted, donates state
+
+    rng = np.random.default_rng(0)
+    batch = {
+        # loss_fn shifts internally: [B, S+1] tokens.
+        "tokens": jnp.asarray(rng.integers(
+            0, cfg.vocab_size, (batch_size, seq_len + 1)), jnp.int32),
+    }
+
+    n_params = sum(int(np.prod(x.shape))
+                   for x in jax.tree_util.tree_leaves(state["params"]))
+
+    # Warmup/compile + correctness signal.
+    state, metrics = train_step(state, batch)
+    jax.block_until_ready(metrics)
+    loss0 = float(metrics["loss"])
+    assert np.isfinite(loss0), "non-finite loss"
+
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        state, metrics = train_step(state, batch)
+    jax.block_until_ready(metrics)
+    step_s = (time.perf_counter() - t0) / reps
+
+    n_tokens = batch_size * seq_len
+    flops = 6.0 * n_params * n_tokens + \
+        12.0 * cfg.n_layers * batch_size * seq_len ** 2 * cfg.d_model / 2
+    achieved_tflops = flops / step_s / 1e12
+    device = jax.devices()[0]
+    peak = _chip_peak_tflops(device)
+    mfu = achieved_tflops / peak * 100.0
+
+    print(json.dumps({
+        "metric": "transformer_train_step_mfu",
+        "value": round(mfu, 2),
+        "unit": "%",
+        "vs_baseline": round(mfu / 40.0, 2),   # target: >= 40% MFU
+        "step_ms": round(step_s * 1000.0, 2),
+        "achieved_tflops": round(achieved_tflops, 2),
+        "peak_tflops": peak,
+        "device_kind": getattr(device, "device_kind", "?"),
+        "backend": jax.default_backend(),
+        "params_m": round(n_params / 1e6, 1),
+        "tokens_per_step": n_tokens,
+        "loss_after_warmup": round(loss0, 4),
+        "config": {"d_model": cfg.d_model, "n_layers": cfg.n_layers,
+                   "n_heads": cfg.n_heads, "d_ff": cfg.d_ff,
+                   "batch": batch_size, "seq": seq_len},
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
