@@ -21,21 +21,33 @@ class FunctionManager:
     def __init__(self, kv):
         self._kv = kv
         self._lock = threading.Lock()
-        self._export_cache: Dict[int, FunctionID] = {}
+        # id(fn) -> (FunctionID, weakref-to-fn); the weakref guards
+        # against id() reuse after the original function is collected.
+        self._export_cache: Dict[int, tuple] = {}
         self._load_cache: Dict[FunctionID, Callable] = {}
 
     def export(self, fn: Callable) -> FunctionID:
+        import weakref
         key = id(fn)
         with self._lock:
             cached = self._export_cache.get(key)
-            if cached is not None:
-                return cached
+            # id() values are reused after GC: a dead closure's address
+            # can be handed to a brand-new function, which would then
+            # silently execute the OLD function's code.  The weakref
+            # identity check makes the cache hit only for the live
+            # original.
+            if cached is not None and cached[1]() is fn:
+                return cached[0]
         blob = dumps_function(fn)
         digest = hashlib.sha256(blob).digest()[:FunctionID.SIZE]
         function_id = FunctionID(digest)
         self._kv.put(_KV_PREFIX + function_id.binary(), blob, overwrite=False)
+        try:
+            ref = weakref.ref(fn)
+        except TypeError:
+            ref = lambda _f=fn: _f     # non-weakrefable: strong pin
         with self._lock:
-            self._export_cache[key] = function_id
+            self._export_cache[key] = (function_id, ref)
             # Seed the load cache with the original callable so local
             # execution avoids a deserialize round-trip.
             self._load_cache.setdefault(function_id, fn)

@@ -260,8 +260,18 @@ class Dataset:
     # Map tasks return one ref PER OUTPUT SHARD (num_returns=n) so reduce
     # tasks consume shard refs directly — the all-to-all never moves
     # through the driver (reference impl/shuffle.py two-phase pattern).
-    def repartition(self, num_blocks: int) -> "Dataset":
+    def repartition(self, num_blocks: int, *,
+                    push_based: Optional[bool] = None) -> "Dataset":
+        from ray_tpu.data.impl import push_shuffle
         n = num_blocks
+        if push_shuffle.push_based_enabled(push_based) and \
+                len(self._blocks) > 1:
+            pairs = push_shuffle.shuffle(
+                self._blocks, n,
+                _split_block, lambda i: (n,),
+                _merge_blocks, lambda j: ())
+            return Dataset([p[0] for p in pairs],
+                           metadata_refs=[p[1] for p in pairs])
         splits = [_split_block.options(num_returns=n).remote(b, n)
                   for b in self._blocks]
         if n == 1:
@@ -272,8 +282,21 @@ class Dataset:
                        metadata_refs=[p[1] for p in pairs])
 
     def random_shuffle(self, *, seed: Optional[int] = None,
-                       num_blocks: Optional[int] = None) -> "Dataset":
+                       num_blocks: Optional[int] = None,
+                       push_based: Optional[bool] = None) -> "Dataset":
+        from ray_tpu.data.impl import push_shuffle
         n = num_blocks or max(1, len(self._blocks))
+        if push_shuffle.push_based_enabled(push_based) and \
+                len(self._blocks) > 1:
+            # Two-stage push-based shuffle (fast_repartition.py /
+            # Exoshuffle parity): merge map outputs in groups so wide
+            # shuffles stay inside the object-store envelope.
+            pairs = push_shuffle.shuffle(
+                self._blocks, n,
+                _shuffle_map, lambda i: (n, seed, i),
+                _shuffle_reduce, lambda j: (seed, j))
+            return Dataset([p[0] for p in pairs],
+                           metadata_refs=[p[1] for p in pairs])
         maps = [_shuffle_map.options(num_returns=n).remote(b, n, seed, i)
                 for i, b in enumerate(self._blocks)]
         if n == 1:
@@ -282,6 +305,25 @@ class Dataset:
                  for j in range(n)]
         return Dataset([p[0] for p in pairs],
                        metadata_refs=[p[1] for p in pairs])
+
+    def to_random_access_dataset(self, key: str, *,
+                                 num_workers: int = 2):
+        """Sort by ``key`` and serve point lookups from a fleet of
+        block-holding actors (reference random_access_dataset.py)."""
+        from ray_tpu.data.impl.push_shuffle import (RandomAccessDataset,
+                                                    _last_key)
+        ds = self.sort(key)
+        # One tiny remote task per block returns just its last key
+        # (never the block bytes), fetched in one batched get.  Empty
+        # blocks (skewed sort partitions) are dropped — boundary index
+        # i must mean "block i's upper bound".
+        lasts = ray_tpu.get([_last_key.remote(b, key)
+                             for b in ds._blocks])
+        kept = [(b, last) for b, last in zip(ds._blocks, lasts)
+                if last is not None]
+        boundaries = [last for _b, last in kept[:-1]]
+        return RandomAccessDataset([b for b, _l in kept], boundaries,
+                                   key, num_workers)
 
     def sort(self, key=None, descending: bool = False) -> "Dataset":
         if not self._blocks:

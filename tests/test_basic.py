@@ -212,3 +212,34 @@ def test_reinit_guard():
         ray_tpu.init()
     ray_tpu.init(ignore_reinit_error=True)
     ray_tpu.shutdown()
+
+
+def test_function_id_not_confused_by_id_reuse(ray_start_regular):
+    """Regression: the export cache keyed raw id(fn); a GC'd closure's
+    address reused by a NEW function returned the old function's id, so
+    tasks silently executed the wrong code.  Trigger: content-identical
+    closures share one fid, so later copies are unpinned and their ids
+    recyclable."""
+    import gc
+
+    def make_probe():
+        def probe():            # content-identical every time
+            return "probe"
+        return probe
+
+    # Export several identical-content copies; all but the first are
+    # unpinned and die here.
+    for _ in range(5):
+        ray_tpu.remote(make_probe()).remote()
+    gc.collect()
+
+    hits = 0
+    for i in range(50):
+        def different(x, _i=i):
+            return ("different", x, _i)
+        out = ray_tpu.get(ray_tpu.remote(different).remote(7), timeout=30)
+        assert out == ("different", 7, i), out
+        hits += 1
+        del different
+        gc.collect()
+    assert hits == 50
