@@ -17,7 +17,10 @@ from ray_tpu.serve.api import (  # noqa: F401
 from ray_tpu.serve.batching import batch  # noqa: F401
 from ray_tpu.serve.handle import DeploymentHandle  # noqa: F401
 from ray_tpu.serve.http_proxy import HTTPRequest  # noqa: F401
+from ray_tpu.serve import pipeline  # noqa: F401
+from ray_tpu.serve.pipeline import InputNode  # noqa: F401
 
 __all__ = ["Deployment", "DeploymentHandle", "HTTPRequest", "batch",
+           "pipeline", "InputNode",
            "delete", "deployment", "get_deployment", "list_deployments",
            "run", "shutdown", "start"]

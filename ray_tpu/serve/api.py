@@ -146,6 +146,17 @@ class Deployment:
         cfg.update(kwargs)
         return Deployment(**cfg)
 
+    def bind(self, *args, **kwargs):
+        """Author a deployment-DAG node (reference serve pipeline
+        ``.bind``): class deployments yield a ClassNode whose methods
+        are further bindable; function deployments yield a call node."""
+        import inspect
+
+        from ray_tpu.serve import pipeline
+        if inspect.isclass(self._func_or_class):
+            return pipeline.ClassNode(self, args, kwargs)
+        return pipeline.FunctionNode(self, args, kwargs)
+
     def __call__(self, *a, **kw):
         raise RuntimeError(
             "Deployments cannot be called directly; use "

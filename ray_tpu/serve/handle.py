@@ -21,10 +21,22 @@ class _MethodCaller:
                                                    kwargs)
 
 
+def _rebuild_handle(deployment_name: str) -> "DeploymentHandle":
+    from ray_tpu import serve
+    return serve.get_deployment(deployment_name).get_handle()
+
+
 class DeploymentHandle:
     def __init__(self, deployment_name: str, router):
         self.deployment_name = deployment_name
         self._router = router
+
+    def __reduce__(self):
+        # Handles travel inside task args / deployment init args
+        # (pipeline composition); the router is process-local state, so
+        # reconstruct from the name on the receiving side (reference
+        # RayServeHandle serialization).
+        return (_rebuild_handle, (self.deployment_name,))
 
     def remote(self, *args, **kwargs):
         return self._router.assign_request("__call__", args, kwargs)

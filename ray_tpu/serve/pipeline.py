@@ -1,0 +1,261 @@
+"""Serve deployment DAGs: author a multi-deployment inference graph,
+build it into deployments + a runnable handle.
+
+Parity: reference ``python/ray/serve/pipeline/`` (DAG authored with
+``.bind()`` + ``InputNode``, compiled by ``pipeline.build`` into the
+deployments it needs — ``api.py:8``, ``deployment_node.py``,
+``deployment_method_node.py``, ``deployment_function_node.py``).
+
+Authoring::
+
+    @serve.deployment
+    class Model:
+        def __init__(self, weight): ...
+        def forward(self, x): ...
+
+    @serve.deployment
+    def ensemble(a, b): ...
+
+    with InputNode() as inp:
+        m1 = Model.bind(1)
+        m2 = Model.bind(2)
+        dag = ensemble.bind(m1.forward.bind(inp), m2.forward.bind(inp))
+    handle = pipeline.build(dag)     # deploys every node's deployment
+    result = ray_tpu.get(handle.remote(5))
+
+Execution walks the DAG per request: each bound method/function call
+becomes a handle call on its deployment, upstream results resolved
+first (fan-out stages run concurrently — sibling calls are submitted
+before any result is awaited).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+
+
+class DAGNode:
+    """Base of the authoring nodes."""
+
+    def _resolve(self, input_value, cache: Dict[int, Any]):
+        raise NotImplementedError
+
+
+class InputNode(DAGNode):
+    """Placeholder for the per-request input (reference InputNode).
+    Usable as a context manager for authoring-scope clarity."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def __getitem__(self, idx):
+        return _InputAttr(self, idx)
+
+    def _resolve(self, input_value, cache):
+        return input_value
+
+
+class _InputAttr(DAGNode):
+    def __init__(self, parent: InputNode, idx):
+        self._parent = parent
+        self._idx = idx
+
+    def _resolve(self, input_value, cache):
+        return input_value[self._idx]
+
+
+class ClassNode(DAGNode):
+    """A deployment class bound to init args (``Deployment.bind``)."""
+
+    def __init__(self, deployment, init_args: tuple,
+                 init_kwargs: dict):
+        self._deployment = deployment
+        self._init_args = init_args
+        self._init_kwargs = init_kwargs
+
+    def __getattr__(self, method_name: str):
+        if method_name.startswith("_"):
+            raise AttributeError(method_name)
+        return _MethodBinder(self, method_name)
+
+    def _resolve(self, input_value, cache):
+        raise TypeError(
+            "a bound class is not callable in the DAG; bind one of its "
+            "methods")
+
+
+class _MethodBinder:
+    def __init__(self, class_node: ClassNode, method_name: str):
+        self._class_node = class_node
+        self._method_name = method_name
+
+    def bind(self, *args, **kwargs) -> "MethodNode":
+        return MethodNode(self._class_node, self._method_name, args,
+                          kwargs)
+
+
+class MethodNode(DAGNode):
+    def __init__(self, class_node: ClassNode, method_name: str,
+                 args: tuple, kwargs: dict):
+        self._class_node = class_node
+        self._method = method_name
+        self._args = args
+        self._kwargs = kwargs
+
+    def _resolve(self, input_value, cache):
+        key = id(self)
+        if key in cache:
+            return cache[key]
+        handle = cache["handles"][id(self._class_node)]
+        # Upstream results pass as ObjectRefs: the replica call's arg
+        # resolution awaits them, so every branch of the DAG is in
+        # flight before anything blocks (true dataflow execution).
+        args = [_submit(a, input_value, cache) for a in self._args]
+        kwargs = {k: _submit(v, input_value, cache)
+                  for k, v in self._kwargs.items()}
+        ref = getattr(handle, self._method).remote(*args, **kwargs)
+        cache[key] = ref
+        return ref
+
+
+class FunctionNode(DAGNode):
+    """A function deployment bound to upstream nodes."""
+
+    def __init__(self, deployment, args: tuple, kwargs: dict):
+        self._deployment = deployment
+        self._args = args
+        self._kwargs = kwargs
+
+    def _resolve(self, input_value, cache):
+        key = id(self)
+        if key in cache:
+            return cache[key]
+        handle = cache["handles"][id(self)]
+        args = [_submit(a, input_value, cache) for a in self._args]
+        kwargs = {k: _submit(v, input_value, cache)
+                  for k, v in self._kwargs.items()}
+        ref = handle.remote(*args, **kwargs)
+        cache[key] = ref
+        return ref
+
+
+def _submit(node, input_value, cache):
+    """Kick off a node (returns an ObjectRef for deployment calls, the
+    literal value otherwise)."""
+    if isinstance(node, DAGNode):
+        return node._resolve(input_value, cache)
+    return node
+
+
+class DAGHandle:
+    """The built pipeline: ``remote(input)`` runs one request through
+    the graph and returns a ref to the root's result."""
+
+    def __init__(self, root: DAGNode, handles: Dict[int, Any],
+                 deployments: List):
+        self._root = root
+        self._handles = handles      # node id -> DeploymentHandle
+        self.deployments = deployments
+
+    def remote(self, input_value=None):
+        cache: Dict = {"handles": self._handles}
+        out = self._root._resolve(input_value, cache)
+        from ray_tpu._private.object_ref import ObjectRef
+        if isinstance(out, ObjectRef):
+            return out
+        return ray_tpu.put(out)
+
+
+def _collect(node, class_nodes: List, fn_nodes: List, seen: set):
+    if id(node) in seen or not isinstance(node, DAGNode):
+        return
+    seen.add(id(node))
+    if isinstance(node, MethodNode):
+        _collect(node._class_node, class_nodes, fn_nodes, seen)
+        for a in list(node._args) + list(node._kwargs.values()):
+            _collect(a, class_nodes, fn_nodes, seen)
+    elif isinstance(node, FunctionNode):
+        fn_nodes.append(node)
+        for a in list(node._args) + list(node._kwargs.values()):
+            _collect(a, class_nodes, fn_nodes, seen)
+    elif isinstance(node, ClassNode):
+        class_nodes.append(node)
+        for a in (list(node._init_args) +
+                  list(node._init_kwargs.values())):
+            _collect(a, class_nodes, fn_nodes, seen)
+    elif isinstance(node, _InputAttr):
+        _collect(node._parent, class_nodes, fn_nodes, seen)
+
+
+def build(root: DAGNode) -> DAGHandle:
+    """Deploy every deployment the DAG references and return a runnable
+    handle (reference ``pipeline.build``, api.py:8).
+
+    Naming never mutates the author's nodes (a node reused across two
+    builds keeps both DAGHandles working) and never collides with
+    pre-existing standalone deployments."""
+    from ray_tpu import serve
+    class_nodes: List[ClassNode] = []
+    fn_nodes: List[FunctionNode] = []
+    _collect(root, class_nodes, fn_nodes, set())
+    taken = set(serve.list_deployments())
+    handles: Dict[int, Any] = {}
+    deployments = []
+
+    def fresh_name(base: str) -> str:
+        name, n = base, 0
+        while name in taken:
+            n += 1
+            name = f"{base}_{n}"
+        taken.add(name)
+        return name
+
+    # Class deployments first: a FunctionNode/ClassNode may take a
+    # bound class as an init/call arg (composition) — it resolves to
+    # the already-deployed handle.
+    def materialize_init_arg(a):
+        if isinstance(a, ClassNode):
+            return handles[id(a)]
+        if isinstance(a, DAGNode):
+            raise TypeError(
+                "only bound classes (handles) and plain values may be "
+                "used as deployment init args; request-time nodes "
+                "cannot — they have no value at deploy time")
+        return a
+
+    def deploy_node(node):
+        name = fresh_name(node._deployment.name)
+        d = node._deployment.options(name=name, route_prefix=None)
+        if isinstance(node, ClassNode):
+            d.deploy(*[materialize_init_arg(a)
+                       for a in node._init_args],
+                     **{k: materialize_init_arg(v)
+                        for k, v in node._init_kwargs.items()})
+        else:
+            d.deploy()
+        deployments.append(d)
+        handles[id(node)] = serve.get_deployment(name).get_handle()
+
+    # Composition means a ClassNode's init args may reference other
+    # ClassNodes: deploy in dependency order.
+    pending = list(class_nodes)
+    while pending:
+        progressed = False
+        for node in list(pending):
+            deps = [a for a in (list(node._init_args) +
+                                list(node._init_kwargs.values()))
+                    if isinstance(a, ClassNode)]
+            if all(id(dep) in handles for dep in deps):
+                deploy_node(node)
+                pending.remove(node)
+                progressed = True
+        if not progressed:
+            raise ValueError("cycle in deployment init-arg bindings")
+    for node in fn_nodes:
+        deploy_node(node)
+    return DAGHandle(root, handles, deployments)

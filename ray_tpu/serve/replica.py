@@ -8,6 +8,8 @@ backpressure signal), runs reconfigure, reports health.
 from __future__ import annotations
 
 import threading
+
+import ray_tpu
 import time
 from typing import Any, Callable, Dict, Optional
 
@@ -43,7 +45,15 @@ class ReplicaActor:
                 target = self._callable
             else:
                 target = getattr(self._callable, method_name)
-            return target(*args, **(kwargs or {}))
+            # ObjectRef args resolve before the user callable sees them
+            # (reference serve handle semantics; the pipeline DAG wires
+            # upstream deployment outputs through as refs).
+            from ray_tpu._private.object_ref import ObjectRef
+            args = [ray_tpu.get(a) if isinstance(a, ObjectRef) else a
+                    for a in args]
+            kwargs = {k: ray_tpu.get(v) if isinstance(v, ObjectRef)
+                      else v for k, v in (kwargs or {}).items()}
+            return target(*args, **kwargs)
         finally:
             with self._lock:
                 self._inflight -= 1

@@ -37,8 +37,16 @@ from ray_tpu.workflow.storage import (
 __all__ = [
     "init", "step", "virtual_actor", "get_actor", "resume", "resume_all",
     "get_output", "get_status", "list_all", "cancel", "delete",
-    "WorkflowStatus",
+    "WorkflowStatus", "EventListener", "TimerListener", "wait_for_event",
 ]
+
+
+def __getattr__(name):
+    # Late-bound: event_listener imports `step` from this module.
+    if name in ("EventListener", "TimerListener", "wait_for_event"):
+        from ray_tpu.workflow import event_listener
+        return getattr(event_listener, name)
+    raise AttributeError(name)
 
 
 def init(storage: Optional[str] = None):

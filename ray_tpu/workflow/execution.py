@@ -20,7 +20,7 @@ from __future__ import annotations
 import contextlib
 import itertools
 import os
-import pickle
+import cloudpickle as pickle  # locals-safe: steps/args may close over test-local classes
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 

@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import json
 import os
-import pickle
+import cloudpickle as pickle  # locals-safe: steps/args may close over test-local classes
 import tempfile
 import threading
 from typing import Any, Dict, List, Optional

@@ -303,3 +303,72 @@ class TestReviewRegressions:
         with pytest.raises(ValueError, match="canceled"):
             workflow.resume("cancel-wf")
         assert "cancel-wf" not in workflow.resume_all()
+
+
+class TestWorkflowEvents:
+    """wait_for_event (reference event_listener.py + api.py:364):
+    poll -> checkpoint -> commit, with exactly-once replay semantics
+    on resume."""
+
+    def test_timer_event_fires(self, wf):
+        import time
+
+        from ray_tpu import workflow
+
+        @workflow.step
+        def after(evt):
+            return ("done", evt)
+
+        t = time.time() + 0.3
+        out = after.step(
+            workflow.wait_for_event(workflow.TimerListener, t)).run(
+            workflow_id="wf_timer")
+        assert out[0] == "done" and out[1] == t
+        assert time.time() >= t
+
+    def test_custom_listener_commit_and_replay(self, wf,
+                                               tmp_path):
+        """The commit callback runs after checkpointing; a RESUMED
+        workflow replays the recorded event instead of re-polling."""
+        from ray_tpu import workflow
+
+        evt_file = tmp_path / "evt.txt"
+        evt_file.write_text("payload-1")
+        poll_log = tmp_path / "polls.log"
+        commit_log = tmp_path / "commits.log"
+
+        class FileListener(workflow.EventListener):
+            def poll_for_event(self, path):
+                with open(poll_log, "a") as f:
+                    f.write("poll\n")
+                return open(path).read()
+
+            def event_checkpointed(self, event):
+                with open(commit_log, "a") as f:
+                    f.write(f"commit:{event}\n")
+
+        @workflow.step
+        def crash_or_pass(evt, marker):
+            import os
+            if not os.path.exists(marker):
+                open(marker, "w").close()
+                raise RuntimeError("first attempt dies")
+            return evt.upper()
+
+        marker = str(tmp_path / "marker")
+        ev = workflow.wait_for_event(FileListener, str(evt_file))
+        node = crash_or_pass.step(ev, marker)
+        import pytest as _pytest
+        with _pytest.raises(Exception) as excinfo:
+            node.run(workflow_id="wf_evt")
+        assert "first attempt dies" in str(excinfo.value), excinfo.value
+        # The event itself was polled, checkpointed and committed.
+        assert poll_log.read_text().count("poll") == 1
+        assert commit_log.read_text() == "commit:payload-1\n"
+        # Change the source AFTER the checkpoint: resume must replay
+        # the recorded payload, not re-poll.
+        evt_file.write_text("payload-2")
+        out = ray_tpu.get(workflow.resume("wf_evt"), timeout=60)
+        assert out == "PAYLOAD-1"
+        assert poll_log.read_text().count("poll") == 1, \
+            "resume re-polled the event source"
