@@ -73,6 +73,30 @@ def register_operator_handlers(cluster, job_manager):
     server.register("stop_job", job_manager.stop_job)
     server.register("cluster_status", handle_cluster_status)
 
+    def handle_memory_summary(_payload):
+        """Per-node object store stats (reference `ray memory`)."""
+        out = []
+        for raylet in cluster.raylets():
+            store = getattr(raylet, "object_store", None)
+            if store is None or not hasattr(store, "used_bytes"):
+                continue
+            out.append({
+                "node": getattr(raylet, "node_name", "") or
+                raylet.node_id.hex()[:12],
+                "used_bytes": store.used_bytes(),
+                "capacity_bytes": getattr(store, "capacity", 0),
+                "num_objects": store.num_objects(),
+                "stats": dict(getattr(store, "stats", {})),
+            })
+        return out
+
+    def handle_timeline(_payload):
+        from ray_tpu.util import tracing
+        return tracing.chrome_tracing_dump()
+
+    server.register("memory_summary", handle_memory_summary)
+    server.register("timeline_dump", handle_timeline)
+
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="ray_tpu.head")

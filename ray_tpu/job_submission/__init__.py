@@ -265,5 +265,11 @@ class JobSubmissionClient:
     def cluster_status(self) -> dict:
         return self._client.call("cluster_status", None, timeout=30.0)
 
+    def memory_summary(self) -> list:
+        return self._client.call("memory_summary", None, timeout=30.0)
+
+    def timeline(self) -> list:
+        return self._client.call("timeline_dump", None, timeout=30.0)
+
     def close(self):
         self._client.close()

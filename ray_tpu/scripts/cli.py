@@ -197,6 +197,132 @@ def cmd_job_stop(args) -> int:
     return 0
 
 
+def cmd_memory(args) -> int:
+    """Per-node object store summary (reference `ray memory`)."""
+    client = _client(args)
+    try:
+        rows = client.memory_summary()
+    finally:
+        client.close()
+    print(f"{'NODE':18} {'OBJECTS':>8} {'USED':>12} {'CAPACITY':>12} "
+          f"{'SPILLED':>10} {'EVICTED':>8}")
+    for r in rows:
+        stats = r.get("stats", {})
+        print(f"{r['node']:18} {r['num_objects']:>8} "
+              f"{r['used_bytes']:>12} {r['capacity_bytes']:>12} "
+              f"{stats.get('spilled_objects', 0):>10} "
+              f"{stats.get('evicted_objects', 0):>8}")
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    """Dump the head's tracing timeline as chrome://tracing JSON
+    (reference `ray timeline`)."""
+    import json as json_mod
+    client = _client(args)
+    try:
+        events = client.timeline()
+    finally:
+        client.close()
+    with open(args.output, "w") as f:
+        json_mod.dump(events, f)
+    print(f"wrote {len(events)} events to {args.output} "
+          "(open in chrome://tracing or Perfetto)")
+    return 0
+
+
+def cmd_up(args) -> int:
+    """Launch a local cluster from a YAML/JSON config: one head + N
+    worker-host processes (reference `ray up` with the local/fake
+    provider collapsed in — no SSH in this image; multi-host uses
+    `start --address` on each machine)."""
+    import json as json_mod
+    with open(args.cluster_config) as f:
+        text = f.read()
+    try:
+        cfg = json_mod.loads(text)
+    except json_mod.JSONDecodeError:
+        cfg = _parse_simple_yaml(text)
+    head_cfg = cfg.get("head", {})
+    cmd = [sys.executable, "-m", "ray_tpu._private.head_main",
+           "--address-file", args.address_file]
+    if head_cfg.get("num_cpus") is not None:
+        cmd += ["--num-cpus", str(head_cfg["num_cpus"])]
+    if head_cfg.get("port"):
+        cmd += ["--port", str(head_cfg["port"])]
+    _spawn_daemon(cmd, "head")
+    address = _wait_for_address_file(args.address_file)
+    print(f"head up at {address}")
+    for worker in cfg.get("workers", []):
+        count = int(worker.get("count", 1))
+        for _ in range(count):
+            wcmd = [sys.executable, "-m",
+                    "ray_tpu._private.node_host",
+                    "--head", address,
+                    "--resources",
+                    json_mod.dumps(worker.get("resources", {})),
+                    "--name", worker.get("name", "")]
+            _spawn_daemon(wcmd, "node")
+    n = sum(int(w.get("count", 1)) for w in cfg.get("workers", []))
+    print(f"launched {n} worker-host node(s); "
+          f"`ray-tpu status --address {address}` to inspect, "
+          f"`ray-tpu down` to stop")
+    return 0
+
+
+def _parse_simple_yaml(text: str) -> dict:
+    """Minimal YAML subset (maps, lists of maps, scalars) so cluster
+    configs read naturally without a yaml dependency."""
+    import re
+    root: dict = {}
+    stack = [(-1, root)]
+    for raw in text.splitlines():
+        if not raw.strip() or raw.lstrip().startswith("#"):
+            continue
+        indent = len(raw) - len(raw.lstrip())
+        line = raw.strip()
+        while stack and indent <= stack[-1][0]:
+            stack.pop()
+        container = stack[-1][1]
+        if line.startswith("- "):
+            item: dict = {}
+            if not isinstance(container, list):
+                raise ValueError(f"unexpected list item: {raw!r}")
+            container.append(item)
+            stack.append((indent, item))
+            line = line[2:]
+            indent += 2
+            container = item
+        m = re.match(r"([^:]+):\s*(.*)$", line)
+        if not m:
+            raise ValueError(f"unparseable line: {raw!r}")
+        key, value = m.group(1).strip(), m.group(2).strip()
+        if not value:
+            child: object = [] if key in ("workers",) else {}
+            container[key] = child
+            stack.append((indent, child))
+        else:
+            if re.fullmatch(r"-?\d+", value):
+                container[key] = int(value)
+            elif re.fullmatch(r"-?\d+\.\d*", value):
+                container[key] = float(value)
+            else:
+                container[key] = value.strip("'\"")
+    return root
+
+
+def _wait_for_address_file(path: str, timeout: float = 60.0) -> str:
+    import time
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            addr = open(path).read().strip()
+            if addr:
+                return addr
+        time.sleep(0.1)
+    raise SystemExit(f"head never wrote {path}")
+
+
 def cmd_down(args) -> int:
     from ray_tpu.rpc import RpcClient
     host, port = _resolve_address(args.address)
@@ -255,6 +381,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("job_id")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_job_stop)
+
+    p = sub.add_parser("memory", help="per-node object store summary")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_memory)
+
+    p = sub.add_parser("timeline", help="dump chrome://tracing JSON")
+    p.add_argument("--address", default=None)
+    p.add_argument("-o", "--output", default="timeline.json")
+    p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("up", help="launch a local cluster from a "
+                                  "YAML/JSON config")
+    p.add_argument("cluster_config")
+    p.add_argument("--address-file", default=DEFAULT_ADDRESS_FILE)
+    p.set_defaults(fn=cmd_up)
 
     p = sub.add_parser("down", help="shut the head down")
     p.add_argument("--address", default=None)

@@ -172,3 +172,52 @@ class TestCliAgainstRunningHead:
             seen = "cli-joined" in status.stdout
             time.sleep(0.3)
         assert seen, "CLI-started worker host never appeared in status"
+
+
+class TestCliMemoryTimelineUp:
+    def _cli(self, *args, timeout=120):
+        return subprocess.run(
+            [sys.executable, "-m", "ray_tpu", *args],
+            env=_env(), capture_output=True, text=True, timeout=timeout)
+
+    def test_memory_and_timeline(self, head_daemon, tmp_path):
+        out = self._cli("memory", "--address", head_daemon["address"])
+        assert out.returncode == 0, out.stderr
+        assert "OBJECTS" in out.stdout and "CAPACITY" in out.stdout
+        dump = tmp_path / "tl.json"
+        out = self._cli("timeline", "--address", head_daemon["address"],
+                        "-o", str(dump))
+        assert out.returncode == 0, out.stderr
+        import json as json_mod
+        assert isinstance(json_mod.loads(dump.read_text()), list)
+
+    def test_up_launches_local_cluster(self, tmp_path):
+        """`up` from a YAML config: head + 2 worker-hosts, visible in
+        `status`, stopped by `down` (reference cluster launcher shape,
+        local provider)."""
+        cfg = tmp_path / "cluster.yaml"
+        cfg.write_text(
+            "head:\n"
+            "  num_cpus: 1\n"
+            "workers:\n"
+            "  - count: 2\n"
+            "    resources:\n"
+            "      CPU: 1\n"
+            "      spoke: 2\n")
+        addr_file = str(tmp_path / "addr.txt")
+        out = self._cli("up", str(cfg), "--address-file", addr_file,
+                        timeout=180)
+        assert out.returncode == 0, out.stdout + out.stderr
+        address = open(addr_file).read().strip()
+        try:
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                st = self._cli("status", "--address", address)
+                if st.returncode == 0 and \
+                        st.stdout.count("ALIVE") >= 3:
+                    break
+                time.sleep(1.0)
+            assert st.stdout.count("ALIVE") >= 3, st.stdout
+            assert "spoke" in st.stdout
+        finally:
+            self._cli("down", "--address", address)
