@@ -52,6 +52,8 @@ class Raylet:
         self.object_manager = NodeObjectManager(self, cluster.object_directory)
         self.core_worker = None      # wired by the cluster/driver
         self._dead = False
+        self._host_stats = None
+        self._host_stats_ts = 0.0
         # Bundles: (pg_id, idx) -> ResourceRequest, prepared or committed.
         self._prepared_bundles: Dict = {}
         self._committed_bundles: Dict = {}
@@ -77,12 +79,26 @@ class Raylet:
         }
 
     def get_resource_report(self) -> dict:
-        return {
+        report = {
             "available": self.local_resources.to_float_dict("available"),
             "total": self.local_resources.to_float_dict("total"),
             "load": {"queued": self.cluster_task_manager.num_queued(),
                      "dispatch": self.local_task_manager.num_queued()},
         }
+        # Physical stats ride the report the node already sends
+        # (reference: reporter agent -> GCS), throttled to ~1 Hz.
+        import time as time_mod
+        now = time_mod.monotonic()
+        if now - self._host_stats_ts >= 1.0:
+            try:
+                from ray_tpu.dashboard.reporter import collect_host_stats
+                self._host_stats = collect_host_stats()
+                self._host_stats_ts = now
+            except Exception:
+                pass
+        if self._host_stats is not None:
+            report["host_stats"] = self._host_stats
+        return report
 
     def update_resource_usage(self, batch: dict):
         """Apply the GCS broadcast to the local (dirty) view

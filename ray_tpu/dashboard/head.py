@@ -65,6 +65,8 @@ class Dashboard:
             self._send_json(req, self._cluster_view())
         elif path == "/api/nodes":
             self._send_json(req, self._nodes())
+        elif path == "/api/node_stats":
+            self._send_json(req, self._node_stats())
         elif path == "/api/actors":
             self._send_json(req, self._actors())
         elif path == "/api/placement_groups":
@@ -100,6 +102,24 @@ class Dashboard:
                    "state": info.get("state"),
                    "resources": info.get("resources", {})}
             out.append(row)
+        return out
+
+    def _node_stats(self) -> list:
+        """Per-node physical stats (reporter-module parity): each
+        node's psutil sample rides its resource report; remote
+        node-hosts' latest reports are cached on their proxies."""
+        out = []
+        for raylet in self._cluster.raylets():
+            try:
+                report = raylet.get_resource_report()
+            except Exception:
+                continue
+            out.append({
+                "node_id": raylet.node_id.hex(),
+                "name": getattr(raylet, "node_name", ""),
+                "load": report.get("load", {}),
+                "host_stats": report.get("host_stats"),
+            })
         return out
 
     def _actors(self) -> list:

@@ -1,0 +1,47 @@
+"""Per-node physical stats (reference ``dashboard/modules/reporter``:
+each node's agent samples CPU/memory/disk via psutil and reports them
+up; the head aggregates).
+
+Here the stats ride the resource-report channel every node already
+sends (``get_resource_report``), so remote node-hosts need no extra
+connection; the dashboard serves the merged view at /api/node_stats.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict
+
+
+def collect_host_stats() -> Dict:
+    """One sample of this host's physical state."""
+    import psutil
+    vm = psutil.virtual_memory()
+    try:
+        disk = psutil.disk_usage(os.sep)
+        disk_row = {"total": disk.total, "used": disk.used,
+                    "percent": disk.percent}
+    except OSError:
+        disk_row = {}
+    try:
+        load1, load5, load15 = os.getloadavg()
+    except OSError:
+        load1 = load5 = load15 = 0.0
+    proc = psutil.Process()
+    with proc.oneshot():
+        proc_row = {
+            "pid": proc.pid,
+            "rss": proc.memory_info().rss,
+            "num_threads": proc.num_threads(),
+        }
+    return {
+        "ts": time.time(),
+        "cpu_percent": psutil.cpu_percent(interval=None),
+        "cpu_count": psutil.cpu_count(),
+        "mem": {"total": vm.total, "available": vm.available,
+                "percent": vm.percent},
+        "disk": disk_row,
+        "load_avg": [load1, load5, load15],
+        "process": proc_row,
+    }

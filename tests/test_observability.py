@@ -271,3 +271,26 @@ class TestTracing:
             ray_tpu.shutdown()
             tracing.enable(False)
             tracing.clear()
+
+
+class TestNodeStatsReporter:
+    def test_node_stats_route_serves_host_stats(self, thread_cluster):
+        """reporter-module parity: /api/node_stats carries psutil
+        samples riding the resource reports."""
+        import json as json_mod
+
+        from ray_tpu._private.worker import global_worker
+        from ray_tpu.dashboard.head import start_dashboard
+        cluster = global_worker().cluster
+        dash = start_dashboard(cluster)
+        try:
+            body = urllib.request.urlopen(
+                dash.url + "/api/node_stats", timeout=10).read()
+            rows = json_mod.loads(body)
+            assert rows, "no node stats rows"
+            hs = rows[0]["host_stats"]
+            assert hs["cpu_count"] >= 1
+            assert hs["mem"]["total"] > 0
+            assert "load" in rows[0]
+        finally:
+            dash.stop()
