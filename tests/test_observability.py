@@ -294,3 +294,50 @@ class TestNodeStatsReporter:
             assert "load" in rows[0]
         finally:
             dash.stop()
+
+
+class TestLatencyEnvelope:
+    def test_task_roundtrip_tail_latency(self, thread_cluster):
+        """Pins the magic-timeout hazards (VERDICT r4: wait()'s 200 ms
+        coarse-poll fallback, get's fixed pull wait): if a READY
+        object's get ever falls into a polling fallback, p99 blows past
+        the bound.  The bound is generous for a loaded CI box; the
+        assertion is about fallback regressions, not peak speed."""
+        import time as time_mod
+
+        @ray_tpu.remote
+        def echo(i):
+            return i
+
+        # Warm the worker pool / code paths.
+        ray_tpu.get([echo.remote(i) for i in range(20)], timeout=60)
+        lat = []
+        for i in range(200):
+            t0 = time_mod.perf_counter()
+            assert ray_tpu.get(echo.remote(i), timeout=30) == i
+            lat.append(time_mod.perf_counter() - t0)
+        lat.sort()
+        p50 = lat[len(lat) // 2]
+        p99 = lat[int(len(lat) * 0.99)]
+        assert p50 < 0.05, f"median task round-trip {p50*1e3:.1f} ms"
+        assert p99 < 0.25, \
+            f"p99 {p99*1e3:.1f} ms — a ready-object get hit a polling " \
+            "fallback"
+
+    def test_wait_ready_object_is_fast(self, thread_cluster):
+        import time as time_mod
+
+        @ray_tpu.remote
+        def one():
+            return 1
+
+        refs = [one.remote() for _ in range(8)]
+        ray_tpu.get(refs, timeout=30)          # all sealed
+        t0 = time_mod.perf_counter()
+        for _ in range(50):
+            ready, rest = ray_tpu.wait(refs, num_returns=8, timeout=5.0)
+            assert len(ready) == 8 and not rest
+        dt = (time_mod.perf_counter() - t0) / 50
+        assert dt < 0.05, \
+            f"wait() on sealed objects took {dt*1e3:.1f} ms — the " \
+            "coarse-poll fallback is on the ready path"
