@@ -1,0 +1,86 @@
+"""Remote pdb: debug a task running in a worker process over TCP.
+
+Parity: reference ``python/ray/util/rpdb.py`` (``ray.util.pdb.set_trace``):
+a task calls ``set_trace()``, a Pdb session binds a TCP port, and the
+developer attaches with ``telnet``/``nc`` (or :func:`connect`).  The
+bound address is printed to the worker's log — which the log pipeline
+streams to the driver — so the user sees where to attach.
+"""
+
+from __future__ import annotations
+
+import pdb
+import socket
+import sys
+
+
+class _SocketIO:
+    def __init__(self, conn: socket.socket):
+        self._file_in = conn.makefile("r")
+        self._file_out = conn.makefile("w")
+
+    def readline(self):
+        return self._file_in.readline()
+
+    def write(self, data):
+        self._file_out.write(data)
+
+    def flush(self):
+        self._file_out.flush()
+
+
+class RemotePdb(pdb.Pdb):
+    """Pdb bound to a TCP listener; one attach per breakpoint."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._listener = socket.socket(socket.AF_INET,
+                                       socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(1)
+        self.address = self._listener.getsockname()
+        print(f"RemotePdb waiting on {self.address[0]}:"
+              f"{self.address[1]} — attach with "
+              f"`nc {self.address[0]} {self.address[1]}`",
+              file=sys.stderr, flush=True)
+        conn, _ = self._listener.accept()
+        self._conn = conn
+        io = _SocketIO(conn)
+        super().__init__(stdin=io, stdout=io)
+        self.prompt = "(remote-pdb) "
+
+    def do_continue(self, arg):
+        out = super().do_continue(arg)
+        self._close()
+        return out
+
+    do_c = do_cont = do_continue
+
+    def do_quit(self, arg):
+        out = super().do_quit(arg)
+        self._close()
+        return out
+
+    do_q = do_exit = do_quit
+
+    def _close(self):
+        for s in (self._conn, self._listener):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+def set_trace(host: str = "127.0.0.1", port: int = 0, frame=None):
+    """Breakpoint inside a task/actor: blocks until a client attaches,
+    then drives a normal pdb session over the socket."""
+    debugger = RemotePdb(host=host, port=port)
+    debugger.set_trace(frame or sys._getframe().f_back)
+
+
+def connect(host: str, port: int):
+    """Minimal interactive client (``nc`` equivalent) for tests and
+    environments without netcat."""
+    conn = socket.create_connection((host, port))
+    return conn
