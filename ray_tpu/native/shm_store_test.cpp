@@ -1,0 +1,128 @@
+// Concurrency + lifecycle test binary for the native shm store.
+//
+// Parity: reference plasma's gtest/valgrind suites
+// (src/ray/object_manager/plasma/test/) and the sanitizer CI configs
+// (TSAN/ASAN bazel configs, SURVEY.md §5.2).  Built and executed by
+// tests/test_native_store.py under -fsanitize=address,undefined and
+// -fsanitize=thread: data races on the object table / allocator /
+// LRU clock and heap errors in the eviction path surface here.
+//
+// Exercises through the same C ABI Python uses: put/get/pin/unpin/
+// delete (incl. deferred free), create/seal, choose_victims — from
+// several threads against one store.
+
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* store_open(const char* name, uint64_t capacity);
+void store_close(void* s);
+int64_t store_put(void* s, const uint8_t* key, uint32_t keylen,
+                  const uint8_t* data, uint64_t size);
+int64_t store_create(void* s, const uint8_t* key, uint32_t keylen,
+                     uint64_t size);
+int store_seal(void* s, const uint8_t* key, uint32_t keylen);
+int store_get(void* s, const uint8_t* key, uint32_t keylen,
+              uint64_t* offset, uint64_t* size);
+int store_delete(void* s, const uint8_t* key, uint32_t keylen);
+int store_pin(void* s, const uint8_t* key, uint32_t keylen);
+int store_unpin(void* s, const uint8_t* key, uint32_t keylen);
+int store_choose_victims(void* s, uint64_t needed, uint8_t* out,
+                         uint32_t out_cap, uint64_t* covered);
+uint64_t store_used(void* s);
+uint64_t store_num_objects(void* s);
+}
+
+namespace {
+
+std::atomic<int> failures{0};
+
+#define CHECK(cond)                                                  \
+  do {                                                               \
+    if (!(cond)) {                                                   \
+      std::fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__,  \
+                   __LINE__, #cond);                                 \
+      failures.fetch_add(1);                                         \
+    }                                                                \
+  } while (0)
+
+std::string Key(int worker, int i) {
+  return "w" + std::to_string(worker) + "-" + std::to_string(i);
+}
+
+void Worker(void* store, int id, int iters) {
+  std::vector<uint8_t> payload(4096, static_cast<uint8_t>(id));
+  for (int i = 0; i < iters; i++) {
+    std::string key = Key(id, i);
+    const uint8_t* kb = reinterpret_cast<const uint8_t*>(key.data());
+    uint32_t kl = static_cast<uint32_t>(key.size());
+    int64_t off = store_put(store, kb, kl, payload.data(),
+                            payload.size());
+    if (off == -1) {
+      // OOM: evict something (any thread may race us — fine).
+      uint8_t buf[1 << 14];
+      uint64_t covered = 0;
+      int n = store_choose_victims(store, 64 * 1024, buf, sizeof(buf),
+                                   &covered);
+      uint32_t pos = 0;
+      for (int v = 0; v < n; v++) {
+        uint32_t len;
+        std::memcpy(&len, buf + pos, 4);
+        store_delete(store, buf + pos + 4, len);
+        pos += 4 + len;
+      }
+      continue;
+    }
+    uint64_t o = 0, sz = 0;
+    if (store_get(store, kb, kl, &o, &sz) == 0) {
+      CHECK(sz == payload.size());
+      // Pin, delete (defers), read metadata gone, unpin (frees).
+      if (store_pin(store, kb, kl) == 0) {
+        CHECK(store_delete(store, kb, kl) == 0);
+        CHECK(store_get(store, kb, kl, &o, &sz) == -1);
+        CHECK(store_unpin(store, kb, kl) == 0);
+      }
+    }
+    // Create/seal lifecycle on a second key.
+    std::string key2 = key + "-c";
+    const uint8_t* kb2 = reinterpret_cast<const uint8_t*>(key2.data());
+    uint32_t kl2 = static_cast<uint32_t>(key2.size());
+    int64_t off2 = store_create(store, kb2, kl2, 512);
+    if (off2 >= 0) {
+      CHECK(store_get(store, kb2, kl2, &o, &sz) == -1);  // unsealed
+      CHECK(store_seal(store, kb2, kl2) == 0);
+      CHECK(store_get(store, kb2, kl2, &o, &sz) == 0);
+      store_delete(store, kb2, kl2);
+    }
+  }
+}
+
+}  // namespace
+
+int main() {
+  std::string name = "/raytpu-santest-" + std::to_string(getpid());
+  void* store = store_open(name.c_str(), 8 * 1024 * 1024);
+  if (store == nullptr) {
+    std::fprintf(stderr, "store_open failed\n");
+    return 2;
+  }
+  const int kThreads = 8, kIters = 400;
+  std::vector<std::thread> threads;
+  for (int t = 0; t < kThreads; t++) {
+    threads.emplace_back(Worker, store, t, kIters);
+  }
+  for (auto& th : threads) th.join();
+  std::fprintf(stderr, "objects=%llu used=%llu failures=%d\n",
+               static_cast<unsigned long long>(store_num_objects(store)),
+               static_cast<unsigned long long>(store_used(store)),
+               failures.load());
+  store_close(store);
+  return failures.load() == 0 ? 0 : 1;
+}

@@ -240,3 +240,35 @@ class TestCrossProcessZeroCopy:
                 "return should have been sealed into the native segment"
         finally:
             ray_tpu.shutdown()
+
+
+class TestSanitizers:
+    """Native-store sanitizer story (SURVEY §5.2: the reference runs
+    plasma under TSAN/ASAN bazel configs + valgrind).  The concurrency
+    test binary is compiled and executed under ASan+UBSan and TSan;
+    any data race on the object table / allocator / LRU clock or heap
+    error in the eviction path fails the run."""
+
+    @pytest.mark.parametrize("flags,tag", [
+        ("-fsanitize=address,undefined", "asan"),
+        ("-fsanitize=thread", "tsan"),
+    ])
+    def test_concurrent_store_under_sanitizer(self, flags, tag,
+                                              tmp_path):
+        import os
+        import subprocess
+        src_dir = os.path.join(os.path.dirname(__file__), "..",
+                               "ray_tpu", "native")
+        binary = tmp_path / f"shm_store_test_{tag}"
+        build = subprocess.run(
+            ["g++", "-O1", "-g", "-std=c++17", *flags.split(),
+             os.path.join(src_dir, "shm_store.cpp"),
+             os.path.join(src_dir, "shm_store_test.cpp"),
+             "-o", str(binary), "-lrt", "-pthread"],
+            capture_output=True, text=True, timeout=300)
+        assert build.returncode == 0, build.stderr
+        run = subprocess.run([str(binary)], capture_output=True,
+                             text=True, timeout=300)
+        assert run.returncode == 0, \
+            f"{tag} run failed:\n{run.stderr[-3000:]}"
+        assert "failures=0" in run.stderr
