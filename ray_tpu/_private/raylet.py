@@ -102,11 +102,21 @@ class Raylet:
 
     def update_resource_usage(self, batch: dict):
         """Apply the GCS broadcast to the local (dirty) view
-        (grpc_based_resource_broadcaster parity)."""
+        (grpc_based_resource_broadcaster parity).
+
+        Batch format: ``{"rows": {node_id: usage}, "full": bool,
+        "removed": [node_id]}`` — a DELTA upserts its rows only; a FULL
+        snapshot additionally prunes nodes absent from it; explicit
+        removals (node death/dereg) arrive in ``removed`` so deltas
+        never have to enumerate the whole membership
+        (ray_syncer.h:37-66)."""
         if self._dead:
             return
+        rows = batch.get("rows", batch)     # legacy plain-dict = full
+        is_full = batch.get("full", "rows" not in batch)
+        removed = batch.get("removed", ())
         known = set(self.cluster_view.node_ids())
-        for node_id, usage in batch.items():
+        for node_id, usage in rows.items():
             if node_id == self.node_id:
                 continue
             if node_id not in known:
@@ -118,7 +128,10 @@ class Raylet:
             else:
                 self.cluster_view.update_available(node_id,
                                                    usage["available"])
-        for node_id in known - set(batch.keys()) - {self.node_id}:
+        gone = set(removed) & known
+        if is_full:
+            gone |= known - set(rows.keys()) - {self.node_id}
+        for node_id in gone:
             self.cluster_view.remove_node(node_id)
         self.cluster_task_manager.on_cluster_changed()
 

@@ -145,6 +145,19 @@ class GcsResourceManager:
         self._publisher = publisher
         self._loop = loop
         self._raylets: Dict[NodeID, object] = {}
+        # Delta broadcast (ray_syncer.h semantics): only rows whose
+        # availability changed since the last period go on the wire;
+        # fresh joiners get one full snapshot.
+        self._last_sent: Dict[NodeID, dict] = {}
+        self._needs_full: set = set()
+        self._removed_pending: set = set()
+        # Receivers DIRTY their peer rows at spillback
+        # (cluster_resource_data.h:221-227); a value-unchanged row
+        # would never correct them under pure deltas, so every Kth
+        # period is a full resync — bounded staleness at ~K x less
+        # steady-state wire traffic.
+        self._period = 0
+        self._full_every = 20
         cfg = get_config()
         loop.schedule_every(
             cfg.gcs_resource_broadcast_period_milliseconds / 1000.0,
@@ -166,24 +179,53 @@ class GcsResourceManager:
     def register_raylet(self, node_id: NodeID, raylet, resources: NodeResources):
         self._raylets[node_id] = raylet
         self.view.add_node(node_id, resources)
+        self._needs_full.add(node_id)
 
     def unregister_raylet(self, node_id: NodeID):
         self._raylets.pop(node_id, None)
+        self._last_sent.pop(node_id, None)
+        self._needs_full.discard(node_id)
+        self._removed_pending.add(node_id)
         self.view.remove_node(node_id)
 
     def _poll_and_broadcast(self):
         # Poll each raylet's local resource usage (RequestResourceReport),
-        # merge into the GCS view, then broadcast the merged batch to all
-        # raylets (UpdateResourceUsage) so their local views converge.
-        batch = {}
+        # merge into the GCS view, then broadcast ONLY the changed rows
+        # to all raylets (UpdateResourceUsage) — at N nodes a full-view
+        # broadcast every period is O(N^2) rows; the delta keeps the
+        # steady-state wire cost proportional to actual churn
+        # (grpc_based_resource_broadcaster + ray_syncer.h:37-66).
+        full = {}
+        delta = {}
         for node_id, raylet in list(self._raylets.items()):
             try:
                 usage = raylet.get_resource_report()
             except Exception:
                 continue
-            batch[node_id] = usage
+            full[node_id] = usage
             self.view.update_available(node_id, usage["available"])
-        for raylet in list(self._raylets.values()):
+            if self._last_sent.get(node_id) != usage["available"]:
+                delta[node_id] = usage
+                self._last_sent[node_id] = dict(usage["available"])
+        joiners, self._needs_full = self._needs_full, set()
+        removed, self._removed_pending = \
+            list(self._removed_pending), set()
+        self._period += 1
+        resync = self._period % self._full_every == 0
+        for node_id, raylet in list(self._raylets.items()):
+            # Deltas are a WIRE optimization: remote node-hosts get
+            # changed rows only (plus periodic resyncs correcting
+            # their dirty spillback decrements); in-process raylets
+            # cost nothing to update and keep the full batch every
+            # period (their dispatch solvers key refreshes off it).
+            if not getattr(raylet, "is_remote_proxy", False) or \
+                    resync or node_id in joiners:
+                batch = {"rows": full, "full": True, "removed": removed}
+            elif delta or removed:
+                batch = {"rows": delta, "full": False,
+                         "removed": removed}
+            else:
+                continue
             try:
                 raylet.update_resource_usage(batch)
             except Exception:

@@ -37,20 +37,37 @@ def test_cross_node_object_transfer(ray_start_cluster):
         np.arange(1_000_000, dtype=np.float32).sum())
 
 
-def test_tasks_flow_to_many_nodes(ray_start_cluster):
+def test_tasks_flow_to_many_nodes(ray_start_cluster, tmp_path):
+    """8 tasks that must run CONCURRENTLY (filesystem barrier) cannot
+    fit on fewer than all 4 2-CPU nodes — pins spillback across the
+    cluster.  (Without the barrier, worker reuse may legitimately
+    funnel short tasks through whichever node's workers warm up first —
+    work-conserving, same as the reference's OnWorkerIdle reuse.)"""
+    import os
     cluster = ray_start_cluster(num_cpus=2)
     for _ in range(3):
         cluster.add_node(num_cpus=2)
     assert cluster.wait_for_nodes(4)
     time.sleep(0.3)
+    barrier_dir = str(tmp_path / "barrier")
+    os.makedirs(barrier_dir, exist_ok=True)
 
     @ray_tpu.remote
-    def where():
-        time.sleep(0.1)
+    def where(i, n):
+        import os as os_mod
+        import time as time_mod
+        open(os_mod.path.join(barrier_dir, str(i)), "w").close()
+        deadline = time_mod.monotonic() + 30
+        while len(os_mod.listdir(barrier_dir)) < n:
+            if time_mod.monotonic() > deadline:
+                raise TimeoutError("barrier never filled")
+            time_mod.sleep(0.01)
         return ray_tpu.get_runtime_context().get_node_id()
 
-    nodes = set(ray_tpu.get([where.remote() for _ in range(16)]))
-    assert len(nodes) >= 3
+    n = 8
+    nodes = set(ray_tpu.get([where.remote(i, n) for i in range(n)],
+                            timeout=60))
+    assert len(nodes) == 4, nodes
 
 
 def test_actor_on_remote_node(ray_start_cluster):
