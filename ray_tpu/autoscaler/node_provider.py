@@ -190,3 +190,86 @@ class FakeMultiNodeProvider(NodeProvider):
     def set_node_tags(self, node_id, tags):
         with self.lock:
             self._tags[node_id].update(tags)
+
+
+class LocalProcessProvider(NodeProvider):
+    """Launches REAL worker-host OS processes (``node_host`` daemons)
+    joined to the cluster's head service — the local analogue of the
+    reference's node launcher flow (``node_launcher.py`` +
+    ``updater.py``: provider creates the instance, the updater brings a
+    raylet up on it; here create IS the bring-up, no SSH).  The
+    autoscaler's decisions scale actual OS processes up and down."""
+
+    def __init__(self, cluster, node_types: Dict[str, dict],
+                 cluster_name: str = "local"):
+        super().__init__({"node_types": node_types}, cluster_name)
+        self.cluster = cluster
+        self.node_types = node_types
+        self._handles: Dict[str, Any] = {}   # node_id hex -> handle
+        self._tags: Dict[str, Dict[str, str]] = {}
+        self._terminated: set = set()
+        self.lock = threading.RLock()
+        head = cluster.head_node
+        hid = head.node_id.hex()
+        self._handles[hid] = None            # head is not ours to kill
+        self._tags[hid] = {TAG_NODE_KIND: NODE_KIND_HEAD,
+                           TAG_NODE_TYPE: "head",
+                           TAG_NODE_STATUS: STATUS_UP_TO_DATE}
+
+    def non_terminated_nodes(self, tag_filters=None):
+        tag_filters = tag_filters or {}
+        with self.lock:
+            return [nid for nid, tags in self._tags.items()
+                    if nid not in self._terminated and
+                    all(tags.get(k) == v for k, v in tag_filters.items())]
+
+    def is_running(self, node_id):
+        with self.lock:
+            if node_id in self._terminated or node_id not in self._tags:
+                return False
+            handle = self._handles.get(node_id)
+        if handle is None:
+            return True                      # head
+        return handle.proc.poll() is None
+
+    def is_terminated(self, node_id):
+        return not self.is_running(node_id)
+
+    def node_tags(self, node_id):
+        with self.lock:
+            return dict(self._tags.get(node_id, {}))
+
+    def internal_ip(self, node_id):
+        return node_id[:12]
+
+    def create_node(self, node_config, tags, count):
+        node_type = tags.get(TAG_NODE_TYPE)
+        resources = dict(
+            (node_config or {}).get("resources") or
+            self.node_types.get(node_type, {}).get("resources",
+                                                   {"CPU": 1}))
+        for _ in range(count):
+            handle = self.cluster.add_remote_node(
+                num_cpus=resources.get("CPU", 0),
+                num_tpus=resources.get("TPU", 0),
+                memory=resources.get("memory"),
+                resources={k: v for k, v in resources.items()
+                           if k not in ("CPU", "TPU", "memory")})
+            nid = handle.node_id.hex()
+            with self.lock:
+                self._handles[nid] = handle
+                self._tags[nid] = dict(tags)
+                self._tags[nid][TAG_NODE_STATUS] = STATUS_UP_TO_DATE
+
+    def terminate_node(self, node_id):
+        with self.lock:
+            handle = self._handles.get(node_id)
+            if node_id in self._terminated:
+                return
+            self._terminated.add(node_id)
+        if handle is not None:
+            handle.terminate()
+
+    def set_node_tags(self, node_id, tags):
+        with self.lock:
+            self._tags[node_id].update(tags)

@@ -242,3 +242,60 @@ def test_pack_with_jax_kernel():
     unfulfilled, alloc = pack_with_jax_kernel(nodes, demands)
     assert unfulfilled == [{"CPU": 16}]
     assert alloc.sum() == 5
+
+
+def test_local_process_provider_autoscales_real_daemons():
+    """The launcher-flow local analogue (node_launcher.py/updater.py
+    parity, no SSH): autoscaler demand creates REAL node_host OS
+    processes; idle timeout terminates them."""
+    import time as time_mod
+
+    from ray_tpu.autoscaler.node_provider import LocalProcessProvider
+    from ray_tpu._private.worker import global_worker
+    ray_tpu.init(num_cpus=1, _system_config={
+        "scheduler_backend": "native",
+        "raylet_heartbeat_period_milliseconds": 50,
+        "num_heartbeats_timeout": 20,
+        "gcs_resource_broadcast_period_milliseconds": 50,
+    })
+    try:
+        cluster = global_worker().cluster
+        cluster.start_head_service()
+        types = {
+            "head": {"resources": {"CPU": 1}, "max_workers": 0},
+            "worker": {"resources": {"CPU": 1, "grunt": 2},
+                       "min_workers": 0, "max_workers": 2},
+        }
+        provider = LocalProcessProvider(cluster, types)
+        monitor = Monitor(cluster, types, max_workers=2,
+                          idle_timeout_minutes=60, provider=provider)
+        try:
+            @ray_tpu.remote(num_cpus=0, resources={"grunt": 1.0})
+            def where():
+                import os
+                return os.getpid()
+
+            ref = where.remote()      # infeasible until a worker node
+            deadline = time_mod.monotonic() + 60
+            while time_mod.monotonic() < deadline:
+                monitor.update_load_metrics()
+                monitor.autoscaler.update()
+                workers = provider.non_terminated_nodes(
+                    {TAG_NODE_KIND: NODE_KIND_WORKER})
+                if workers:
+                    break
+                time_mod.sleep(0.2)
+            assert workers, "autoscaler never launched a node_host"
+            handle = provider._handles[workers[0]]
+            assert handle.proc.poll() is None, "daemon not running"
+            pid = ray_tpu.get(ref, timeout=60)
+            assert pid == handle.proc.pid, \
+                "task did not run inside the launched OS process"
+            # Scale down: terminate and confirm the process dies.
+            provider.terminate_node(workers[0])
+            handle.proc.wait(timeout=15)
+            assert handle.proc.poll() is not None
+        finally:
+            monitor.stop()
+    finally:
+        ray_tpu.shutdown()
