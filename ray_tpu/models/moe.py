@@ -1,0 +1,116 @@
+"""Mixture-of-experts FFN with expert parallelism over an ``ep`` mesh
+axis.
+
+TPU-first design (GShard/Switch recipe, the scaling-book EP chapter's
+shape): top-1 router, capacity-bounded dense dispatch/combine einsums —
+everything is static-shaped matmuls and one-hots, so XLA lays the
+dispatch as all-to-all over the ``ep`` axis when the expert dimension
+is sharded there.  The reference framework has no MoE at all (SURVEY
+§5.7 — parallelism beyond DP is an extension our substrate makes
+natural).
+
+Per layer, with T = B*S tokens, E experts, capacity C:
+    probs   = softmax(x @ wr)                        [T, E]
+    choice  = argmax_E                               (switch top-1)
+    pos     = rank of each token within its expert   (cumsum one-hot)
+    disp    = onehot(choice) & (pos < C)             [T, E, C]
+    ex_in   = einsum('tec,td->ecd', disp, x)         (all-to-all in)
+    ex_out  = silu(ex_in @ w1_e) * (ex_in @ w3_e) @ w2_e   per expert
+    y       = einsum('tec,ecd->td', disp * gate, ex_out)   (back)
+Tokens beyond capacity are dropped (residual passes them through) —
+standard Switch behavior.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def init_moe_params(rng: jax.Array, n_layers: int, d_model: int,
+                    d_ff: int, n_experts: int, dtype) -> Dict:
+    init = jax.nn.initializers.normal(0.02)
+    keys = jax.random.split(rng, 4)
+
+    def stacked(key, shape):
+        return init(key, (n_layers, *shape), jnp.float32).astype(dtype)
+
+    return {
+        "wr": stacked(keys[0], (d_model, n_experts)),
+        "w1": stacked(keys[1], (n_experts, d_model, d_ff)),
+        "w3": stacked(keys[2], (n_experts, d_model, d_ff)),
+        "w2": stacked(keys[3], (n_experts, d_ff, d_model)),
+    }
+
+
+def moe_param_specs() -> Dict:
+    """Experts sharded over ``ep``; router replicated."""
+    return {
+        "wr": P(None, None),
+        "w1": P(None, "ep", None, None),
+        "w3": P(None, "ep", None, None),
+        "w2": P(None, "ep", None, None),
+    }
+
+
+def moe_ffn(x: jax.Array, lp: Dict, n_experts: int,
+            capacity_factor: float, mesh=None) -> jax.Array:
+    """One MoE FFN block: x [B, S, D] -> [B, S, D] (residual NOT
+    included).  ``lp`` holds this layer's wr/w1/w3/w2."""
+    B, S, D = x.shape
+    T = B * S
+    capacity = max(1, int(capacity_factor * T / n_experts))
+    xt = x.reshape(T, D)
+    logits = jnp.einsum("td,de->te", xt.astype(jnp.float32),
+                        lp["wr"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+    choice = jnp.argmax(probs, axis=-1)                   # [T]
+    gate = jnp.max(probs, axis=-1)                        # [T]
+    onehot = jax.nn.one_hot(choice, n_experts,
+                            dtype=jnp.float32)            # [T, E]
+    # Position of each token within its chosen expert's queue.
+    pos = jnp.cumsum(onehot, axis=0) * onehot - onehot    # excl. [T, E]
+    within = pos < capacity
+    disp = onehot * within                                # [T, E]
+    slot = jax.nn.one_hot(pos.sum(axis=-1).astype(jnp.int32),
+                          capacity, dtype=jnp.float32)    # [T, C]
+    dispatch = jnp.einsum("te,tc->tec", disp, slot)       # [T, E, C]
+    combine = dispatch * gate[:, None, None]
+    ex_in = jnp.einsum("tec,td->ecd", dispatch,
+                       xt.astype(jnp.float32))            # [E, C, D]
+    if mesh is not None and "ep" in mesh.axis_names:
+        # Experts over ep AND capacity rows over dp: capacity slots are
+        # independent, so dp shards each run 1/dp of every expert's
+        # matmuls instead of replicating the full global-capacity
+        # compute per replica.
+        ex_in = jax.lax.with_sharding_constraint(
+            ex_in, NamedSharding(mesh, P("ep", "dp", None)))
+    ex_in = ex_in.astype(x.dtype)
+    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", ex_in, lp["w1"])) * \
+        jnp.einsum("ecd,edf->ecf", ex_in, lp["w3"])
+    ex_out = jnp.einsum("ecf,efd->ecd", h, lp["w2"])      # [E, C, D]
+    if mesh is not None and "ep" in mesh.axis_names:
+        ex_out = jax.lax.with_sharding_constraint(
+            ex_out, NamedSharding(mesh, P("ep", "dp", None)))
+    y = jnp.einsum("tec,ecd->td", combine,
+                   ex_out.astype(jnp.float32))            # [T, D]
+    return y.astype(x.dtype).reshape(B, S, D)
+
+
+def aux_load_balance_loss(x: jax.Array, wr: jax.Array,
+                          n_experts: int) -> jax.Array:
+    """Switch load-balance auxiliary loss: E * sum_e f_e * p_e, where
+    f_e = fraction of tokens routed to e, p_e = mean router prob."""
+    T = x.shape[0] * x.shape[1]
+    xt = x.reshape(T, -1)
+    probs = jax.nn.softmax(
+        jnp.einsum("td,de->te", xt.astype(jnp.float32),
+                   wr.astype(jnp.float32)), axis=-1)
+    choice = jax.nn.one_hot(jnp.argmax(probs, axis=-1), n_experts,
+                            dtype=jnp.float32)
+    f = jnp.mean(choice, axis=0)
+    p = jnp.mean(probs, axis=0)
+    return n_experts * jnp.sum(f * p)

@@ -42,6 +42,13 @@ class TransformerConfig:
     remat: bool = True
     #: Use ring attention over the "sp" mesh axis when its size > 1.
     context_parallel: bool = True
+    #: >0 replaces the dense FFN with a switch-MoE of this many experts
+    #: (expert weights shard over the "ep" mesh axis — models/moe.py).
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    #: Switch load-balance auxiliary loss weight (prevents router
+    #: collapse onto one expert under top-1 routing).
+    moe_aux_coeff: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -58,20 +65,29 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> Dict:
     def stacked(key, shape):
         return init(key, (nl,) + shape, jnp.float32).astype(cfg.dtype)
 
-    return {
-        "embed": init(k_embed, (cfg.vocab_size, d), jnp.float32
-                      ).astype(cfg.dtype),
-        "layers": {
-            "ln1": jnp.ones((nl, d), jnp.float32),
-            "ln2": jnp.ones((nl, d), jnp.float32),
-            "wq": stacked(lkeys[0], (d, h, dh)),
-            "wk": stacked(lkeys[1], (d, h, dh)),
-            "wv": stacked(lkeys[2], (d, h, dh)),
-            "wo": stacked(lkeys[3], (h, dh, d)),
+    layers: Dict = {
+        "ln1": jnp.ones((nl, d), jnp.float32),
+        "ln2": jnp.ones((nl, d), jnp.float32),
+        "wq": stacked(lkeys[0], (d, h, dh)),
+        "wk": stacked(lkeys[1], (d, h, dh)),
+        "wv": stacked(lkeys[2], (d, h, dh)),
+        "wo": stacked(lkeys[3], (h, dh, d)),
+    }
+    if cfg.moe_experts > 0:
+        from ray_tpu.models.moe import init_moe_params
+        layers["moe"] = init_moe_params(
+            jax.random.fold_in(k_layers, 8), nl, d, f,
+            cfg.moe_experts, cfg.dtype)
+    else:
+        layers.update({
             "w1": stacked(lkeys[4], (d, f)),
             "w3": stacked(lkeys[5], (d, f)),
             "w2": stacked(jax.random.fold_in(k_layers, 7), (f, d)),
-        },
+        })
+    return {
+        "embed": init(k_embed, (cfg.vocab_size, d), jnp.float32
+                      ).astype(cfg.dtype),
+        "layers": layers,
         "ln_f": jnp.ones((d,), jnp.float32),
         "lm_head": init(k_head, (d, cfg.vocab_size), jnp.float32
                         ).astype(cfg.dtype),
@@ -79,20 +95,28 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> Dict:
 
 
 def param_specs(cfg: TransformerConfig) -> Dict:
-    """PartitionSpecs: Megatron TP on heads/FFN-hidden, vocab on lm_head."""
-    return {
-        "embed": P(None, "tp"),
-        "layers": {
-            "ln1": P(None, None),
-            "ln2": P(None, None),
-            "wq": P(None, None, "tp", None),
-            "wk": P(None, None, "tp", None),
-            "wv": P(None, None, "tp", None),
-            "wo": P(None, "tp", None, None),
+    """PartitionSpecs: Megatron TP on heads/FFN-hidden, vocab on
+    lm_head; MoE expert weights shard over "ep"."""
+    layers: Dict = {
+        "ln1": P(None, None),
+        "ln2": P(None, None),
+        "wq": P(None, None, "tp", None),
+        "wk": P(None, None, "tp", None),
+        "wv": P(None, None, "tp", None),
+        "wo": P(None, "tp", None, None),
+    }
+    if cfg.moe_experts > 0:
+        from ray_tpu.models.moe import moe_param_specs
+        layers["moe"] = moe_param_specs()
+    else:
+        layers.update({
             "w1": P(None, None, "tp"),
             "w3": P(None, None, "tp"),
             "w2": P(None, "tp", None),
-        },
+        })
+    return {
+        "embed": P(None, "tp"),
+        "layers": layers,
         "ln_f": P(None),
         "lm_head": P(None, "tp"),
     }
@@ -139,6 +163,14 @@ def _attention_core(q, k, v, mesh, cfg: TransformerConfig):
 def forward(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
             mesh=None) -> jax.Array:
     """tokens [B, S] int32 -> logits [B, S, V]."""
+    logits, _aux = forward_with_aux(params, tokens, cfg, mesh)
+    return logits
+
+
+def forward_with_aux(params: Dict, tokens: jax.Array,
+                     cfg: TransformerConfig, mesh=None):
+    """Like :func:`forward` but also returns the mean per-layer MoE
+    load-balance auxiliary (0 for dense models)."""
     B, S = tokens.shape
     x = jnp.take(params["embed"], tokens, axis=0)     # [B, S, D]
     if mesh is not None:
@@ -146,7 +178,8 @@ def forward(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
             x, NamedSharding(mesh, P("dp", "sp", None)))
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
 
-    def layer(x, lp):
+    def layer(carry, lp):
+        x, aux = carry
         h = _rms_norm(x, lp["ln1"])
         q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
         k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
@@ -156,30 +189,48 @@ def forward(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
         o = _attention_core(q, k, v, mesh, cfg)
         x = x + jnp.einsum("bshk,hkd->bsd", o, lp["wo"])
         h = _rms_norm(x, lp["ln2"])
-        gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, lp["w1"]))
-        up = jnp.einsum("bsd,df->bsf", h, lp["w3"])
-        x = x + jnp.einsum("bsf,fd->bsd", gate * up, lp["w2"])
+        if cfg.moe_experts > 0:
+            from ray_tpu.models.moe import (aux_load_balance_loss,
+                                            moe_ffn)
+            x = x + moe_ffn(h, lp["moe"], cfg.moe_experts,
+                            cfg.moe_capacity_factor, mesh)
+            aux = aux + aux_load_balance_loss(h, lp["moe"]["wr"],
+                                              cfg.moe_experts)
+        else:
+            gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, lp["w1"]))
+            up = jnp.einsum("bsd,df->bsf", h, lp["w3"])
+            x = x + jnp.einsum("bsf,fd->bsd", gate * up, lp["w2"])
         if mesh is not None:
             x = jax.lax.with_sharding_constraint(
                 x, NamedSharding(mesh, P("dp", "sp", None)))
-        return x, None
+        return (x, aux), None
 
     layer_fn = jax.checkpoint(layer) if cfg.remat else layer
-    x, _ = jax.lax.scan(lambda c, lp: layer_fn(c, lp), x, params["layers"])
+    (x, aux), _ = jax.lax.scan(lambda c, lp: layer_fn(c, lp),
+                               (x, jnp.zeros((), jnp.float32)),
+                               params["layers"])
     x = _rms_norm(x, params["ln_f"])
-    return jnp.einsum("bsd,dv->bsv", x, params["lm_head"])
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"])
+    return logits, aux / max(1, cfg.n_layers)
 
 
 def loss_fn(params: Dict, batch: Dict, cfg: TransformerConfig,
             mesh=None) -> jax.Array:
-    """Next-token cross entropy.  batch = {"tokens": [B, S+1] int32}."""
+    """Next-token cross entropy (+ MoE load-balance auxiliary when
+    experts are on: without it, top-1 routing collapses onto one
+    expert and over-capacity tokens get dropped en masse).
+    batch = {"tokens": [B, S+1] int32}."""
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits = forward(params, inputs, cfg, mesh).astype(jnp.float32)
+    logits, aux = forward_with_aux(params, inputs, cfg, mesh)
+    logits = logits.astype(jnp.float32)
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None],
                                axis=-1).squeeze(-1)
-    return jnp.mean(logz - gold)
+    loss = jnp.mean(logz - gold)
+    if cfg.moe_experts > 0 and cfg.moe_aux_coeff > 0:
+        loss = loss + cfg.moe_aux_coeff * aux
+    return loss
 
 
 # ---------------------------------------------------------------------------
