@@ -160,6 +160,36 @@ def _attention_core(q, k, v, mesh, cfg: TransformerConfig):
     return flash_or_ref_attention(q, k, v, causal=True)
 
 
+def apply_layer(x, lp, positions, cfg: TransformerConfig, mesh=None):
+    """One transformer block on [B, S, D] activations with this
+    layer's params ``lp``; returns (x, moe_aux).  Shared by the scan
+    forward and the pipeline-parallel stage executor."""
+    h = _rms_norm(x, lp["ln1"])
+    q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
+    k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
+    v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    o = _attention_core(q, k, v, mesh, cfg)
+    x = x + jnp.einsum("bshk,hkd->bsd", o, lp["wo"])
+    h = _rms_norm(x, lp["ln2"])
+    aux = jnp.zeros((), jnp.float32)
+    if cfg.moe_experts > 0:
+        from ray_tpu.models.moe import aux_load_balance_loss, moe_ffn
+        x = x + moe_ffn(h, lp["moe"], cfg.moe_experts,
+                        cfg.moe_capacity_factor, mesh)
+        aux = aux_load_balance_loss(h, lp["moe"]["wr"],
+                                    cfg.moe_experts)
+    else:
+        gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, lp["w1"]))
+        up = jnp.einsum("bsd,df->bsf", h, lp["w3"])
+        x = x + jnp.einsum("bsf,fd->bsd", gate * up, lp["w2"])
+    if mesh is not None:
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P("dp", "sp", None)))
+    return x, aux
+
+
 def forward(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
             mesh=None) -> jax.Array:
     """tokens [B, S] int32 -> logits [B, S, V]."""
@@ -180,30 +210,8 @@ def forward_with_aux(params: Dict, tokens: jax.Array,
 
     def layer(carry, lp):
         x, aux = carry
-        h = _rms_norm(x, lp["ln1"])
-        q = jnp.einsum("bsd,dhk->bshk", h, lp["wq"])
-        k = jnp.einsum("bsd,dhk->bshk", h, lp["wk"])
-        v = jnp.einsum("bsd,dhk->bshk", h, lp["wv"])
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
-        o = _attention_core(q, k, v, mesh, cfg)
-        x = x + jnp.einsum("bshk,hkd->bsd", o, lp["wo"])
-        h = _rms_norm(x, lp["ln2"])
-        if cfg.moe_experts > 0:
-            from ray_tpu.models.moe import (aux_load_balance_loss,
-                                            moe_ffn)
-            x = x + moe_ffn(h, lp["moe"], cfg.moe_experts,
-                            cfg.moe_capacity_factor, mesh)
-            aux = aux + aux_load_balance_loss(h, lp["moe"]["wr"],
-                                              cfg.moe_experts)
-        else:
-            gate = jax.nn.silu(jnp.einsum("bsd,df->bsf", h, lp["w1"]))
-            up = jnp.einsum("bsd,df->bsf", h, lp["w3"])
-            x = x + jnp.einsum("bsf,fd->bsd", gate * up, lp["w2"])
-        if mesh is not None:
-            x = jax.lax.with_sharding_constraint(
-                x, NamedSharding(mesh, P("dp", "sp", None)))
-        return (x, aux), None
+        x, layer_aux = apply_layer(x, lp, positions, cfg, mesh)
+        return (x, aux + layer_aux), None
 
     layer_fn = jax.checkpoint(layer) if cfg.remat else layer
     (x, aux), _ = jax.lax.scan(lambda c, lp: layer_fn(c, lp),
@@ -238,7 +246,8 @@ def loss_fn(params: Dict, batch: Dict, cfg: TransformerConfig,
 # ---------------------------------------------------------------------------
 
 def make_train_state(rng, cfg: TransformerConfig, mesh=None,
-                     learning_rate: float = 3e-4):
+                     learning_rate: float = 3e-4,
+                     specs_override: Optional[Dict] = None):
     import optax
     tx = optax.adamw(learning_rate, b1=0.9, b2=0.95, weight_decay=0.1)
     params = init_params(rng, cfg)
@@ -246,7 +255,7 @@ def make_train_state(rng, cfg: TransformerConfig, mesh=None,
     state = {"params": params, "opt": opt_state,
              "step": jnp.zeros((), jnp.int32)}
     if mesh is not None:
-        specs = param_specs(cfg)
+        specs = specs_override or param_specs(cfg)
         state_specs = {
             "params": specs,
             "opt": jax.tree.map(
@@ -273,10 +282,15 @@ def _opt_specs(opt_state, param_spec_tree):
     return tuple(one(e) for e in opt_state)
 
 
-def make_train_step(cfg: TransformerConfig, tx, mesh=None):
+def make_train_step(cfg: TransformerConfig, tx, mesh=None,
+                    loss_override=None):
+    """``loss_override(params, batch)`` substitutes the plain loss
+    (used by the pipeline-parallel schedule)."""
     def train_step(state, batch):
+        compute = loss_override or (
+            lambda p, b: loss_fn(p, b, cfg, mesh))
         loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(p, batch, cfg, mesh))(state["params"])
+            lambda p: compute(p, batch))(state["params"])
         updates, new_opt = tx.update(grads, state["opt"], state["params"])
         new_params = jax.tree.map(
             lambda p, u: (p.astype(jnp.float32) + u).astype(p.dtype),
