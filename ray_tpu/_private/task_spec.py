@@ -97,6 +97,10 @@ class TaskSpec:
     max_concurrency: int = 1
     max_task_retries: int = 0
     concurrency_group: str = ""
+    # Actor creation only: {group_name: max_concurrency} — methods
+    # tagged with a group execute in that group's own pool
+    # (concurrency_group_manager.cc parity).
+    concurrency_groups: Optional[dict] = None
     # Placement group
     placement_group_id: Optional[PlacementGroupID] = None
     placement_group_bundle_index: int = -1

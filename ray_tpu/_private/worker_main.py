@@ -81,6 +81,8 @@ class _WorkerRuntime:
         self._fn_cache: Dict[bytes, object] = {}
         self.actor_instance = None
         self._sema: Optional[threading.Semaphore] = None
+        # Per-concurrency-group bounds (concurrency_group_manager.cc).
+        self._group_semas: Dict[str, threading.Semaphore] = {}
         self._order_lock = threading.Lock()
         self._stop_event = threading.Event()
         # Plasma-client mapping of the node's shm segment (metadata via
@@ -138,12 +140,16 @@ class _WorkerRuntime:
 
     def _handle_push(self, payload, reply):
         kind = payload["kind"]
-        if kind == "actor_task" and self._sema is not None:
-            self._sema.acquire()
+        sema = None
+        if kind == "actor_task":
+            group = payload.get("concurrency_group") or ""
+            sema = self._group_semas.get(group, self._sema)
+        if sema is not None:
+            sema.acquire()
             try:
                 reply(self._execute(payload))
             finally:
-                self._sema.release()
+                sema.release()
         else:
             reply(self._execute(payload))
 
@@ -168,6 +174,11 @@ class _WorkerRuntime:
                     self.actor_instance = cls(*args, **kwargs)
                     n = max(1, int(payload.get("max_concurrency", 1)))
                     self._sema = threading.Semaphore(n)
+                    for gname, gsize in (
+                            payload.get("concurrency_groups")
+                            or {}).items():
+                        self._group_semas[gname] = threading.Semaphore(
+                            max(1, int(gsize)))
                     out = {"error": None, "returns": []}
                 elif kind == "actor_task":
                     if self.actor_instance is None:

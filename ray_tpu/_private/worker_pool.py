@@ -62,6 +62,7 @@ class Worker:
         self.actor_id = None
         self.actor_instance = None
         self._actor_threads: List[threading.Thread] = []
+        self._group_queues: Dict[str, "queue.Queue"] = {}
         self._max_concurrency = 1
         self._killed = threading.Event()
         self._thread = threading.Thread(
@@ -82,8 +83,15 @@ class Worker:
 
     def submit_actor_task(self, spec, on_done: Callable):
         """Ordered actor method execution (sequential_actor_submit_queue
-        parity; max_concurrency>1 uses the out-of-order queue)."""
-        self._queue.put(("actor_task", spec, on_done))
+        parity; max_concurrency>1 uses the out-of-order queue).  A
+        method tagged with a concurrency group routes to that group's
+        own pool (concurrency_group_manager.cc)."""
+        group = getattr(spec, "concurrency_group", "")
+        gq = self._group_queues.get(group) if group else None
+        if gq is not None:
+            gq.put(("actor_task", spec, on_done))
+        else:
+            self._queue.put(("actor_task", spec, on_done))
 
     def kill_actor(self):
         self._killed.set()
@@ -138,7 +146,20 @@ class Worker:
             for i in range(self._max_concurrency - 1):
                 t = threading.Thread(target=self._actor_concurrent_loop,
                                      daemon=True,
-                                     name=f"{self._thread.name}::cg{i}")
+                                     name=f"{self._thread.name}::cc{i}")
+                t.start()
+                self._actor_threads.append(t)
+        # Named concurrency groups: each gets its own queue + thread
+        # pool, concurrent with the default group and each other
+        # (concurrency_group_manager.cc parity).
+        for gname, gsize in (spec.concurrency_groups or {}).items():
+            gq: "queue.Queue" = queue.Queue()
+            self._group_queues[gname] = gq
+            for i in range(max(1, int(gsize))):
+                t = threading.Thread(
+                    target=self._actor_concurrent_loop, args=(gq,),
+                    daemon=True,
+                    name=f"{self._thread.name}::cg-{gname}-{i}")
                 t.start()
                 self._actor_threads.append(t)
         on_done(None)
@@ -149,20 +170,21 @@ class Worker:
             actor_instance=self.actor_instance)
         on_done(None if ok else err)
 
-    def _actor_concurrent_loop(self):
+    def _actor_concurrent_loop(self, source: "queue.Queue" = None):
         worker_context.set_context(
             worker_context.ExecutionContext(worker=self, node=self.node))
         from ray_tpu._private import executor as executor_mod
+        src = source if source is not None else self._queue
         while not self._killed.is_set():
             try:
-                kind, spec, on_done = self._queue.get(timeout=1.0)
+                kind, spec, on_done = src.get(timeout=1.0)
             except queue.Empty:
                 continue
             if kind == "exit":
-                self._queue.put(("exit", None, None))  # propagate to siblings
+                src.put(("exit", None, None))  # propagate to siblings
                 break
             self._run_actor_task(spec, on_done, executor_mod)
-            kind = spec = on_done = None   # same: no idle-frame pinning
+            kind, spec, on_done = None, None, None  # no idle-frame pinning
 
     def _on_exit(self):
         was_actor = self.state == WorkerState.ACTOR
@@ -511,9 +533,12 @@ class ProcessWorker:
                 continue
             if kind == "exit":
                 break
-            if kind == "actor_task" and self._max_concurrency > 1:
+            if kind == "actor_task" and (
+                    self._max_concurrency > 1 or
+                    getattr(spec, "concurrency_group", "")):
                 # Out-of-order queue parity: up to max_concurrency calls
-                # in flight; replies handled on the client reader.
+                # in flight (group-tagged calls bound by their group's
+                # semaphore in the child); replies on the client reader.
                 fut = self._client.call_future(
                     "push", self._build_payload(kind, spec))
                 fut.add_done_callback(
@@ -577,6 +602,9 @@ class ProcessWorker:
         return {
             "kind": kind,
             "trace_ctx": getattr(spec, "trace_ctx", None),
+            "concurrency_group": getattr(spec, "concurrency_group", ""),
+            "concurrency_groups": getattr(spec, "concurrency_groups",
+                                          None),
             "function_key": fn_key,
             "function_name": spec.function_name,
             "actor_method_name": spec.actor_method_name,

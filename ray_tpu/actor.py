@@ -23,6 +23,7 @@ from ray_tpu.remote_function import (
 _DEFAULT_ACTOR_OPTIONS = dict(
     num_cpus=None, num_tpus=0, num_gpus=0, memory=0, resources=None,
     max_restarts=0, max_task_retries=0, max_concurrency=1,
+    concurrency_groups=None,
     name=None, namespace=None, lifetime=None, scheduling_strategy=None,
     runtime_env=None,
 )
@@ -30,18 +31,23 @@ _DEFAULT_ACTOR_OPTIONS = dict(
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", method_name: str,
-                 num_returns: int = 1):
+                 num_returns: int = 1, concurrency_group: str = ""):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
     def remote(self, *args, **kwargs):
         return self._handle._submit_method(
-            self._method_name, args, kwargs, num_returns=self._num_returns)
+            self._method_name, args, kwargs,
+            num_returns=self._num_returns,
+            concurrency_group=self._concurrency_group)
 
-    def options(self, num_returns: int = 1, **_):
+    def options(self, num_returns: int = 1,
+                concurrency_group: str = "", **_):
         return ActorMethod(self._handle, self._method_name,
-                           num_returns=num_returns)
+                           num_returns=num_returns,
+                           concurrency_group=concurrency_group)
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -65,7 +71,8 @@ class ActorHandle:
         return ActorMethod(self, name)
 
     def _submit_method(self, method_name: str, args, kwargs,
-                       num_returns: int = 1):
+                       num_returns: int = 1,
+                       concurrency_group: str = ""):
         w = worker_mod.global_worker()
         core = w.core_worker
         gcs_actor = w.cluster.gcs.actor_manager.get_actor(self._actor_id)
@@ -86,6 +93,7 @@ class ActorHandle:
             task_type=TaskType.ACTOR_TASK,
             actor_id=self._actor_id,
             actor_method_name=method_name,
+            concurrency_group=concurrency_group,
             max_retries=(creation.max_task_retries if creation else 0),
             borrowed_ids=borrowed,
         )
@@ -167,6 +175,7 @@ class ActorClass:
             max_restarts=o.get("max_restarts", 0),
             max_task_retries=o.get("max_task_retries", 0),
             max_concurrency=o.get("max_concurrency", 1),
+            concurrency_groups=o.get("concurrency_groups"),
             placement_group_id=pg_id,
             placement_group_bundle_index=bundle_idx,
             runtime_env=_normalized_env(o.get("runtime_env"), w),
