@@ -61,10 +61,18 @@ class Raylet:
         self.loop.schedule_every(cfg.event_loop_tick_ms / 1000.0,
                                  self.cluster_task_manager.schedule_and_dispatch,
                                  "raylet.schedule_tick")
-        # Heartbeats to GCS.
-        self.loop.schedule_every(
-            cfg.raylet_heartbeat_period_milliseconds / 1000.0,
-            self._heartbeat, "raylet.heartbeat")
+        # Heartbeats to GCS on a DEDICATED thread: the event loop runs
+        # callbacks serially, so one long callback (a big serialization,
+        # a compile) would delay beats behind it and a loaded box could
+        # miss num_heartbeats_timeout in a row — a false node death.
+        # The reference raylet also heartbeats off its main dispatch
+        # path (gcs_heartbeat_manager.h).
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop,
+            args=(cfg.raylet_heartbeat_period_milliseconds / 1000.0,),
+            daemon=True,
+            name=f"ray_tpu::hb::{self.node_id.hex()[:6]}")
+        self._hb_thread.start()
         # Seed own view.
         self.cluster_view.add_node(self.node_id, self.local_resources)
 
@@ -138,6 +146,15 @@ class Raylet:
     def _heartbeat(self):
         if not self._dead:
             self.cluster.gcs.heartbeat_manager.heartbeat(self.node_id)
+
+    def _heartbeat_loop(self, period_s: float):
+        import time as time_mod
+        while not self._dead:
+            try:
+                self._heartbeat()
+            except Exception:
+                pass
+            time_mod.sleep(period_s)
 
     # ---- lease protocol (NodeManagerService) ----------------------------
     def request_worker_lease(self, spec: TaskSpec, reply: Callable):

@@ -31,15 +31,24 @@ before any result is awaited).
 
 from __future__ import annotations
 
+import itertools
+import uuid
 from typing import Any, Dict, List, Optional
 
 import ray_tpu
 
 
-class DAGNode:
-    """Base of the authoring nodes."""
+_uid_counter = itertools.count()
 
-    def _resolve(self, input_value, cache: Dict[int, Any]):
+
+class DAGNode:
+    """Base of the authoring nodes.  Every node gets a stable ``_uid``
+    so a DAGHandle survives pickling (object ids do not)."""
+
+    def __init__(self):
+        self._uid = f"n{next(_uid_counter)}-{uuid.uuid4().hex[:8]}"
+
+    def _resolve(self, input_value, cache: Dict[str, Any]):
         raise NotImplementedError
 
 
@@ -62,6 +71,7 @@ class InputNode(DAGNode):
 
 class _InputAttr(DAGNode):
     def __init__(self, parent: InputNode, idx):
+        super().__init__()
         self._parent = parent
         self._idx = idx
 
@@ -74,6 +84,7 @@ class ClassNode(DAGNode):
 
     def __init__(self, deployment, init_args: tuple,
                  init_kwargs: dict):
+        super().__init__()
         self._deployment = deployment
         self._init_args = init_args
         self._init_kwargs = init_kwargs
@@ -102,16 +113,17 @@ class _MethodBinder:
 class MethodNode(DAGNode):
     def __init__(self, class_node: ClassNode, method_name: str,
                  args: tuple, kwargs: dict):
+        super().__init__()
         self._class_node = class_node
         self._method = method_name
         self._args = args
         self._kwargs = kwargs
 
     def _resolve(self, input_value, cache):
-        key = id(self)
+        key = self._uid
         if key in cache:
             return cache[key]
-        handle = cache["handles"][id(self._class_node)]
+        handle = cache["handles"][self._class_node._uid]
         # Upstream results pass as ObjectRefs: the replica call's arg
         # resolution awaits them, so every branch of the DAG is in
         # flight before anything blocks (true dataflow execution).
@@ -127,15 +139,16 @@ class FunctionNode(DAGNode):
     """A function deployment bound to upstream nodes."""
 
     def __init__(self, deployment, args: tuple, kwargs: dict):
+        super().__init__()
         self._deployment = deployment
         self._args = args
         self._kwargs = kwargs
 
     def _resolve(self, input_value, cache):
-        key = id(self)
+        key = self._uid
         if key in cache:
             return cache[key]
-        handle = cache["handles"][id(self)]
+        handle = cache["handles"][self._uid]
         args = [_submit(a, input_value, cache) for a in self._args]
         kwargs = {k: _submit(v, input_value, cache)
                   for k, v in self._kwargs.items()}
@@ -156,10 +169,10 @@ class DAGHandle:
     """The built pipeline: ``remote(input)`` runs one request through
     the graph and returns a ref to the root's result."""
 
-    def __init__(self, root: DAGNode, handles: Dict[int, Any],
+    def __init__(self, root: DAGNode, handles: Dict[str, Any],
                  deployments: List):
         self._root = root
-        self._handles = handles      # node id -> DeploymentHandle
+        self._handles = handles      # node uid -> DeploymentHandle
         self.deployments = deployments
 
     def remote(self, input_value=None):
@@ -172,9 +185,9 @@ class DAGHandle:
 
 
 def _collect(node, class_nodes: List, fn_nodes: List, seen: set):
-    if id(node) in seen or not isinstance(node, DAGNode):
+    if not isinstance(node, DAGNode) or node._uid in seen:
         return
-    seen.add(id(node))
+    seen.add(node._uid)
     if isinstance(node, MethodNode):
         _collect(node._class_node, class_nodes, fn_nodes, seen)
         for a in list(node._args) + list(node._kwargs.values()):
@@ -192,9 +205,9 @@ def _collect(node, class_nodes: List, fn_nodes: List, seen: set):
         _collect(node._parent, class_nodes, fn_nodes, seen)
 
 
-def build(root: DAGNode) -> DAGHandle:
+def _build_inner(root: DAGNode) -> DAGHandle:
     """Deploy every deployment the DAG references and return a runnable
-    handle (reference ``pipeline.build``, api.py:8).
+    handle.
 
     Naming never mutates the author's nodes (a node reused across two
     builds keeps both DAGHandles working) and never collides with
@@ -220,7 +233,7 @@ def build(root: DAGNode) -> DAGHandle:
     # the already-deployed handle.
     def materialize_init_arg(a):
         if isinstance(a, ClassNode):
-            return handles[id(a)]
+            return handles[a._uid]
         if isinstance(a, DAGNode):
             raise TypeError(
                 "only bound classes (handles) and plain values may be "
@@ -239,7 +252,7 @@ def build(root: DAGNode) -> DAGHandle:
         else:
             d.deploy()
         deployments.append(d)
-        handles[id(node)] = serve.get_deployment(name).get_handle()
+        handles[node._uid] = serve.get_deployment(name).get_handle()
 
     # Composition means a ClassNode's init args may reference other
     # ClassNodes: deploy in dependency order.
@@ -250,7 +263,7 @@ def build(root: DAGNode) -> DAGHandle:
             deps = [a for a in (list(node._init_args) +
                                 list(node._init_kwargs.values()))
                     if isinstance(a, ClassNode)]
-            if all(id(dep) in handles for dep in deps):
+            if all(dep._uid in handles for dep in deps):
                 deploy_node(node)
                 pending.remove(node)
                 progressed = True
@@ -259,3 +272,45 @@ def build(root: DAGNode) -> DAGHandle:
     for node in fn_nodes:
         deploy_node(node)
     return DAGHandle(root, handles, deployments)
+
+
+class PipelineDriver:
+    """Ingress deployment wrapping a DAGHandle: HTTP requests (and
+    handle calls) run the graph (reference DAGDriver shape).  The
+    DAGHandle pickles into the replica — DeploymentHandles reconstruct
+    from their names, node identity is uid-stable."""
+
+    def __init__(self, dag_handle: "DAGHandle"):
+        self._dag = dag_handle
+
+    def __call__(self, request):
+        # HTTP path: the proxy passes an HTTPRequest; json body (or
+        # query "input") is the DAG input.  Direct handle calls pass
+        # the input value through unchanged.
+        value = request
+        body = getattr(request, "json", None)
+        if callable(body):
+            try:
+                value = body()
+            except Exception:
+                value = getattr(request, "query_params", {})
+        return ray_tpu.get(self._dag.remote(value))
+
+
+def build(root: DAGNode, http_route: Optional[str] = None):
+    """Deploy every deployment the DAG references and return a runnable
+    handle (reference ``pipeline.build``, api.py:8); with
+    ``http_route``, additionally deploy a :class:`PipelineDriver`
+    ingress bound to that route and return it as
+    ``handle.ingress``."""
+    handle = _build_inner(root)
+    handle.ingress = None
+    if http_route is not None:
+        from ray_tpu import serve
+        driver = serve.deployment(
+            PipelineDriver,
+            name=f"pipeline_driver{http_route.replace('/', '_')}",
+            route_prefix=http_route)
+        driver.deploy(handle)
+        handle.ingress = driver
+    return handle

@@ -336,8 +336,9 @@ class TestRollingUpdate:
         assert len(handles) == 2
         ray_tpu.kill(handles[0])
         # The periodic health check must notice and the reconciler must
-        # restore 2 healthy replicas.
-        deadline = time.monotonic() + 30
+        # restore 2 healthy replicas.  Budget: period x failure
+        # threshold + restart, with slack for a loaded box.
+        deadline = time.monotonic() + 60
         ok = False
         while time.monotonic() < deadline:
             info = ray_tpu.get(controller.get_deployment_info.remote("hc"))
@@ -445,3 +446,40 @@ class TestDeploymentPipeline:
         h2 = pipeline.build(dag2)
         assert ray_tpu.get(h2.remote(5), timeout=60) == 50
         assert ray_tpu.get(h1.remote(4), timeout=60) == 41
+
+    def test_http_ingress_for_pipeline(self, serve_instance):
+        """build(http_route=...) deploys a PipelineDriver: HTTP
+        requests run the whole DAG (DAGDriver shape)."""
+        import json as json_mod
+        import urllib.request
+
+        from ray_tpu import serve
+        from ray_tpu.serve import pipeline
+        from ray_tpu.serve.pipeline import InputNode
+
+        @serve.deployment
+        class Doubler:
+            def __init__(self):
+                pass
+
+            def run(self, x):
+                return x * 2
+
+        @serve.deployment
+        def plus_one(x):
+            return x + 1
+
+        with InputNode() as inp:
+            dag = plus_one.bind(Doubler.bind().run.bind(inp))
+        handle = pipeline.build(dag, http_route="/pipe")
+        assert handle.ingress is not None
+        # Direct handle path still works.
+        assert ray_tpu.get(handle.remote(20), timeout=60) == 41
+        # HTTP path: json body is the DAG input.
+        port = _proxy_port()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/pipe",
+            data=json_mod.dumps(5).encode(),
+            headers={"Content-Type": "application/json"})
+        body = urllib.request.urlopen(req, timeout=30).read()
+        assert json_mod.loads(body) == 11
