@@ -143,16 +143,37 @@ def _groupby_map(block: Block, key, n: int):
     return built[0] if n == 1 else built
 
 
-@ray_tpu.remote(num_cpus=1, num_returns=2)
-def _groupby_reduce(key, agg_name: str, on, *shards: Block):
+def _gather_groups(key, shards):
+    """shards -> {group_key: rows}, iterated in a stable order (shared
+    by every groupby reduce)."""
     groups: Dict[Any, List[Any]] = {}
     kf = _sort_key_fn(key)
     for s in shards:
         for r in BlockAccessor(s).iter_rows():
             groups.setdefault(kf(r), []).append(r)
-    out = BlockBuilder()
     for k in sorted(groups.keys(), key=lambda x: (str(type(x)), x)):
-        rows = groups[k]
+        yield k, groups[k]
+
+
+@ray_tpu.remote(num_cpus=1, num_returns=2)
+def _map_groups_reduce(key, fn, *shards: Block):
+    """User-function reduce over each hash partition's groups."""
+    out = BlockBuilder()
+    for _k, rows in _gather_groups(key, shards):
+        result = fn(rows)
+        if isinstance(result, list):
+            for row in result:
+                out.add(row)
+        else:
+            out.add(result)
+    block = out.build()
+    return block, BlockAccessor(block).get_metadata()
+
+
+@ray_tpu.remote(num_cpus=1, num_returns=2)
+def _groupby_reduce(key, agg_name: str, on, *shards: Block):
+    out = BlockBuilder()
+    for k, rows in _gather_groups(key, shards):
         if on is not None:
             vals = [r[on] for r in rows]
         else:
@@ -688,17 +709,27 @@ class GroupedDataset:
         self._ds = ds
         self._key = key
 
-    def _agg(self, name: str, on=None) -> Dataset:
+    def _hash_shuffle(self, reduce_remote_fn, *reduce_args) -> Dataset:
+        """Hash-partition shuffle + per-partition reduce (shared by the
+        aggregations and map_groups)."""
         n = max(1, self._ds.num_blocks())
         maps = [_groupby_map.options(num_returns=n).remote(b, self._key, n)
                 for b in self._ds._blocks]
         if n == 1:
             maps = [[m] for m in maps]
-        pairs = [
-            _groupby_reduce.remote(self._key, name, on, *[m[j] for m in maps])
+        pairs = [reduce_remote_fn.remote(
+            self._key, *reduce_args, *[m[j] for m in maps])
             for j in range(n)]
         return Dataset([p[0] for p in pairs],
                        metadata_refs=[p[1] for p in pairs])
+
+    def _agg(self, name: str, on=None) -> Dataset:
+        return self._hash_shuffle(_groupby_reduce, name, on)
+
+    def map_groups(self, fn: Callable) -> Dataset:
+        """Apply ``fn(rows) -> row | list[row]`` to every group
+        (reference ``GroupedDataset.map_groups``)."""
+        return self._hash_shuffle(_map_groups_reduce, fn)
 
     def count(self) -> Dataset:
         return self._agg("count")

@@ -192,11 +192,18 @@ def deployment(_func_or_class: Optional[Callable] = None, *,
     return wrap
 
 
-def run(target: Deployment, host: str = "127.0.0.1", port: int = 8000
-        ) -> DeploymentHandle:
+def run(target, host: str = "127.0.0.1", port: int = 8000,
+        route_prefix: str = "/"):
     """Deploy ``target`` with an HTTP ingress and return its handle
-    (reference ``serve.run``, api.py:1437)."""
+    (reference ``serve.run``, api.py:1437).  ``target`` may be a
+    Deployment or a pipeline DAG node (``Deployment.bind(...)``) — the
+    latter builds the whole graph behind the route."""
+    from ray_tpu.serve.pipeline import DAGNode, build
     start(http_options={"host": host, "port": port})
+    if isinstance(target, DAGNode):
+        return build(target, http_route=route_prefix)
+    if route_prefix != target.route_prefix:
+        target = target.options(route_prefix=route_prefix)
     target.deploy()
     return target.get_handle()
 

@@ -307,3 +307,21 @@ class TestRandomAccessDataset:
         # OWN block, not the next one.
         rows = rad.multiget([15, 31, 47])
         assert [r["id"] for r in rows] == [15, 31, 47]
+
+
+class TestMapGroups:
+    def test_map_groups_rows_and_lists(self, ray_start_regular):
+        import ray_tpu.data as rdata
+        ds = rdata.from_items([
+            {"k": i % 3, "v": float(i)} for i in range(30)])
+        # One summary row per group.
+        out = ds.groupby("k").map_groups(
+            lambda rows: {"k": rows[0]["k"],
+                          "total": sum(r["v"] for r in rows)})
+        rows = sorted(out.take(10), key=lambda r: r["k"])
+        assert [r["k"] for r in rows] == [0, 1, 2]
+        assert rows[0]["total"] == sum(float(i) for i in range(0, 30, 3))
+        # Expanding fn: list returns flatten.
+        out2 = ds.groupby("k").map_groups(
+            lambda rows: [{"k": rows[0]["k"], "n": len(rows)}] * 2)
+        assert out2.count() == 6
