@@ -88,9 +88,49 @@ def arrival_stream(rng, counts, ticks, per_tick=130_000):
     return stream
 
 
+def _init_backend():
+    """Bring up a jax backend, falling back to CPU when the configured
+    platform (e.g. a TPU plugin) fails to initialize.  Returns the
+    backend name, or None when no backend at all comes up — the bench
+    must emit parseable JSON and rc=0 in that case, not a backend-init
+    traceback (BENCH_r05 recorded rc=1 nulls from exactly this)."""
+    try:
+        import jax
+        jax.devices()
+        return jax.default_backend()
+    except Exception:
+        pass
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.devices()
+        return jax.default_backend()
+    except Exception:
+        return None
+
+
 def main():
+    backend = _init_backend()
+    if backend is None:
+        print(json.dumps({
+            "metric": "scheduler_tick_1M_tasks_x_10k_nodes",
+            "value": None, "unit": "ms", "skipped": True,
+            "reason": "no jax backend initialized (TPU plugin failed "
+                      "and no CPU fallback)",
+        }))
+        return 0
+
     rng = np.random.default_rng(42)
-    avail, total, demand, counts, accel_node, accel_class = build_problem(rng)
+    # The 1M x 10k problem is sized for a TPU; on CPU run a scaled
+    # replica of the same closed-loop shape so the trajectory records a
+    # real number instead of a timeout/null.
+    on_cpu = backend == "cpu"
+    if on_cpu:
+        avail, total, demand, counts, accel_node, accel_class = \
+            build_problem(rng, num_tasks=50_000, C=64, N=512, R=8)
+    else:
+        avail, total, demand, counts, accel_node, accel_class = \
+            build_problem(rng)
 
     from ray_tpu.scheduler.jax_backend import BatchSolver
     solver = BatchSolver(mode="waterfill")
@@ -100,8 +140,9 @@ def main():
     solver.prepare_device(avail, total, demand, accel_node=accel_node,
                           accel_class=accel_class, spread_threshold=0.5)
 
-    ticks = 40
-    stream = arrival_stream(rng, counts, ticks)
+    ticks = 8 if on_cpu else 40
+    stream = arrival_stream(rng, counts, ticks,
+                            per_tick=(8_000 if on_cpu else 130_000))
     # Per-class geometric completion rates (mean service 2-8 ticks) —
     # the closed loop evolves availability: placements occupy capacity
     # until their completions release it.
@@ -123,7 +164,7 @@ def main():
     # needs crosses the boundary inside the timed region: arrivals down,
     # sparse assignment + validation bits back; queue, availability and
     # inflight state stay device-resident between ticks.
-    reps = 3
+    reps = 1 if on_cpu else 3
     t0 = time.perf_counter()
     for _ in range(reps):
         out = solver.solve_stream(stream, rho=rho)
@@ -137,7 +178,10 @@ def main():
         "metric": "scheduler_tick_1M_tasks_x_10k_nodes",
         "value": round(ms_per_tick, 3),
         "unit": "ms",
-        "vs_baseline": round(baseline_ms / ms_per_tick, 2),
+        # The 50 ms target is sized for the full 1M x 10k problem: a
+        # ratio against a CPU-scaled replica would read as beating it.
+        "vs_baseline": (None if on_cpu
+                        else round(baseline_ms / ms_per_tick, 2)),
         "placed_tasks": placed,
         "ticks_per_program": ticks,
         "nnz_max_per_tick": int(out["nnz"].max()),
@@ -145,6 +189,10 @@ def main():
         "nodes": int(avail.shape[0]),
         "backend": jax.default_backend(),
     }
+    if on_cpu:
+        # Not the headline problem: flag it so the trajectory doesn't
+        # compare CPU-scaled numbers against TPU targets.
+        res["scaled_down_for_cpu"] = True
     print(json.dumps(res))
 
 

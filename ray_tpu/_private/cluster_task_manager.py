@@ -21,9 +21,17 @@ import threading
 from collections import defaultdict, deque
 from typing import Callable, Dict, Tuple
 
+import time
+
 from ray_tpu._private.config import get_config
 from ray_tpu._private.task_spec import TaskSpec
 from ray_tpu.scheduler import policy as policy_mod
+
+# Tick-latency histogram bounds (seconds).  The north-star budget is
+# 50 ms/tick at 1M tasks x 10k nodes (BASELINE.md); the sub-ms buckets
+# resolve the common in-process case.
+_TICK_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                 0.1, 0.25, 1.0)
 
 
 class ClusterTaskManager:
@@ -34,6 +42,30 @@ class ClusterTaskManager:
         self._infeasible: Dict[int, deque] = defaultdict(deque)
         self._view_version = -1
         self._jax_solver = None
+        # Tick telemetry: the hot path bumps these plain counters; the
+        # scrape-time collector renders them at /metrics (the repo-wide
+        # stats pattern — no registry lock on the tick path).  Only the
+        # tick-latency histogram observes into the registry directly
+        # (bounded _Hist accumulator, one call per tick).
+        self._node_label = self._raylet.node_id.hex()[:12]
+        self.tick_stats = {"ticks": 0, "busy_ticks": 0,
+                           "spillbacks": 0, "jnp_fallbacks": 0,
+                           "last_batch_classes": 0, "last_batch_tasks": 0}
+        from ray_tpu._private.metrics_agent import (get_metrics_registry,
+                                                    record_internal)
+        label = {"node": self._node_label}
+
+        def _collect(mgr):
+            for k, v in mgr.tick_stats.items():
+                record_internal(f"ray_tpu.scheduler.tick.{k}", v, **label)
+            record_internal("ray_tpu.scheduler.pending_queue_depth",
+                            mgr.num_queued(), **label)
+            # The latency histogram is observed on the tick path, not
+            # here — claim its series so it dies with this manager
+            # instead of leaking per-node cardinality under churn.
+            get_metrics_registry().claim_series(
+                "ray_tpu.scheduler.tick_latency", **label)
+        get_metrics_registry().register_collector(self, _collect)
 
     # ---- entry (HandleRequestWorkerLease -> QueueAndScheduleTask) -------
     def queue_and_schedule(self, spec: TaskSpec, reply: Callable):
@@ -61,17 +93,51 @@ class ClusterTaskManager:
 
     # ---- the tick -------------------------------------------------------
     def schedule_and_dispatch(self):
+        from ray_tpu._private.metrics_agent import observe_internal
+        from ray_tpu.util import tracing
         cfg = get_config()
-        if cfg.scheduler_backend == "jax" and self._total_queued() > 1:
-            if self._schedule_batched():
-                return
-            # Device path unavailable/invalid this tick — the work was
-            # requeued; fall through to the validated native policy.
-        self._schedule_greedy()
+        depth = self._total_queued()
+        t0 = time.perf_counter()
+        # One span per WORKING tick (idle ticks fire every
+        # event_loop_tick_ms — tracing them would bury the timeline).
+        span = tracing.span("scheduler.tick", category="sched",
+                            node=self._node_label, queued=depth) \
+            if depth else None
+        try:
+            if span is not None:
+                span.__enter__()
+            if cfg.scheduler_backend == "jax" and depth > 1:
+                if self._schedule_batched():
+                    return
+                # Device path unavailable/invalid this tick — the work
+                # was requeued; fall through to the validated native
+                # policy.
+                self.tick_stats["jnp_fallbacks"] += 1
+            self._schedule_greedy()
+        finally:
+            if span is not None:
+                span.__exit__(None, None, None)
+            dt = time.perf_counter() - t0
+            self.tick_stats["ticks"] += 1
+            if depth:
+                # Working ticks only (same gate as the span): idle
+                # no-op ticks fire every event_loop_tick_ms and their
+                # microsecond latencies would drown the signal the
+                # 50 ms/tick budget is measured against.
+                self.tick_stats["busy_ticks"] += 1
+                observe_internal("ray_tpu.scheduler.tick_latency", dt,
+                                 buckets=_TICK_BUCKETS,
+                                 node=self._node_label)
 
     def _total_queued(self) -> int:
         with self._lock:
             return sum(len(q) for q in self._queues.values())
+
+    def _emit_scheduled(self, spec: TaskSpec):
+        from ray_tpu.gcs import task_events
+        task_events.emit(self._raylet.cluster, spec.task_id,
+                         task_events.SCHEDULED,
+                         node_id=self._raylet.node_id.hex())
 
     def _schedule_greedy(self):
         """Reference-parity greedy loop: per class, per task, pick the best
@@ -115,6 +181,7 @@ class ClusterTaskManager:
                                 view.add_back(local_id, spec.resources)
                                 continue
                             self._queues[cls].popleft()
+                        self._emit_scheduled(spec)
                         self._raylet.local_task_manager.queue_and_schedule(
                             spec, reply)
                         progress = True
@@ -133,6 +200,7 @@ class ClusterTaskManager:
                         # subtract above stops this tick from spilling
                         # everything to the same node; the broadcast
                         # corrects it.
+                        self.tick_stats["spillbacks"] += 1
                         reply({"retry_at": target})
                         progress = True
             if not progress:
@@ -161,6 +229,9 @@ class ClusterTaskManager:
                 q.clear()
         if not work:
             return True
+        self.tick_stats["last_batch_tasks"] = len(work)
+        self.tick_stats["last_batch_classes"] = len(
+            {spec.scheduling_class for spec, _ in work})
         assignments = self._jax_solver.solve(
             view, [spec for spec, _ in work])
         if assignments is None:
@@ -181,6 +252,7 @@ class ClusterTaskManager:
                         self._queues[spec.scheduling_class].append(
                             (spec, reply))
                     continue
+                self._emit_scheduled(spec)
                 self._raylet.local_task_manager.queue_and_schedule(spec, reply)
             else:
                 # Validate against the exact vectors before committing the
@@ -188,6 +260,7 @@ class ClusterTaskManager:
                 # SURVEY.md §7.4).
                 node = view.node_resources(target)
                 if node is not None and node.is_feasible(spec.resources):
+                    self.tick_stats["spillbacks"] += 1
                     reply({"retry_at": target})
                 else:
                     with self._lock:

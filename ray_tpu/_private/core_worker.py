@@ -412,10 +412,16 @@ class CoreWorker:
         return out, dep_ids, holders, borrowed
 
     def submit_task(self, spec: TaskSpec, holders=()) -> List[ObjectRef]:
+        from ray_tpu.gcs import task_events
         from ray_tpu.util import tracing
         self.task_manager.add_pending_task(spec)
         del holders  # submitted-task refs now pin the promoted args
         self.metrics["tasks_submitted"] += 1
+        task_events.emit(self.cluster, spec.task_id,
+                         task_events.PENDING_ARGS_AVAIL,
+                         name=spec.function_name,
+                         job_id=spec.job_id.hex(),
+                         task_type=spec.task_type)
         with tracing.span(f"submit:{spec.function_name}",
                           category="submit",
                           task_id=spec.task_id.hex()) as sp:
@@ -425,10 +431,16 @@ class CoreWorker:
                 for oid in spec.return_ids]
 
     def submit_actor_task(self, spec: TaskSpec, holders=()) -> List[ObjectRef]:
+        from ray_tpu.gcs import task_events
         from ray_tpu.util import tracing
         self.task_manager.add_pending_task(spec)
         del holders
         self.metrics["actor_tasks_submitted"] += 1
+        task_events.emit(self.cluster, spec.task_id,
+                         task_events.PENDING_ARGS_AVAIL,
+                         name=spec.function_name,
+                         job_id=spec.job_id.hex(),
+                         task_type=spec.task_type)
         with tracing.span(f"submit:{spec.function_name}",
                           category="submit",
                           task_id=spec.task_id.hex()) as sp:

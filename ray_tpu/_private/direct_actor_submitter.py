@@ -100,6 +100,13 @@ class DirectActorTaskSubmitter:
                         spec, error, resubmit=self.submit)
                 self._pump(actor_id)
 
+            from ray_tpu.gcs import task_events
+            nid = getattr(worker, "node_id", None)
+            wid = getattr(worker, "worker_id", None)
+            task_events.emit(self._core.cluster, spec.task_id,
+                             task_events.SUBMITTED_TO_WORKER,
+                             node_id=nid.hex() if nid is not None else "",
+                             worker_id=wid.hex() if wid is not None else "")
             worker.submit_actor_task(spec, on_done)
 
     def on_gcs_restart(self):

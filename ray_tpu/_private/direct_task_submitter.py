@@ -163,6 +163,14 @@ class DirectTaskSubmitter:
 
     # ---- dispatch -------------------------------------------------------
     def _push(self, spec: TaskSpec, worker, raylet, key: int):
+        from ray_tpu.gcs import task_events
+        nid = getattr(worker, "node_id", None)
+        wid = getattr(worker, "worker_id", None)
+        task_events.emit(self._core.cluster, spec.task_id,
+                         task_events.SUBMITTED_TO_WORKER,
+                         node_id=nid.hex() if nid is not None else "",
+                         worker_id=wid.hex() if wid is not None else "")
+
         def on_done(error):
             if error is None:
                 self._core.task_manager.complete_task(spec)

@@ -64,6 +64,7 @@ def execute_task(spec: TaskSpec, node, core_worker, actor_instance=None):
     On success return values are already stored.  On failure the caller
     (TaskManager) decides between retry and storing error objects.
     """
+    from ray_tpu.gcs import task_events
     from ray_tpu.util import tracing
     ctx = worker_context.ExecutionContext(
         task_spec=spec, node=node,
@@ -71,6 +72,10 @@ def execute_task(spec: TaskSpec, node, core_worker, actor_instance=None):
         actor_instance=actor_instance)
     prev = worker_context.get_context()
     worker_context.set_context(ctx)
+    wid = getattr(ctx.worker, "worker_id", None)
+    task_events.emit(node.cluster, spec.task_id, task_events.RUNNING,
+                     node_id=node.node_id.hex(),
+                     worker_id=wid.hex() if wid is not None else "")
     t0 = time.monotonic()
     trace_ctx = getattr(spec, "trace_ctx", None)
     try:

@@ -94,8 +94,30 @@ def register_operator_handlers(cluster, job_manager):
         from ray_tpu.util import tracing
         return tracing.chrome_tracing_dump()
 
+    def handle_state_list(payload):
+        """State API over the wire (`ray-tpu list <resource>`)."""
+        from ray_tpu.experimental.state import api as state_api
+        resource = payload.get("resource")
+        fns = {"tasks": state_api.tasks_from_cluster,
+               "actors": state_api.actors_from_cluster,
+               "objects": state_api.objects_from_cluster,
+               "nodes": state_api.nodes_from_cluster}
+        fn = fns.get(resource)
+        if fn is None:
+            raise ValueError(f"unknown state resource {resource!r}; "
+                             f"expected one of {sorted(fns)}")
+        filters = [tuple(f) for f in payload.get("filters") or []]
+        return fn(cluster, filters or None,
+                  payload.get("limit"), payload.get("offset", 0))
+
+    def handle_state_summary(_payload):
+        from ray_tpu.experimental.state import api as state_api
+        return state_api.summarize_tasks_from_cluster(cluster)
+
     server.register("memory_summary", handle_memory_summary)
     server.register("timeline_dump", handle_timeline)
+    server.register("state_list", handle_state_list)
+    server.register("state_summary", handle_state_summary)
 
 
 def main(argv=None) -> int:

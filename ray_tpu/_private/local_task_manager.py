@@ -152,6 +152,11 @@ class LocalTaskManager:
                 self._allocated[worker.worker_id] = held
             for oid in spec.arg_object_ids():
                 self._raylet.object_store.pin(oid)
+            # NOTE no SUBMITTED_TO_WORKER event here: the lease reply's
+            # worker may end up running a DIFFERENT task than this
+            # representative spec (transport-side queue rotation, and
+            # lease reuse never comes back through here at all) — the
+            # transport emits it at the actual spec->worker push.
             reply({"worker": worker, "raylet": self._raylet,
                    "resources": spec.resources})
 

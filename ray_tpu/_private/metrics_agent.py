@@ -18,6 +18,27 @@ from typing import Dict, List, Tuple
 _LabelKey = Tuple[Tuple[str, str], ...]
 
 
+class _Hist:
+    """Fixed-bucket histogram accumulator: O(buckets) memory however
+    many observations land (a per-tick observe must not grow a raw
+    observation list forever)."""
+
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self, num_buckets: int):
+        self.counts = [0] * num_buckets     # per-bucket (non-cumulative)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float, buckets) -> None:
+        for i, b in enumerate(buckets):
+            if value <= b:
+                self.counts[i] += 1
+                break
+        self.sum += value
+        self.count += 1
+
+
 class MetricRecord:
     __slots__ = ("type", "description", "series", "buckets")
 
@@ -88,6 +109,13 @@ class MetricsRegistry:
         if sink is not None:
             sink.add((name, labels))
 
+    def claim_series(self, name: str, **labels) -> None:
+        """Tie an externally-written series (e.g. a histogram observed
+        on a hot path) to the collector currently running, so it is
+        pruned with the collector's owner — otherwise per-node series
+        written outside collector runs would outlive their node."""
+        self._note_write(name, tuple(sorted(labels.items())))
+
     def register(self, name: str, mtype: str, description: str = "",
                  buckets=None) -> None:
         with self._lock:
@@ -109,7 +137,13 @@ class MetricsRegistry:
         self._note_write(name, labels)
         with self._lock:
             rec = self._metrics[name]
-            rec.series.setdefault(labels, []).append(value)
+            if rec.buckets:
+                h = rec.series.get(labels)
+                if h is None:
+                    h = rec.series[labels] = _Hist(len(rec.buckets))
+                h.observe(value, rec.buckets)
+            else:
+                rec.series.setdefault(labels, []).append(value)
 
     def get_value(self, name: str, labels: _LabelKey = ()):
         with self._lock:
@@ -137,6 +171,18 @@ class MetricsRegistry:
                 lstr = ",".join(f'{k}="{v}"' for k, v in labels)
                 lsuf = "{" + lstr + "}" if lstr else ""
                 if rec.type == "histogram":
+                    if isinstance(val, _Hist):
+                        acc = 0
+                        for i, b in enumerate(rec.buckets):
+                            acc += val.counts[i]
+                            blab = (lstr + "," if lstr else "") \
+                                + f'le="{b}"'
+                            out.append(f"{pname}_bucket{{{blab}}} {acc}")
+                        blab = (lstr + "," if lstr else "") + 'le="+Inf"'
+                        out.append(f"{pname}_bucket{{{blab}}} {val.count}")
+                        out.append(f"{pname}_sum{lsuf} {val.sum}")
+                        out.append(f"{pname}_count{lsuf} {val.count}")
+                        continue
                     obs = list(val)
                     acc = 0
                     for b in rec.buckets:
@@ -168,3 +214,18 @@ def record_internal(name: str, value: float, mtype: str = "gauge",
         _registry.inc(name, value, key)
     else:
         _registry.set(name, value, key)
+
+
+# Generic latency-shaped default (seconds): a bucketless histogram
+# would fall back to an unbounded raw-observation list.
+_DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0)
+
+
+def observe_internal(name: str, value: float, buckets=None,
+                     **labels) -> None:
+    """Fire-and-forget internal histogram observation.  ``buckets`` is
+    only honored at first registration (Prometheus semantics: a series'
+    buckets never change)."""
+    _registry.register(name, "histogram",
+                       buckets=buckets or _DEFAULT_BUCKETS)
+    _registry.observe(name, value, tuple(sorted(labels.items())))

@@ -40,6 +40,14 @@ class _RemoteHeartbeats:
     def heartbeat(self, node_id: NodeID):
         self._host.client.call_async(
             "heartbeat", {"node_id": node_id.binary()}, lambda _r, _e: None)
+        # The emitter buffer only flushes from emit(): piggyback on the
+        # raylet's heartbeat loop so the tail of events after the LAST
+        # emit on this node (e.g. the final task's RUNNING) still
+        # reaches the head once the node quiesces — the query layer's
+        # read-your-writes flush can only reach the head's own buffer.
+        buf = getattr(self._host.adapter.gcs, "task_events", None)
+        if buf is not None and buf.num_buffered():
+            buf.flush()
 
 
 class _RemoteActorManager:
@@ -71,11 +79,23 @@ class _RemoteGcs:
     """The slice of the GCS surface a raylet touches, over the wire."""
 
     def __init__(self, host: "NodeHost"):
+        import uuid
+
+        from ray_tpu.gcs.task_events import TaskEventBuffer
         self._host = host
         self.heartbeat_manager = _RemoteHeartbeats(host)
         self.actor_manager = _RemoteActorManager(host)
         self.kv = _RemoteKV(host)
         self.publisher = _RemotePublisher(host)
+        # Task-event emissions from this node (raylet SCHEDULED, worker
+        # RUNNING, ...) batch over the wire publisher; the head's
+        # WirePubsubService re-publishes into the GCS plane where the
+        # TaskEventManager subscribes — remote nodes report the same
+        # lifecycle detail as the head's own raylet.  buffer_id must be
+        # unique per incarnation (pids collide across machines and
+        # restarts): the manager keys per-source drop counters on it.
+        self.task_events = TaskEventBuffer(
+            self.publisher, buffer_id=f"node-{uuid.uuid4().hex[:12]}")
 
     def raylet(self, node_id: NodeID):
         """Peer lookup for object pulls: every peer is reachable through

@@ -145,7 +145,13 @@ def _device_to_host(value):
     """
     import sys
     jax = sys.modules.get("jax")
-    if jax is not None and isinstance(value, jax.Array):
+    # getattr, not attribute access: another thread may be mid-way
+    # through the first `import jax` (e.g. the scheduler's jax backend
+    # loading on its own thread), leaving a partially-initialized
+    # module in sys.modules without `Array` yet.  A value can only BE a
+    # jax array if jax finished importing wherever it was created.
+    jax_array = getattr(jax, "Array", None)
+    if jax_array is not None and isinstance(value, jax_array):
         import numpy as np
         return np.asarray(value)
     return value

@@ -68,9 +68,12 @@ class TaskManager:
 
     # ---- completion/failure (called from transport) ---------------------
     def complete_task(self, spec: TaskSpec):
+        from ray_tpu.gcs import task_events
         with self._lock:
             self._pending.pop(spec.task_id, None)
             self._completion_cv.notify_all()
+        task_events.emit(self._core.cluster, spec.task_id,
+                         task_events.FINISHED)
         self._core.reference_counter.remove_submitted_task_refs(
             spec.arg_object_ids() + list(spec.borrowed_ids))
 
@@ -94,7 +97,15 @@ class TaskManager:
                 do_retry = True
             else:
                 do_retry = False
+            attempt = spec.max_retries - t.retries_left
         if do_retry:
+            from ray_tpu.gcs import task_events
+            # Retry re-enters the lifecycle at PENDING_ARGS_AVAIL with a
+            # bumped attempt counter (reference: attempt_number on
+            # TaskEvents; retries are new attempts of the same task id).
+            task_events.emit(self._core.cluster, spec.task_id,
+                             task_events.PENDING_ARGS_AVAIL,
+                             name=spec.function_name, attempt=attempt)
             resubmit(spec)
             return True
         self.fail_task(spec, error)
@@ -102,9 +113,12 @@ class TaskManager:
 
     def fail_task(self, spec: TaskSpec, error: BaseException):
         """Store the error into all return objects so gets raise."""
+        from ray_tpu.gcs import task_events
         with self._lock:
             self._pending.pop(spec.task_id, None)
             self._completion_cv.notify_all()
+        task_events.emit(self._core.cluster, spec.task_id,
+                         task_events.FAILED, error=repr(error))
         for oid in spec.return_ids:
             self._core.memory_store.put_error(oid, _user_error(error))
         self._core.reference_counter.remove_submitted_task_refs(

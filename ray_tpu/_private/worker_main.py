@@ -167,8 +167,14 @@ class _WorkerRuntime:
                     f"execute:{payload.get('function_name', '?')}",
                     category="execute", parent=trace_ctx,
                     force=bool(trace_ctx)):
-                args, kwargs = self._resolve_args(payload["args"], pinned)
                 kind = payload["kind"]
+                # Actor calls (and creation) copy shm args out of the
+                # mapping so their pins can be released at frame end —
+                # an arg kept as actor state must not reference a
+                # region the host could evict once unpinned.  Normal
+                # tasks stay zero-copy (args die with the frame).
+                args, kwargs = self._resolve_args(
+                    payload["args"], pinned, copy_shm=(kind != "task"))
                 if kind == "create_actor":
                     cls = self._load_function(payload["function_key"])
                     self.actor_instance = cls(*args, **kwargs)
@@ -206,10 +212,13 @@ class _WorkerRuntime:
             out = {"error": blob, "returns": []}
         finally:
             worker_context.set_context(prev_ctx)
-            # Normal tasks: args died with the frame; drop their pins.
-            # Actor creation/tasks keep theirs — args may live on as
-            # actor state referencing the mapping.
-            if pinned and payload["kind"] == "task":
+            # Every kind releases its pins at frame end: normal-task
+            # args died with the frame (zero-copy views included), and
+            # actor creation/call args were copied out of the mapping
+            # above.  Holding pins for an actor's lifetime permanently
+            # pinned every large shm arg a long-lived actor ever took
+            # (ADVICE.md).
+            if pinned:
                 self._release_pins(pinned)
         if trace_ctx:
             # Ship locally-recorded spans back on the reply (ProfileEvent
@@ -217,14 +226,14 @@ class _WorkerRuntime:
             out["trace"] = tracing.drain()
         return out
 
-    def _resolve_args(self, packed, pinned):
+    def _resolve_args(self, packed, pinned, copy_shm: bool = False):
         from ray_tpu._private.executor import _split_args
         flat = []
         for kind, data in packed:
             if kind == "inline":
                 flat.append(deserialize(SerializedObject.from_bytes(data)))
                 continue
-            value = self._shm_get(data, pinned)
+            value = self._shm_get(data, pinned, copy=copy_shm)
             if value is not _SHM_MISS:
                 flat.append(value)
                 continue
@@ -235,13 +244,14 @@ class _WorkerRuntime:
             flat.append(deserialize(SerializedObject.from_bytes(blob)))
         return _split_args(flat)
 
-    def _shm_get(self, oid_bin: bytes, pinned: list):
-        """Zero-copy arg read (plasma client Get): locate pins the
-        object host-side, bytes come straight from the read-only
-        mapping and the deserialized arrays reference it.  The pin key
-        is recorded in ``pinned``; normal tasks release at task end,
-        actor tasks hold for the worker's lifetime (their args become
-        actor state)."""
+    def _shm_get(self, oid_bin: bytes, pinned: list, copy: bool = False):
+        """Arg read through the segment (plasma client Get): locate
+        pins the object host-side, bytes come from the read-only
+        mapping.  ``copy=False`` (normal tasks) keeps zero-copy — the
+        deserialized arrays reference the mapping and the pin holds
+        until task end.  ``copy=True`` (actor creation/calls) snapshots
+        the bytes first so the value survives the pin release at frame
+        end.  Every pin key lands in ``pinned``."""
         if self._shm is None:
             return _SHM_MISS
         try:
@@ -255,6 +265,8 @@ class _WorkerRuntime:
             return _SHM_MISS
         pinned.append(oid_bin)
         view = self._shm.read(int(loc[0]), int(loc[1]))
+        if copy:
+            view = bytes(view)
         return deserialize(SerializedObject.from_bytes(view))
 
     def _release_pins(self, pinned: list):

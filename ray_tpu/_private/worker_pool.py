@@ -208,6 +208,11 @@ class WorkerHostService:
         self._events: Dict[str, threading.Event] = {}
         self._worker_pins: Dict[str, list] = {}
         self._shm_pins: Dict[str, list] = {}
+        # Orders seal against abort: each RPC runs on its own dispatch
+        # thread, and abort's locate-then-delete must not interleave
+        # with a concurrent seal of the same key (the sealed-object
+        # guard would read stale state and delete a live object).
+        self._shm_seal_lock = threading.Lock()
         self.shm_locate_count = 0    # observability/tests
         self.server = RpcServer(
             name=f"workerhost-{node.node_id.hex()[:6]}")
@@ -305,8 +310,9 @@ class WorkerHostService:
         its mapping.  Pin BEFORE reading the offset: native.pin fails
         if the object was just freed, and once it succeeds the block
         cannot move — so the returned (offset, size) can never be
-        stale.  Pins are released per-task (normal tasks) or on worker
-        death (actors / crashed workers)."""
+        stale.  The worker releases its pins at the end of every task
+        frame (actor calls copy the bytes out first); worker death
+        releases whatever a crashed worker still held."""
         store, native = self._native_store()
         if native is None:
             return None
@@ -344,10 +350,21 @@ class WorkerHostService:
 
     def _shm_abort(self, payload):
         """Drop a create-reservation whose write/seal failed — unsealed
-        entries are invisible to eviction and would leak forever."""
+        entries are invisible to eviction and would leak forever.
+
+        Reclaims ONLY unsealed reservations: the worker fires abort on
+        any mid-write exception, including a timeout on a seal reply
+        that actually LANDED host-side — by then the object is sealed,
+        registered in the node store and locatable by other readers, so
+        deleting it here would corrupt a live object (ADVICE.md)."""
         _store, native = self._native_store()
-        if native is not None:
-            native.delete(payload["object_id"])
+        if native is None:
+            return False
+        key = payload["object_id"]
+        with self._shm_seal_lock:
+            if native.locate(key) is not None:
+                return False  # sealed: the seal won the race, keep it
+            native.delete(key)
         return True
 
     def _shm_create(self, payload):
@@ -368,8 +385,9 @@ class WorkerHostService:
         if native is None:
             return False
         key = payload["object_id"]
-        if not native.seal(key):
-            return False
+        with self._shm_seal_lock:
+            if not native.seal(key):
+                return False
         oid = ObjectID(key)
         size = int(payload["size"])
         store.register_native_entry(oid, size)
@@ -539,6 +557,7 @@ class ProcessWorker:
                 # Out-of-order queue parity: up to max_concurrency calls
                 # in flight (group-tagged calls bound by their group's
                 # semaphore in the child); replies on the client reader.
+                self._emit_running(spec)
                 fut = self._client.call_future(
                     "push", self._build_payload(kind, spec))
                 fut.add_done_callback(
@@ -548,7 +567,18 @@ class ProcessWorker:
             self._roundtrip(kind, spec, on_done)
         self._on_exit()
 
+    def _emit_running(self, spec):
+        """Host-side RUNNING transition: the push to the child's RPC
+        server is the moment the task starts executing in the worker OS
+        process (the child has no path to the GCS event buffer)."""
+        from ray_tpu.gcs import task_events
+        task_events.emit(self.node.cluster, spec.task_id,
+                         task_events.RUNNING,
+                         node_id=self.node_id.hex(),
+                         worker_id=self.worker_id.hex())
+
     def _roundtrip(self, kind, spec, on_done):
+        self._emit_running(spec)
         try:
             reply = self._client.call("push",
                                       self._build_payload(kind, spec),

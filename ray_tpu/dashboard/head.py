@@ -8,6 +8,9 @@ scope; this serves the same data as JSON for tools and humans:
     GET /api/cluster            totals, availability, node count, jobs
     GET /api/nodes              node table (state, resources)
     GET /api/actors             actor table (state, restarts, class)
+    GET /api/tasks              task lifecycle records (task-event
+                                pipeline; ?state= ?name= ?limit= filters)
+    GET /api/tasks/summary      per-function rollup + loss accounting
     GET /api/placement_groups   PG table (state, bundles)
     GET /api/jobs               job submissions (when a JobManager runs)
     GET /metrics                Prometheus text exposition
@@ -57,7 +60,10 @@ class Dashboard:
 
     # ---- routing --------------------------------------------------------
     def _route(self, req: BaseHTTPRequestHandler):
-        path = req.path.split("?", 1)[0].rstrip("/") or "/"
+        from urllib.parse import parse_qsl
+        path, _, query = req.path.partition("?")
+        path = path.rstrip("/") or "/"
+        params = dict(parse_qsl(query))
         if path == "/metrics":
             self._send(req, get_metrics_registry().render_prometheus(),
                        content_type="text/plain; version=0.0.4")
@@ -69,6 +75,13 @@ class Dashboard:
             self._send_json(req, self._node_stats())
         elif path == "/api/actors":
             self._send_json(req, self._actors())
+        elif path == "/api/tasks":
+            self._send_json(req, self._tasks(params))
+        elif path == "/api/tasks/summary":
+            from ray_tpu.experimental.state.api import \
+                summarize_tasks_from_cluster
+            self._send_json(req,
+                            summarize_tasks_from_cluster(self._cluster))
         elif path == "/api/placement_groups":
             self._send_json(req, self._cluster.gcs
                             .placement_group_manager.table())
@@ -126,6 +139,20 @@ class Dashboard:
         return [info for _aid, info in
                 self._cluster.gcs.actor_manager.all_actor_info().items()]
 
+    def _tasks(self, params: dict) -> list:
+        from ray_tpu.experimental.state.api import tasks_from_cluster
+        filters = [(key, "=", params[key])
+                   for key in ("state", "name", "job_id", "node_id")
+                   if key in params]
+        try:
+            limit = int(params.get("limit", 100))
+            offset = int(params.get("offset", 0))
+        except ValueError:
+            # Client typo (?limit=abc) is a client error, not a 500.
+            limit, offset = 100, 0
+        return tasks_from_cluster(self._cluster, filters or None,
+                                  limit, offset)
+
     def _jobs(self) -> list:
         if self._job_manager is None:
             return []
@@ -149,6 +176,7 @@ class Dashboard:
             "<table border=1><tr><th>node</th><th>state</th>"
             "<th>resources</th></tr>" + rows + "</table>"
             "<p>endpoints: /api/cluster /api/nodes /api/actors "
+            "/api/tasks /api/tasks/summary "
             "/api/placement_groups /api/jobs /metrics</p>"
             "</body></html>")
 

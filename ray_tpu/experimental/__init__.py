@@ -1,0 +1,1 @@
+"""Experimental public surfaces (reference ``ray.experimental``)."""

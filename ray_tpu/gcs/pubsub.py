@@ -20,6 +20,7 @@ OBJECT_LOCATION_CHANNEL = "OBJECT_LOCATION"
 JOB_CHANNEL = "JOB"
 ERROR_INFO_CHANNEL = "ERROR_INFO"
 RESOURCE_USAGE_CHANNEL = "RESOURCE_USAGE"
+TASK_EVENT_CHANNEL = "TASK_EVENT"
 
 
 class Publisher:

@@ -333,6 +333,13 @@ class GcsServer:
         self.resource_manager = GcsResourceManager(self.loop, self.publisher)
         self.job_manager = GcsJobManager(self.storage, self.publisher)
         self.worker_manager = GcsWorkerManager(self.publisher)
+        # Task-event pipeline: emitters (core worker, raylet queues,
+        # worker pool, executor) drop lifecycle transitions into the
+        # bounded buffer; batches ride the pubsub plane into the
+        # manager, which the State API / dashboard / CLI query.
+        from ray_tpu.gcs.task_events import TaskEventBuffer, TaskEventManager
+        self.task_event_manager = TaskEventManager(self.publisher)
+        self.task_events = TaskEventBuffer(self.publisher)
         from ray_tpu.gcs.actor_manager import GcsActorManager
         self.actor_manager = GcsActorManager(self)
         from ray_tpu.gcs.placement_group_manager import GcsPlacementGroupManager

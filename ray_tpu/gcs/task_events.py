@@ -1,0 +1,331 @@
+"""Task-event pipeline: per-task lifecycle state transitions.
+
+Parity: the reference's task-events backend shipped after 2.0.0.dev0
+(``src/ray/gcs/gcs_server/gcs_task_manager.h`` + the worker-side
+``TaskEventBuffer``, ``core_worker/task_event_buffer.h``): every layer
+that moves a task (core worker submit, raylet scheduling, worker
+dispatch, executor, owner-side completion) drops a tiny state-transition
+record into a bounded buffer; the buffer batches over the pubsub plane
+to a GCS-side aggregator which the State API (``ray list tasks``,
+``ray summary tasks``) queries.
+
+Lifecycle (task_events.proto ``TaskStatus`` subset)::
+
+    PENDING_ARGS_AVAIL -> SCHEDULED -> SUBMITTED_TO_WORKER -> RUNNING
+                                   -> FINISHED | FAILED
+
+Loss semantics are explicit, never silent: the emitter-side buffer is
+bounded (events past ``max_buffer`` are dropped and counted), each
+flushed batch carries the cumulative drop counter, and the GCS-side
+manager bounds tracked tasks (oldest finished evicted first) with its
+own eviction counter.  Observability must never become the memory leak
+it is meant to find.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu.gcs.pubsub import TASK_EVENT_CHANNEL
+
+# Task lifecycle states (reference TaskStatus enum subset).
+PENDING_ARGS_AVAIL = "PENDING_ARGS_AVAIL"
+SCHEDULED = "SCHEDULED"
+SUBMITTED_TO_WORKER = "SUBMITTED_TO_WORKER"
+RUNNING = "RUNNING"
+FINISHED = "FINISHED"
+FAILED = "FAILED"
+
+# Canonical ordering, used by consumers to sanity-check transitions.
+STATE_ORDER = (PENDING_ARGS_AVAIL, SCHEDULED, SUBMITTED_TO_WORKER,
+               RUNNING, FINISHED, FAILED)
+TERMINAL_STATES = (FINISHED, FAILED)
+
+# Per-task history cap: a lifecycle is ~6 transitions; retries add a
+# handful more.  Bounded so one infinitely-retried task can't grow a
+# record without limit.
+_MAX_HISTORY = 32
+
+
+class TaskEventBuffer:
+    """Emitter-side bounded buffer (core_worker/task_event_buffer.h
+    parity): ``emit`` is the hot-path call — append under a lock, no
+    I/O; batches go out over the pubsub channel when the buffer reaches
+    ``batch_size`` or ``flush_interval`` has elapsed since the last
+    flush (checked on emit — no dedicated thread), or on an explicit
+    ``flush()`` from the query layer (read-your-writes)."""
+
+    def __init__(self, publisher, buffer_id: str = "head",
+                 max_buffer: int = 8192, batch_size: int = 256,
+                 flush_interval: float = 0.2):
+        self._publisher = publisher
+        self._buffer_id = buffer_id
+        self._max_buffer = max_buffer
+        self._batch_size = batch_size
+        self._flush_interval = flush_interval
+        self._lock = threading.Lock()
+        # Serializes pop+publish so concurrent flushes from different
+        # emitting threads cannot deliver batches out of emission order
+        # (a FINISHED overtaking its own PENDING would seed the
+        # manager's record with the wrong start_time).
+        self._flush_lock = threading.Lock()
+        self._events: List[dict] = []
+        self._last_flush = time.monotonic()
+        self.dropped = 0          # cumulative, rides every batch
+
+    def emit(self, task_id, state: str, *, name: str = "",
+             job_id: str = "", task_type: str = "NORMAL_TASK",
+             node_id: str = "", worker_id: str = "", attempt: int = 0,
+             error: Optional[str] = None) -> None:
+        tid = task_id.hex() if hasattr(task_id, "hex") else str(task_id)
+        ev = {"task_id": tid, "state": state, "ts": time.time()}
+        if name:
+            ev["name"] = name
+        if job_id:
+            ev["job_id"] = job_id
+        if task_type != "NORMAL_TASK":
+            ev["type"] = task_type
+        if node_id:
+            ev["node_id"] = node_id
+        if worker_id:
+            ev["worker_id"] = worker_id
+        if attempt:
+            ev["attempt"] = attempt
+        if error is not None:
+            ev["error"] = str(error)[:500]
+        flush_now = False
+        with self._lock:
+            if len(self._events) >= self._max_buffer:
+                self.dropped += 1
+                return
+            self._events.append(ev)
+            if len(self._events) >= self._batch_size or \
+                    time.monotonic() - self._last_flush \
+                    >= self._flush_interval:
+                flush_now = True
+        if flush_now:
+            self.flush()
+
+    def flush(self) -> None:
+        with self._flush_lock:
+            with self._lock:
+                if not self._events:
+                    self._last_flush = time.monotonic()
+                    return
+                batch, self._events = self._events, []
+                dropped = self.dropped
+                self._last_flush = time.monotonic()
+            try:
+                self._publisher.publish(
+                    TASK_EVENT_CHANNEL, b"",
+                    {"buffer_id": self._buffer_id, "events": batch,
+                     "dropped": dropped})
+            except Exception:
+                # The popped batch is gone: count it, keep loss
+                # explicit.
+                with self._lock:
+                    self.dropped += len(batch)
+
+    def num_buffered(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+
+class TaskEventManager:
+    """GCS-side aggregator (gcs_task_manager.h parity): subscribes to
+    the task-event channel, folds batches into one bounded record per
+    task (latest state, per-state wall-clock, attempt counter, node /
+    worker placement, ordered transition history)."""
+
+    def __init__(self, publisher, max_tasks: int = 10_000):
+        self._lock = threading.Lock()
+        self._max_tasks = max_tasks
+        self._records: "OrderedDict[str, dict]" = OrderedDict()
+        # Terminal-record index (insertion order): O(1) eviction even
+        # when ingest runs synchronously on the emitter's flush path.
+        self._terminal: "OrderedDict[str, None]" = OrderedDict()
+        # Per-source cumulative drop counters (reported by buffers).
+        self._source_dropped: Dict[str, int] = {}
+        self.evicted = 0
+        publisher.subscribe(TASK_EVENT_CHANNEL, None, self._on_batch)
+
+    # ---- ingest ---------------------------------------------------------
+    def _on_batch(self, _key, batch) -> None:
+        try:
+            events = batch["events"]
+            buffer_id = batch.get("buffer_id", "")
+            dropped = int(batch.get("dropped", 0))
+        except Exception:
+            return
+        with self._lock:
+            self._source_dropped[buffer_id] = max(
+                self._source_dropped.get(buffer_id, 0), dropped)
+            for ev in events:
+                self._ingest_one(ev)
+            while len(self._records) > self._max_tasks:
+                self._evict_one()
+
+    def _ingest_one(self, ev: dict) -> None:
+        tid = ev["task_id"]
+        rec = self._records.get(tid)
+        if rec is None:
+            rec = {"task_id": tid, "name": "", "job_id": "",
+                   "type": "NORMAL_TASK", "state": None, "node_id": "",
+                   "worker_id": "", "attempt": 0, "state_ts": {},
+                   "events": [], "error": None,
+                   "start_time": ev["ts"], "end_time": None}
+            self._records[tid] = rec
+        state, ts = ev["state"], ev["ts"]
+        # Batches from different buffers (owner-side vs node-side)
+        # interleave in arrival order, not wall-clock order: an early
+        # PENDING arriving after the node's SCHEDULED batch must still
+        # anchor the duration at submit time.
+        if ts < rec["start_time"]:
+            rec["start_time"] = ts
+        rec["state_ts"][state] = ts
+        if len(rec["events"]) < _MAX_HISTORY:
+            rec["events"].append((state, ts))
+        for key in ("name", "job_id", "node_id", "worker_id"):
+            if ev.get(key):
+                rec[key] = ev[key]
+        if ev.get("type"):
+            rec["type"] = ev["type"]
+        is_retry = ev.get("attempt", 0) > rec["attempt"]
+        if is_retry:
+            rec["attempt"] = ev["attempt"]
+        if ev.get("error"):
+            rec["error"] = ev["error"]
+        # Emitters race across threads AND buffers (owner-side events
+        # flush from the head buffer, node-side SCHEDULED/RUNNING ride
+        # the wire from remote raylets): a straggling earlier state
+        # must never regress the record — not past a terminal state,
+        # and not past a later non-terminal state either (RUNNING must
+        # not flip back to SUBMITTED_TO_WORKER because the owner's
+        # batch arrived late).  Only a genuine retry (higher attempt)
+        # rewinds the lifecycle.
+        if state in TERMINAL_STATES:
+            rec["state"] = state
+            rec["end_time"] = ts
+            self._terminal[tid] = None
+        elif is_retry:
+            rec["state"] = state
+            rec["end_time"] = None
+            self._terminal.pop(tid, None)
+        elif rec["state"] not in TERMINAL_STATES and (
+                rec["state"] is None or
+                STATE_ORDER.index(state) >= STATE_ORDER.index(rec["state"])):
+            rec["state"] = state
+
+    def _evict_one(self) -> None:
+        # Oldest finished task first; if everything is still live, the
+        # oldest record goes regardless (bounded memory beats history).
+        if self._terminal:
+            victim, _ = self._terminal.popitem(last=False)
+        else:
+            victim = next(iter(self._records))
+        del self._records[victim]
+        self.evicted += 1
+
+    # ---- query ----------------------------------------------------------
+    @staticmethod
+    def _snapshot(rec: dict) -> dict:
+        """Deep-enough copy: callers may iterate state_ts/events while
+        the ingest thread keeps folding into the live record.  History
+        is presented in wall-clock order — ingest appends in arrival
+        order, and batches from different buffers interleave."""
+        row = dict(rec)
+        row["state_ts"] = dict(rec["state_ts"])
+        row["events"] = sorted(rec["events"], key=lambda e: e[1])
+        start, end = row["start_time"], row["end_time"]
+        row["duration_s"] = (end - start) if end is not None else None
+        return row
+
+    def tasks(self, limit: Optional[int] = None, offset: int = 0,
+              pred=None) -> List[dict]:
+        """Snapshot of tracked task records (insertion order).
+        Filtering (``pred`` runs against the live record — cheap field
+        reads only) and slicing happen BEFORE the per-record copies, so
+        a paginated query of a full manager only pays for the page it
+        asked for — the copies must stay under the lock (the ingest
+        thread keeps folding into the live dicts), so the page size
+        bounds the expensive part of the hold."""
+        with self._lock:
+            recs = self._records.values()
+            if pred is not None:
+                recs = [rec for rec in recs if pred(rec)]
+            else:
+                recs = list(recs)
+            if offset:
+                recs = recs[offset:]
+            if limit is not None:
+                recs = recs[:limit]
+            return [self._snapshot(rec) for rec in recs]
+
+    def get(self, task_id: str) -> Optional[dict]:
+        with self._lock:
+            rec = self._records.get(task_id)
+            return self._snapshot(rec) if rec is not None else None
+
+    def num_tracked(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+    def num_dropped_at_source(self) -> int:
+        """Events dropped before ingest (emitter buffers overflowed)."""
+        with self._lock:
+            return sum(self._source_dropped.values())
+
+    def summarize(self) -> Dict[str, dict]:
+        """Per-function-name rollup (``ray summary tasks`` parity)."""
+        out: Dict[str, dict] = {}
+        for rec in self.tasks():
+            name = rec["name"] or "<unknown>"
+            row = out.setdefault(name, {"count": 0, "by_state": {},
+                                        "total_duration_s": 0.0,
+                                        "finished": 0})
+            row["count"] += 1
+            st = rec["state"] or "UNKNOWN"
+            row["by_state"][st] = row["by_state"].get(st, 0) + 1
+            if rec["duration_s"] is not None:
+                row["total_duration_s"] += rec["duration_s"]
+                row["finished"] += 1
+        for row in out.values():
+            row["mean_duration_s"] = (
+                row["total_duration_s"] / row["finished"]
+                if row["finished"] else None)
+        return out
+
+
+def flushed_manager(gcs) -> Optional[TaskEventManager]:
+    """Read-your-writes entry for the query layer: flush the local
+    buffer (events emitted in this process become visible) and hand
+    back the manager, or None where the pipeline isn't wired (remote
+    gcs proxies)."""
+    buf = getattr(gcs, "task_events", None)
+    if buf is not None:
+        buf.flush()
+    return getattr(gcs, "task_event_manager", None)
+
+
+# ---------------------------------------------------------------------------
+# Emission helper — safe from every layer.
+# ---------------------------------------------------------------------------
+
+def emit(cluster, task_id, state: str, **kw) -> None:
+    """Record one lifecycle transition if this process can reach a task
+    event buffer.  No-ops (never raises) on remote node-hosts whose gcs
+    handle is a wire proxy without the buffer — their scheduling-side
+    events are a known gap, owner-side events still cover the task."""
+    try:
+        buf = cluster.gcs.task_events
+    except Exception:
+        return
+    if buf is None:
+        return
+    try:
+        buf.emit(task_id, state, **kw)
+    except Exception:
+        pass

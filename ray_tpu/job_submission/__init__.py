@@ -271,5 +271,16 @@ class JobSubmissionClient:
     def timeline(self) -> list:
         return self._client.call("timeline_dump", None, timeout=30.0)
 
+    def list_state(self, resource: str, filters: Optional[list] = None,
+                   limit: Optional[int] = 100, offset: int = 0) -> list:
+        """State API rows (`ray-tpu list tasks/actors/objects/nodes`)."""
+        return self._client.call(
+            "state_list", {"resource": resource, "filters": filters,
+                           "limit": limit, "offset": offset},
+            timeout=30.0)
+
+    def summarize_tasks(self) -> dict:
+        return self._client.call("state_summary", None, timeout=30.0)
+
     def close(self):
         self._client.close()

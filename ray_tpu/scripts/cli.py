@@ -7,6 +7,8 @@ start/stop/status/submit/...``) + ``dashboard/modules/job/cli.py``
     python -m ray_tpu start --head [--port 7788] [--num-cpus 8]
     python -m ray_tpu start --address 127.0.0.1:7788 --num-cpus 4
     python -m ray_tpu status
+    python -m ray_tpu list tasks --filter state=RUNNING
+    python -m ray_tpu summary tasks
     python -m ray_tpu submit --working-dir . -- python script.py
     python -m ray_tpu jobs
     python -m ray_tpu logs <job-id>
@@ -215,6 +217,74 @@ def cmd_memory(args) -> int:
     return 0
 
 
+def cmd_list(args) -> int:
+    """State API listing (reference `ray list tasks/actors/objects`)."""
+    filters = []
+    for f in args.filter or []:
+        if "!=" in f:
+            key, _, value = f.partition("!=")
+            filters.append((key, "!=", value))
+        elif "=" in f:
+            key, _, value = f.partition("=")
+            filters.append((key, "=", value))
+        else:
+            raise SystemExit(f"bad --filter {f!r}: expected key=value or "
+                             "key!=value")
+    client = _client(args)
+    try:
+        rows = client.list_state(args.resource, filters or None,
+                                 limit=args.limit, offset=args.offset)
+    finally:
+        client.close()
+    if args.output == "json":
+        print(json.dumps(rows, default=str, indent=2))
+        return 0
+    columns = {
+        "tasks": ("task_id", "name", "state", "attempt", "node_id",
+                  "duration_s"),
+        "actors": ("actor_id", "state", "name"),
+        "objects": ("object_id", "node_id", "size_bytes", "sealed",
+                    "pin_count"),
+        "nodes": ("node_id", "node_name", "state"),
+    }[args.resource]
+    print(" ".join(f"{c.upper():20}" for c in columns))
+    for row in rows:
+        cells = []
+        for c in columns:
+            v = row.get(c, "")
+            if c.endswith("_id") and isinstance(v, str):
+                v = v[:16]
+            elif isinstance(v, float):
+                v = f"{v:.4f}"
+            cells.append(f"{str(v):20}")
+        print(" ".join(cells))
+    print(f"\n{len(rows)} row(s)")
+    return 0
+
+
+def cmd_summary(args) -> int:
+    """Per-function task rollup (reference `ray summary tasks`)."""
+    client = _client(args)
+    try:
+        summary = client.summarize_tasks()
+    finally:
+        client.close()
+    if args.output == "json":
+        print(json.dumps(summary, default=str, indent=2))
+        return 0
+    print(f"{'FUNCTION':32} {'COUNT':>6} {'MEAN_S':>8} STATES")
+    for name, row in sorted(summary.get("summary", {}).items()):
+        mean = row.get("mean_duration_s")
+        mean_s = f"{mean:.4f}" if mean is not None else "-"
+        states = " ".join(f"{s}={n}"
+                          for s, n in sorted(row["by_state"].items()))
+        print(f"{name:32} {row['count']:>6} {mean_s:>8} {states}")
+    print(f"\ntracked: {summary.get('total_tasks', 0)}  "
+          f"dropped_at_source: {summary.get('dropped_at_source', 0)}  "
+          f"evicted_records: {summary.get('evicted_records', 0)}")
+    return 0
+
+
 def cmd_timeline(args) -> int:
     """Dump the head's tracing timeline as chrome://tracing JSON
     (reference `ray timeline`)."""
@@ -385,6 +455,24 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("memory", help="per-node object store summary")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_memory)
+
+    p = sub.add_parser("list", help="list cluster state: "
+                                    "tasks/actors/objects/nodes")
+    p.add_argument("resource",
+                   choices=["tasks", "actors", "objects", "nodes"])
+    p.add_argument("--filter", action="append", metavar="KEY=VALUE",
+                   help="e.g. --filter state=FINISHED (also KEY!=VALUE)")
+    p.add_argument("--limit", type=int, default=100)
+    p.add_argument("--offset", type=int, default=0)
+    p.add_argument("--output", choices=["table", "json"], default="table")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("summary", help="rollups: summary tasks")
+    p.add_argument("resource", choices=["tasks"])
+    p.add_argument("--output", choices=["table", "json"], default="table")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_summary)
 
     p = sub.add_parser("timeline", help="dump chrome://tracing JSON")
     p.add_argument("--address", default=None)

@@ -293,7 +293,9 @@ class PipelineDriver:
             try:
                 value = body()
             except Exception:
-                value = getattr(request, "query_params", {})
+                # No/invalid JSON body: the documented fallback is the
+                # "input" query param, not the raw query_params dict.
+                value = getattr(request, "query_params", {}).get("input")
         return ray_tpu.get(self._dag.remote(value))
 
 

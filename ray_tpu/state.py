@@ -30,6 +30,18 @@ class GlobalState:
             return info.get(actor_id, {})
         return {aid.hex(): v for aid, v in info.items()}
 
+    def task_table(self, task_id=None) -> dict:
+        """Task lifecycle records from the task-event pipeline
+        (reference ``GlobalState.task_table``), keyed by task id hex."""
+        from ray_tpu.gcs.task_events import flushed_manager
+        mgr = flushed_manager(self._gcs())
+        if mgr is None:
+            return {}
+        if task_id is not None:
+            tid = task_id.hex() if hasattr(task_id, "hex") else str(task_id)
+            return mgr.get(tid) or {}
+        return {rec["task_id"]: rec for rec in mgr.tasks()}
+
     def placement_group_table(self) -> dict:
         return self._gcs().placement_group_manager.table()
 

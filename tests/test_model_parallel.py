@@ -9,7 +9,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 
 def test_ring_attention_matches_full():
-    from jax import shard_map
+    from jax.experimental.shard_map import shard_map
 
     from ray_tpu.ops.ring_attention import full_attention, ring_attention
     from ray_tpu.parallel.mesh import MeshConfig, build_mesh
@@ -27,7 +27,7 @@ def test_ring_attention_matches_full():
         mesh=mesh,
         in_specs=(P(None, "sp", None, None),) * 3,
         out_specs=P(None, "sp", None, None),
-        check_vma=False)
+        check_rep=False)
     with mesh:
         got = jax.jit(fn)(q, k, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
@@ -35,7 +35,7 @@ def test_ring_attention_matches_full():
 
 
 def test_ring_attention_non_causal():
-    from jax import shard_map
+    from jax.experimental.shard_map import shard_map
 
     from ray_tpu.ops.ring_attention import full_attention, ring_attention
     from ray_tpu.parallel.mesh import MeshConfig, build_mesh
@@ -51,7 +51,7 @@ def test_ring_attention_non_causal():
         lambda q, k, v: ring_attention(q, k, v, axis_name="sp",
                                        causal=False),
         mesh=mesh, in_specs=(P(None, "sp", None, None),) * 3,
-        out_specs=P(None, "sp", None, None), check_vma=False)
+        out_specs=P(None, "sp", None, None), check_rep=False)
     with mesh:
         got = jax.jit(fn)(q, k, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),

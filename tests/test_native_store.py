@@ -64,6 +64,58 @@ def test_idempotent_put(store):
     assert bytes(store.get(b"k")) == b"v1"
 
 
+class TestShmAbort:
+    """The host's ``shm_abort`` handler must reclaim ONLY unsealed
+    create-reservations: a worker fires abort on any mid-write failure,
+    including a timed-out seal reply that actually landed — deleting
+    the now-sealed (registered, locatable) object would corrupt it for
+    every other reader (ADVICE.md)."""
+
+    def _host_stub(self, native):
+        import threading
+        from types import SimpleNamespace
+
+        from ray_tpu._private.worker_pool import WorkerHostService
+        stub = SimpleNamespace(
+            _node=SimpleNamespace(
+                object_store=SimpleNamespace(_native=native)),
+            _shm_seal_lock=threading.Lock())
+        stub._native_store = \
+            WorkerHostService._native_store.__get__(stub)
+        return stub
+
+    def test_abort_reclaims_unsealed_reservation(self, store):
+        from ray_tpu._private.worker_pool import WorkerHostService
+        stub = self._host_stub(store)
+        off = store.create(b"pending", 4096)
+        assert off is not None
+        used = store.used_bytes()
+        assert WorkerHostService._shm_abort(stub,
+                                            {"object_id": b"pending"})
+        assert store.used_bytes() < used
+        # The key is reusable again (the reservation really went away).
+        assert store.create(b"pending", 4096) is not None
+
+    def test_abort_spares_sealed_object(self, store):
+        from ray_tpu._private.worker_pool import WorkerHostService
+        stub = self._host_stub(store)
+        off = store.create(b"sealed", 8)
+        store._mm[off:off + 8] = b"payload!"
+        assert store.seal(b"sealed")
+        # Late abort (e.g. the worker timed out on the seal reply that
+        # actually landed): must be refused, bytes must survive.
+        assert WorkerHostService._shm_abort(
+            stub, {"object_id": b"sealed"}) is False
+        assert bytes(store.get(b"sealed")) == b"payload!"
+
+    def test_abort_missing_key_is_noop(self, store):
+        from ray_tpu._private.worker_pool import WorkerHostService
+        stub = self._host_stub(store)
+        used = store.used_bytes()
+        WorkerHostService._shm_abort(stub, {"object_id": b"ghost"})
+        assert store.used_bytes() == used
+
+
 def test_integration_with_node_store(ray_start_regular):
     """Large puts flow through the native backend when available."""
     import ray_tpu

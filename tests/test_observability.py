@@ -296,6 +296,247 @@ class TestNodeStatsReporter:
             dash.stop()
 
 
+class TestTaskEvents:
+    """Task-event pipeline (reference State API / task-events backend):
+    lifecycle transitions emitted by core worker + raylet + executor,
+    batched over pubsub into the GCS TaskEventManager, queried through
+    ``ray_tpu.experimental.state``."""
+
+    ORDER = ["PENDING_ARGS_AVAIL", "SCHEDULED", "SUBMITTED_TO_WORKER",
+             "RUNNING", "FINISHED", "FAILED"]
+
+    def _rows_named(self, fragment):
+        from ray_tpu.experimental.state import list_tasks
+        return [r for r in list_tasks(limit=None)
+                if fragment in r["name"]]
+
+    def _assert_lifecycle(self, rec):
+        # All five states observed, in canonical order, each stamped.
+        states = [s for s, _ts in rec["events"]]
+        expected = ["PENDING_ARGS_AVAIL", "SCHEDULED",
+                    "SUBMITTED_TO_WORKER", "RUNNING", "FINISHED"]
+        for s in expected:
+            assert s in states, f"missing state {s} in {states}"
+            assert s in rec["state_ts"], f"no timestamp for {s}"
+        indices = [self.ORDER.index(s) for s in states]
+        assert indices == sorted(indices), \
+            f"states out of lifecycle order: {states}"
+        ts = [rec["state_ts"][s] for s in expected]
+        assert ts == sorted(ts), "per-state timestamps not monotone"
+        assert rec["state"] == "FINISHED"
+        assert rec["node_id"] and rec["worker_id"]
+        assert rec["duration_s"] is not None and rec["duration_s"] >= 0
+
+    def test_lifecycle_thread_mode(self, thread_cluster):
+        @ray_tpu.remote
+        def add_one_te(x):
+            return x + 1
+
+        assert ray_tpu.get(add_one_te.remote(1), timeout=30) == 2
+        rows = self._rows_named("add_one_te")
+        assert rows, "task never reached the event manager"
+        self._assert_lifecycle(rows[-1])
+
+    def test_lifecycle_process_mode(self, process_cluster):
+        @ray_tpu.remote
+        def add_two_te(x):
+            return x + 2
+
+        assert ray_tpu.get(add_two_te.remote(1), timeout=60) == 3
+        rows = self._rows_named("add_two_te")
+        assert rows
+        self._assert_lifecycle(rows[-1])
+
+    def test_attempt_counter_on_retry(self, thread_cluster, tmp_path):
+        marker = str(tmp_path / "flaky_marker")
+
+        @ray_tpu.remote(max_retries=2, retry_exceptions=True)
+        def flaky_te(path):
+            if not os.path.exists(path):
+                open(path, "w").close()
+                raise ValueError("first attempt fails")
+            return "ok"
+
+        assert ray_tpu.get(flaky_te.remote(marker), timeout=30) == "ok"
+        rows = self._rows_named("flaky_te")
+        assert rows
+        rec = rows[-1]
+        assert rec["attempt"] >= 1, \
+            "retry did not bump the attempt counter"
+        assert rec["state"] == "FINISHED"
+
+    def test_failed_task_records_error(self, thread_cluster):
+        @ray_tpu.remote(max_retries=0)
+        def boom_te():
+            raise RuntimeError("deliberate")
+
+        with pytest.raises(Exception):
+            ray_tpu.get(boom_te.remote(), timeout=30)
+        rows = self._rows_named("boom_te")
+        assert rows
+        rec = rows[-1]
+        assert rec["state"] == "FAILED"
+        assert "FAILED" in rec["state_ts"]
+        assert rec["error"] and "deliberate" in rec["error"]
+
+    def test_burst_500_tasks_zero_drops(self, thread_cluster):
+        from ray_tpu._private.worker import global_worker
+        from ray_tpu.experimental.state import summarize_tasks
+
+        @ray_tpu.remote
+        def unit_te(i):
+            return i
+
+        out = ray_tpu.get([unit_te.remote(i) for i in range(500)],
+                          timeout=120)
+        assert sorted(out) == list(range(500))
+        gcs = global_worker().cluster.gcs
+        gcs.task_events.flush()
+        assert gcs.task_event_manager.num_dropped_at_source() == 0, \
+            "bounded buffer dropped events under a 500-task burst"
+        rows = self._rows_named("unit_te")
+        finished = [r for r in rows if r["state"] == "FINISHED"]
+        assert len(finished) == 500
+        summary = summarize_tasks()
+        assert summary["dropped_at_source"] == 0
+        name = next(k for k in summary["summary"] if "unit_te" in k)
+        assert summary["summary"][name]["count"] == 500
+
+    def test_filters_and_pagination(self, thread_cluster):
+        from ray_tpu.experimental.state import list_tasks
+
+        @ray_tpu.remote
+        def page_te(i):
+            return i
+
+        ray_tpu.get([page_te.remote(i) for i in range(10)], timeout=60)
+        finished = list_tasks(filters=[("state", "=", "FINISHED")],
+                              limit=None)
+        assert all(r["state"] == "FINISHED" for r in finished)
+        page1 = list_tasks(limit=4)
+        page2 = list_tasks(limit=4, offset=4)
+        assert len(page1) == 4 and len(page2) == 4
+        assert {r["task_id"] for r in page1}.isdisjoint(
+            {r["task_id"] for r in page2})
+        not_finished = list_tasks(filters=[("state", "!=", "FINISHED")],
+                                  limit=None)
+        assert all(r["state"] != "FINISHED" for r in not_finished)
+
+    def test_task_table_global_state(self, thread_cluster):
+        from ray_tpu.state import state as global_state
+
+        @ray_tpu.remote
+        def table_te():
+            return 1
+
+        ref = table_te.remote()
+        assert ray_tpu.get(ref, timeout=30) == 1
+        table = global_state.task_table()
+        tid = ref.task_id().hex()
+        assert tid in table
+        assert table[tid]["state"] == "FINISHED"
+
+    def test_actor_task_lifecycle(self, thread_cluster):
+        @ray_tpu.remote
+        class CounterTE:
+            def __init__(self):
+                self.n = 0
+
+            def bump(self):
+                self.n += 1
+                return self.n
+
+        c = CounterTE.remote()
+        assert ray_tpu.get(c.bump.remote(), timeout=30) == 1
+        rows = self._rows_named("CounterTE.bump")
+        assert rows
+        rec = rows[-1]
+        states = [s for s, _ts in rec["events"]]
+        assert "PENDING_ARGS_AVAIL" in states
+        assert "SUBMITTED_TO_WORKER" in states
+        assert rec["state"] == "FINISHED"
+
+    def test_dashboard_tasks_route(self, thread_cluster):
+        import json as json_mod
+
+        from ray_tpu._private.worker import global_worker
+        from ray_tpu.dashboard.head import start_dashboard
+
+        @ray_tpu.remote
+        def dash_te():
+            return 1
+
+        ray_tpu.get(dash_te.remote(), timeout=30)
+        dash = start_dashboard(global_worker().cluster)
+        try:
+            body = urllib.request.urlopen(
+                dash.url + "/api/tasks?state=FINISHED&limit=1000",
+                timeout=10).read()
+            rows = json_mod.loads(body)
+            assert rows and all(r["state"] == "FINISHED" for r in rows)
+            assert any("dash_te" in r["name"] for r in rows)
+            body = urllib.request.urlopen(
+                dash.url + "/api/tasks/summary", timeout=10).read()
+            summary = json_mod.loads(body)
+            assert summary["dropped_at_source"] == 0
+            assert any("dash_te" in k for k in summary["summary"])
+        finally:
+            dash.stop()
+
+
+class TestSchedulerTickMetrics:
+    """Scheduler tick instrumentation: latency histogram, queue depth
+    gauge, spillback/fallback counters at /metrics, and a tracing span
+    per working tick."""
+
+    def _scrape(self):
+        from ray_tpu._private.metrics_agent import get_metrics_registry
+        return get_metrics_registry().render_prometheus()
+
+    def test_tick_series_exposed_and_populated(self, thread_cluster):
+        @ray_tpu.remote
+        def tick_te(i):
+            return i
+
+        ray_tpu.get([tick_te.remote(i) for i in range(16)], timeout=60)
+        text = self._scrape()
+        assert "ray_tpu_scheduler_tick_latency_bucket" in text
+        assert "ray_tpu_scheduler_pending_queue_depth" in text
+        assert "ray_tpu_scheduler_tick_ticks" in text
+        assert "ray_tpu_scheduler_tick_spillbacks" in text
+        assert "ray_tpu_scheduler_tick_jnp_fallbacks" in text
+        # The histogram carries at least one observation after a tick.
+        counts = [float(line.rsplit(" ", 1)[1])
+                  for line in text.splitlines()
+                  if line.startswith("ray_tpu_scheduler_tick_latency_count")]
+        assert counts and max(counts) >= 1
+        # The scheduler actually ticked with work queued.
+        busy = [float(line.rsplit(" ", 1)[1])
+                for line in text.splitlines()
+                if line.startswith("ray_tpu_scheduler_tick_busy_ticks")]
+        assert busy and max(busy) >= 1
+
+    def test_tick_emits_tracing_span(self):
+        from ray_tpu.util import tracing
+        ray_tpu.init(num_cpus=2, _system_config={"tracing_enabled": True})
+        try:
+            tracing.clear()
+
+            @ray_tpu.remote
+            def span_te():
+                return 1
+
+            assert ray_tpu.get(span_te.remote(), timeout=30) == 1
+            events = ray_tpu.timeline()
+            ticks = [e for e in events if e["cat"] == "sched"]
+            assert ticks, "no scheduler.tick span in the timeline"
+            assert any(e["name"] == "scheduler.tick" for e in ticks)
+        finally:
+            ray_tpu.shutdown()
+            tracing.enable(False)
+            tracing.clear()
+
+
 class TestLatencyEnvelope:
     def test_task_roundtrip_tail_latency(self, thread_cluster):
         """Pins the magic-timeout hazards (VERDICT r4: wait()'s 200 ms
