@@ -39,6 +39,8 @@ Problem shape (config 5 of BASELINE.json, Google-cluster-trace shaped):
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -88,18 +90,32 @@ def arrival_stream(rng, counts, ticks, per_tick=130_000):
     return stream
 
 
-def _init_backend():
-    """Bring up a jax backend, falling back to CPU when the configured
-    platform (e.g. a TPU plugin) fails to initialize.  Returns the
-    backend name, or None when no backend at all comes up — the bench
-    must emit parseable JSON and rc=0 in that case, not a backend-init
-    traceback (BENCH_r05 recorded rc=1 nulls from exactly this)."""
-    try:
-        import jax
-        jax.devices()
-        return jax.default_backend()
-    except Exception:
-        pass
+def _probe():
+    """Bounded-timeout subprocess probe of the configured backend
+    (ray_tpu._private.tpu_probe) — a sick chip can never hang this
+    process (BENCH_r05 was rc=1 and MULTICHIP_r05 rc=124 from exactly
+    that).  Prints a structured marker when the chip is unusable."""
+    from ray_tpu._private.tpu_probe import (chip_unavailable_marker,
+                                            probe_backend)
+    probe = probe_backend(timeout=90.0, retries=2)
+    if not probe.get("ok"):
+        print(chip_unavailable_marker(probe, stage="bench",
+                                      fallback="cpu"), flush=True)
+    return probe
+
+
+def _init_backend(probe):
+    """Bring up the probed backend in-process, falling back to CPU.
+    Returns the backend name, or None when no backend at all comes up —
+    the bench must emit parseable JSON and rc=0 in that case, not a
+    backend-init traceback."""
+    if probe.get("ok"):
+        try:
+            import jax
+            jax.devices()      # probe proved this returns promptly
+            return jax.default_backend()
+        except Exception:
+            pass
     try:
         import jax
         jax.config.update("jax_platforms", "cpu")
@@ -109,14 +125,52 @@ def _init_backend():
         return None
 
 
+def _model_bench_row(on_cpu: bool):
+    """Run bench_model.py (transformer train-step MFU) in a subprocess
+    and return its parsed JSON row, or a structured skip dict.  The
+    driver only ever invokes bench.py, so the MFU number must ride this
+    process's output (VERDICT weak-#2: MFU had never been measured)."""
+    env = dict(os.environ)
+    if on_cpu:
+        # The parent already decided the TPU is unusable: the child
+        # must not retry (and hang on) the real backend.
+        env["JAX_PLATFORMS"] = "cpu"
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench_model.py")
+    try:
+        proc = subprocess.run([sys.executable, path], env=env,
+                              capture_output=True, text=True,
+                              timeout=1200)
+    except subprocess.TimeoutExpired:
+        return {"skipped": True, "reason": "bench_model timed out"}
+    if proc.returncode != 0 or not proc.stdout.strip():
+        return {"skipped": True,
+                "reason": f"bench_model rc={proc.returncode}: "
+                          f"{(proc.stderr or '')[-400:]}"}
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except ValueError:
+        return {"skipped": True, "reason": "unparseable bench_model output"}
+
+
 def main():
-    backend = _init_backend()
+    probe = _probe()
+    probed_cpu = not probe.get("ok") or probe.get("backend") != "tpu"
+    # MFU child runs BEFORE this process initializes any backend: the
+    # TPU is per-process exclusive, so a parent already holding the
+    # chip would starve (or wedge) the very measurement this exists
+    # for.  The child gets the chip to itself, then releases it.
+    model = _model_bench_row(probed_cpu)
+
+    backend = _init_backend(probe)
     if backend is None:
         print(json.dumps({
             "metric": "scheduler_tick_1M_tasks_x_10k_nodes",
             "value": None, "unit": "ms", "skipped": True,
             "reason": "no jax backend initialized (TPU plugin failed "
                       "and no CPU fallback)",
+            "mfu": None,
+            "mfu_skip_reason": "no jax backend initialized",
         }))
         return 0
 
@@ -193,6 +247,21 @@ def main():
         # Not the headline problem: flag it so the trajectory doesn't
         # compare CPU-scaled numbers against TPU targets.
         res["scaled_down_for_cpu"] = True
+
+    # Model-compute axis: transformer train-step MFU rode the same
+    # bench.py invocation (measured above, before this process touched
+    # the chip — the driver runs nothing else).  Its own JSON line is
+    # printed for the record AND folded into the headline row as an
+    # ``mfu`` field (structured null + reason on skip).
+    if model.get("skipped"):
+        res["mfu"] = None
+        res["mfu_skip_reason"] = model.get("reason")
+    else:
+        print(json.dumps(model))
+        res["mfu"] = model.get("value")
+        res["mfu_backend"] = model.get("backend")
+        if model.get("backend") != "tpu":
+            res["mfu_scaled_down_for_cpu"] = True
     print(json.dumps(res))
 
 

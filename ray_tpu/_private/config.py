@@ -70,6 +70,10 @@ class Config:
     use_native_object_store: bool = True
     #: Chunk size for node-to-node object transfer (object_manager.cc).
     object_manager_chunk_size: int = 5 * 1024 * 1024
+    #: In-flight chunk requests per pull transfer: the receiver keeps a
+    #: window of this many pipelined chunk RPCs open to hide round-trip
+    #: latency (push_manager.cc ack window / pull retry flow).
+    object_transfer_pipeline_depth: int = 8
 
     # ------ core worker / task path ------
     #: Args at or below this size are inlined into the task spec

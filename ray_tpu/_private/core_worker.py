@@ -502,6 +502,23 @@ class CoreWorker:
                 self.memory_store.delete(oid)
                 self.recover_object(oid)
 
+    def fail_owned_object(self, object_id: ObjectID,
+                          error: BaseException):
+        """Owner-death invalidation: seal ``error`` over the object so
+        every borrower's get/wait raises instead of hanging, and drop
+        the now-ownerless copies (reference: reference_count.cc OWNER
+        _DIED propagation / WaitForRefRemoved teardown)."""
+        self.memory_store.fail(object_id, error)
+        directory = self.cluster.object_directory
+        for node_id in directory.get_locations(object_id):
+            raylet = self.cluster.gcs.raylet(node_id)
+            if raylet is not None:
+                try:
+                    raylet.object_store.delete(object_id)
+                except Exception:
+                    pass
+        directory.remove_object(object_id)
+
     # ---- free path ------------------------------------------------------
     def _free_object(self, object_id: ObjectID):
         self.memory_store.delete(object_id)

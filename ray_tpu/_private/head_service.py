@@ -123,6 +123,20 @@ class _ProxyObjectStore:
             return None
         return None if blob is None else SerializedObject.from_bytes(blob)
 
+    def fetch_into(self, object_id: ObjectID, local_store,
+                   pipeline: int = 8, on_chunk=None,
+                   timeout: float = 300.0):
+        """Streamed head-side pull from a spoke: the windowed chunk
+        pipeline assembles directly into a reserved block of the head's
+        segment (same zero-copy receive path the spokes use)."""
+        from ray_tpu._private.object_manager import fetch_object_into
+        try:
+            return fetch_object_into(
+                self._proxy.client, object_id, local_store,
+                pipeline=pipeline, on_chunk=on_chunk, timeout=timeout)
+        except Exception:
+            return None
+
     def delete(self, object_id: ObjectID):
         self._proxy.client.call_async(
             "delete_object", {"object_id": object_id.binary()}, _ignore)
@@ -317,10 +331,19 @@ class HeadService:
         # Chunked object plane (pull_manager/push_manager parity): any
         # object size crosses the wire as chunk frames with per-chunk
         # acks and sender-side admission control.
+        from ray_tpu._private.object_store import segment_chunk_source
         from ray_tpu.rpc.chunked import serve_chunks
+
+        def _head_segment_source(oid_bin):
+            head = cluster.head_node
+            if head is None:
+                return None
+            return segment_chunk_source(head.object_store)(oid_bin)
+
         self.chunk_server = serve_chunks(
             s, lambda oid_bin: self._handle_fetch_object(
-                {"object_id": oid_bin}))
+                {"object_id": oid_bin}),
+            get_source=_head_segment_source)
         # Remote-driver surface (Ray Client parity): drivers in other
         # processes connect via init(address="ray-tpu://host:port").
         from ray_tpu._private.client_service import register_client_surface

@@ -146,6 +146,35 @@ class _PeerStoreReader:
                              timeout=300.0)
         return None if blob is None else SerializedObject.from_bytes(blob)
 
+    def fetch_into(self, object_id: ObjectID, local_store,
+                   pipeline: int = 8, on_chunk=None,
+                   timeout: float = 300.0) -> Optional[int]:
+        """Streamed pull: assemble the windowed chunk pipeline DIRECTLY
+        into a reserved block of ``local_store`` (no intermediate
+        ``bytearray`` — the zero-copy receive half of the data plane).
+        Tries the direct peer link first, the head link as fallback."""
+        from ray_tpu import exceptions as exc
+        from ray_tpu._private.object_manager import fetch_object_into
+        peer = self._host.peers.client_for(self._node_id)
+        for client in ([peer] if peer is not None else []) + \
+                [self._host.client]:
+            try:
+                nbytes = fetch_object_into(
+                    client, object_id, local_store, pipeline=pipeline,
+                    on_chunk=on_chunk, timeout=timeout)
+            except exc.ObjectStoreFullError:
+                # LOCAL store cannot take the object: the peer is not
+                # at fault (don't tear its link down) and the head leg
+                # would fail identically — surface the failure.
+                return None
+            except Exception:
+                nbytes = None
+                if client is peer:
+                    self._host.peers.drop(self._node_id)
+            if nbytes is not None:
+                return nbytes
+        return None
+
     def get(self, object_id: ObjectID):
         return None
 
@@ -490,10 +519,12 @@ class NodeHost:
         s.register("cancel_bundle", self._handle_cancel_bundle)
         s.register("ping", lambda _p: "pong")
         s.register("stop", self._handle_stop)
+        from ray_tpu._private.object_store import segment_chunk_source
         from ray_tpu.rpc.chunked import serve_chunks
         self.chunk_server = serve_chunks(
             s, lambda oid_bin: self._handle_fetch_object(
-                {"object_id": oid_bin}))
+                {"object_id": oid_bin}),
+            get_source=segment_chunk_source(self.raylet.object_store))
         self._stop_event = threading.Event()
 
         # Join the cluster (NodeInfoGcsService RegisterNode parity).

@@ -12,12 +12,62 @@ the chunked-flow structure the gRPC path would have.
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, List, Set
+import time
+from typing import Callable, Dict, List, Optional, Set
 
-from ray_tpu import exceptions
 from ray_tpu._private.config import get_config
 from ray_tpu._private.ids import NodeID, ObjectID
-from ray_tpu._private.serialization import SerializedObject
+from ray_tpu._private.object_store import NodeObjectStore, _NativeHandle
+
+
+def fetch_object_into(client, object_id: ObjectID, local_store,
+                      pipeline: int = 8, on_chunk=None,
+                      timeout: float = 300.0):
+    """One complete streamed pull over ``client``: negotiate the chunk
+    session (inline reply / busy-backoff / windowed pipeline) and
+    assemble the object DIRECTLY into a reserved block of
+    ``local_store`` via ``create_transfer_writer`` — the shared receive
+    half of the zero-copy data plane, used by spoke-to-peer, spoke-to-
+    head and head-to-spoke pulls alike.  Returns the flat byte count on
+    success, None on failure/absence."""
+    from ray_tpu._private.serialization import SerializedObject
+    from ray_tpu.rpc.chunked import fetch_session_into
+    deadline = time.monotonic() + timeout
+    backoff = 0.02
+    while True:
+        meta = client.call("fetch_meta",
+                           {"object_id": object_id.binary()},
+                           timeout=min(60.0, timeout))
+        if meta is None:
+            return None              # source has no copy
+        if "inline" in meta:
+            blob = meta["inline"]
+            local_store.put(object_id, SerializedObject.from_bytes(blob),
+                            pin=False)
+            if on_chunk is not None:
+                on_chunk(len(blob), 0)
+            return len(blob)
+        if meta.get("busy"):
+            # Sender admission control: back off and retry.
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 1.0)
+            continue
+        writer = local_store.create_transfer_writer(object_id,
+                                                    meta["size"])
+        ok = False
+        try:
+            ok = fetch_session_into(
+                client, meta, writer.write,
+                timeout=max(0.0, deadline - time.monotonic()),
+                pipeline=pipeline, on_chunk=on_chunk)
+        finally:
+            if ok:
+                writer.seal()
+            else:
+                writer.abort()
+        return meta["size"] if ok else None
 
 
 class ObjectDirectory:
@@ -112,7 +162,18 @@ class NodeObjectManager:
         self._pull_pool = DaemonPool(
             4, name=f"ray_tpu::pull::{raylet.node_id.hex()[:6]}")
         self.stats = {"pulled_objects": 0, "pulled_bytes": 0,
-                      "chunks_transferred": 0}
+                      "chunks_transferred": 0, "failed_pulls": 0,
+                      "transfer_gbps_last": 0.0,
+                      "inflight_window_peak": 0}
+        from ray_tpu._private.metrics_agent import (get_metrics_registry,
+                                                    record_internal)
+        nid = raylet.node_id.hex()[:12]
+
+        def _collect(om):
+            labels = {"node": nid}
+            for k, v in om.stats.items():
+                record_internal(f"ray_tpu.object_manager.{k}", v, **labels)
+        get_metrics_registry().register_collector(self, _collect)
 
     # ---- queries --------------------------------------------------------
     def is_local_or_inline(self, object_id: ObjectID) -> bool:
@@ -154,7 +215,15 @@ class NodeObjectManager:
             if self.is_local_or_inline(object_id):
                 finish(True)
                 return
-            finish(self._fetch_from(object_id, node_id))
+            # finish() MUST run no matter how the transfer dies: an
+            # escaped exception would be swallowed by the pull pool,
+            # leaving every waiter (and all future pulls of this id,
+            # parked on the orphaned inflight entry) hung forever.
+            try:
+                ok = self._fetch_from(object_id, node_id)
+            except Exception:
+                ok = False
+            finish(ok)
 
         locations = self._directory.get_locations(object_id)
         if locations:
@@ -186,8 +255,20 @@ class NodeObjectManager:
         self._pull_pool.stop()
 
     def _fetch_from(self, object_id: ObjectID, node_id: NodeID) -> bool:
-        """Chunked copy of the serialized object from a remote node store
-        into the local store (ObjectBufferPool chunk assembly parity)."""
+        """Streamed transfer of the serialized object from a remote node
+        store into the local store (ObjectBufferPool chunk assembly
+        parity) — single-copy end to end:
+
+        * cross-process peers: a WINDOWED pipeline of in-flight chunk
+          requests (rpc/chunked.py) assembles each chunk directly into a
+          reserved local shm-segment block — no intermediate
+          ``bytearray``, no whole-blob RPC;
+        * in-process source stores (simulated multi-node): the source's
+          segment view is copied chunk-by-chunk straight into the local
+          reservation under a source-side pin.
+
+        Per-transfer throughput and the in-flight window peak are
+        exported through the metrics agent."""
         source = self._raylet.cluster.gcs.raylet(node_id)
         if source is None:
             # Source died; try another location or give up.
@@ -195,18 +276,96 @@ class NodeObjectManager:
                 if other != node_id:
                     return self._fetch_from(object_id, other)
             return False
-        serialized = source.object_store.get_serialized(object_id)
-        if serialized is None:
-            return False
-        blob = serialized.to_bytes()
-        chunk = get_config().object_manager_chunk_size
-        assembled = bytearray(len(blob))
-        for off in range(0, len(blob), chunk):
-            assembled[off:off + chunk] = blob[off:off + chunk]
+        t0 = time.monotonic()
+        reader = source.object_store
+        window_peak = [0]
+
+        def on_chunk(nbytes: int, inflight: int):
             self.stats["chunks_transferred"] += 1
-        restored = SerializedObject.from_bytes(bytes(assembled))
-        self._raylet.object_store.put(object_id, restored, pin=False)
+            if inflight > window_peak[0]:
+                window_peak[0] = inflight
+
+        if hasattr(reader, "fetch_into"):
+            # Cross-process peer: pipelined chunk stream into the local
+            # segment (PullManager admission + ack flow).
+            nbytes = reader.fetch_into(
+                object_id, self._raylet.object_store,
+                pipeline=get_config().object_transfer_pipeline_depth,
+                on_chunk=on_chunk)
+        elif isinstance(reader, NodeObjectStore):
+            nbytes = self._copy_local(object_id, reader, on_chunk)
+        else:
+            nbytes = self._copy_via_serialized(object_id, reader,
+                                               on_chunk)
+        if nbytes is None:
+            self.stats["failed_pulls"] += 1
+            return False
         self._directory.add_location(object_id, self._raylet.node_id)
         self.stats["pulled_objects"] += 1
-        self.stats["pulled_bytes"] += len(blob)
+        self.stats["pulled_bytes"] += nbytes
+        elapsed = max(time.monotonic() - t0, 1e-9)
+        self.stats["transfer_gbps_last"] = round(
+            nbytes / elapsed / 1e9, 3)
+        self.stats["inflight_window_peak"] = max(
+            self.stats["inflight_window_peak"], window_peak[0])
+        from ray_tpu._private.metrics_agent import (observe_internal,
+                                                    record_internal)
+        record_internal("ray_tpu.object_manager.transfer_gbps",
+                        nbytes / elapsed / 1e9,
+                        node=self._raylet.node_id.hex()[:12])
+        observe_internal("ray_tpu.object_manager.transfer_seconds",
+                         elapsed)
         return True
+
+    def _copy_local(self, object_id: ObjectID, src: "NodeObjectStore",
+                    on_chunk) -> Optional[int]:
+        """In-process store-to-store transfer: chunked copy from the
+        source's segment view directly into a local reservation.  The
+        source block is pinned for the duration so eviction cannot
+        recycle it mid-read."""
+        entry = src.get(object_id)
+        if entry is None:
+            return None
+        data = entry.data
+        if isinstance(data, _NativeHandle) and src._native is not None:
+            key = data.key
+            # Pin failure = the block was spilled/freed in the window;
+            # fall through to the serialized leg, whose get() restores
+            # spilled bytes — the object may still be recoverable.
+            if src._native.pin(key):
+                try:
+                    view = data.read()
+                    if view is not None:
+                        nbytes = view.nbytes
+                        store = self._raylet.object_store
+                        writer = store.create_transfer_writer(
+                            object_id, nbytes)
+                        try:
+                            chunk = get_config().object_manager_chunk_size
+                            for off in range(0, nbytes, chunk):
+                                writer.write(off, view[off:off + chunk])
+                                on_chunk(min(chunk, nbytes - off), 0)
+                            writer.seal()
+                        except BaseException:
+                            writer.abort()
+                            raise
+                        return nbytes
+                finally:
+                    src._native.unpin(key)
+        return self._copy_via_serialized(object_id, src, on_chunk)
+
+    def _copy_via_serialized(self, object_id: ObjectID, reader,
+                             on_chunk) -> Optional[int]:
+        """Generic leg (python-held / device / proxy sources): the
+        source hands back a SerializedObject whose buffers are
+        heap-backed (kept alive by the views), and the local put moves
+        them straight into the local segment — still one data copy."""
+        serialized = reader.get_serialized(object_id)
+        if serialized is None:
+            return None
+        nbytes = serialized.flat_nbytes
+        self._raylet.object_store.put(object_id, serialized, pin=False)
+        chunk = get_config().object_manager_chunk_size
+        for off in range(0, nbytes, chunk):
+            on_chunk(min(chunk, nbytes - off), 0)
+        return nbytes

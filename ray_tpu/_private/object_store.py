@@ -92,6 +92,20 @@ class MemoryStore:
     def put_error(self, object_id: ObjectID, error: BaseException):
         self.put(object_id, None, error=error)
 
+    def fail(self, object_id: ObjectID, error: BaseException):
+        """Force-seal ``error`` over the entry, REPLACING any existing
+        value (owner-death semantics: the owner's table was
+        authoritative, so its loss invalidates the object even when
+        bytes still exist somewhere — borrowers must observe the error,
+        reference: OWNER_DIED reply on Get)."""
+        with self._lock:
+            entry = _Entry(data=None, error=error)
+            self._entries[object_id] = entry
+            callbacks = self._get_callbacks.pop(object_id, [])
+            self._lock.notify_all()
+        for cb in callbacks:
+            cb(entry)
+
     def contains(self, object_id: ObjectID) -> bool:
         with self._lock:
             e = self._entries.get(object_id)
@@ -170,10 +184,15 @@ class NodeObjectStore:
         self._lock = threading.Condition()
         self._entries: Dict[ObjectID, _Entry] = {}
         self._used = 0
+        # Bytes reserved by in-flight transfer writers (charged before
+        # the chunks land so concurrent pulls cannot over-commit the
+        # budget; moved into _used at seal, dropped at abort).
+        self._transfer_reserved = 0
         self._native = native_backend  # ray_tpu.native shm store, optional
         self.stats = {"spilled_bytes": 0, "restored_bytes": 0,
                       "spilled_objects": 0, "restored_objects": 0,
-                      "evicted_objects": 0}
+                      "evicted_objects": 0, "native_put_bytes": 0,
+                      "native_puts": 0}
         from ray_tpu._private.metrics_agent import (get_metrics_registry,
                                                     record_internal)
         nid = getattr(node_id, "hex", lambda: str(node_id))()[:12]
@@ -192,36 +211,112 @@ class NodeObjectStore:
 
     # ---- create/seal (plasma lifecycle) --------------------------------
     def put(self, object_id: ObjectID, data, pin: bool = True) -> int:
+        """Store a value.  For serialized payloads with a native backend
+        this is SINGLE-COPY: a block is reserved in the shm segment
+        (create), the flattened form is written straight into the
+        mapping with NO store lock held (each payload byte moves exactly
+        once, source buffer -> segment), then the entry is sealed and
+        published.  Concurrent puts of different objects overlap their
+        bulk copies; the lock only guards table bookkeeping."""
         size = getattr(data, "total_bytes", None) or getattr(data, "nbytes", 0)
+        native_eligible = (self._native is not None
+                           and isinstance(data, SerializedObject))
         with self._lock:
-            if object_id in self._entries and self._entries[object_id].sealed:
-                return self._entries[object_id].size
+            existing = self._entries.get(object_id)
+            if existing is not None:
+                if existing.sealed:
+                    return existing.size
+                # Another putter is mid-copy: wait for its seal
+                # (idempotent re-put, plasma create-in-progress reply).
+                self._wait_sealed_locked(object_id)
+                existing = self._entries.get(object_id)
+                if existing is not None:
+                    # Sealed: idempotent success with the winner's size.
+                    # Still unsealed after the wait: stuck writer —
+                    # don't double-store under it.
+                    return existing.size if existing.sealed else size
+                # Deleted mid-copy: the winner's bytes are gone — fall
+                # through and store OUR copy (returning success with no
+                # stored value would surface as a spurious ObjectLost).
             self._ensure_capacity(size)
-            e = _Entry(data=data, size=size)
+            reservation = None
+            if native_eligible:
+                reservation = self._reserve_native_locked(
+                    object_id, data.flat_nbytes)
+            e = _Entry(data=None if reservation is not None else data,
+                       size=size)
+            e.sealed = reservation is None
             e.pin_count = 1 if pin else 0
-            if self._native is not None and isinstance(data, SerializedObject) \
-                    and not e.is_device:
-                handle = self._native_put(object_id, data.to_bytes())
-                if handle is not None:
-                    e.data = handle
             self._entries[object_id] = e
             self._used += size
-            self._lock.notify_all()
-            return size
+            if reservation is None:
+                self._lock.notify_all()
+                return size
+        # Bulk copy OUTSIDE the lock.
+        self._fill_reservation(object_id, e, data, reservation)
+        return size
 
-    def _native_put(self, object_id: ObjectID, blob: bytes):
-        """Native put with the create-request retry flow
+    def _fill_reservation(self, object_id: ObjectID, e: _Entry, data,
+                          reservation) -> None:
+        key = object_id.binary()
+        nbytes, offset = reservation
+        handle = None
+        if offset == _ADOPT:
+            # The key was already sealed in the segment (worker-written
+            # return re-put): adopt it, no copy.
+            handle = _NativeHandle(self._native, key, nbytes)
+        else:
+            try:
+                data.write_into(self._native.view(offset, nbytes))
+                self._native.seal(key)
+                handle = _NativeHandle(self._native, key, nbytes)
+                self.stats["native_put_bytes"] += nbytes
+                self.stats["native_puts"] += 1
+            except Exception:
+                try:
+                    self._native.delete(key)
+                except Exception:
+                    pass
+        with self._lock:
+            if self._entries.get(object_id) is not e:
+                # Deleted while mid-copy: drop the orphaned native block.
+                if handle is not None and offset != _ADOPT:
+                    handle.delete()
+                return
+            e.data = handle if handle is not None else data
+            e.sealed = True
+            self._lock.notify_all()
+
+    def _wait_sealed_locked(self, object_id: ObjectID,
+                            timeout: float = 60.0) -> None:
+        deadline = time.monotonic() + timeout
+        while True:
+            e = self._entries.get(object_id)
+            if e is None or e.sealed:
+                return
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return
+            self._lock.wait(timeout=min(remaining, 0.5))
+
+    def _reserve_native_locked(self, object_id: ObjectID, nbytes: int):
+        """Reserve a segment block with the create-request retry flow
         (create_request_queue.h parity): on OOM, ask the native LRU for
         victims, spill them through the Python IO path, and retry;
-        returns None (python-held buffers, the fallback allocation)
-        only when the segment genuinely cannot fit the object.  Must
-        hold the store lock."""
+        returns ``(nbytes, offset)``, ``(nbytes, _ADOPT)`` when the key
+        is already sealed natively, or None (python-held buffers, the
+        fallback allocation) only when the segment genuinely cannot fit
+        the object.  Must hold the store lock."""
         key = object_id.binary()
-        need = len(blob) + 128
+        need = nbytes + 128
         for attempt in range(4):   # 3 escalations + final retry
             try:
-                self._native.put(key, blob)
-                return _NativeHandle(self._native, key, len(blob))
+                off = self._native.create(key, nbytes)
+                if off is None:
+                    # Duplicate key: adopt if sealed, else give up.
+                    loc = self._native.locate(key)
+                    return (loc[1], _ADOPT) if loc is not None else None
+                return (nbytes, off)
             except MemoryError:
                 free = self._native.capacity - self._native.used_bytes()
                 # Escalating eviction: first the byte shortfall, then a
@@ -250,6 +345,50 @@ class NodeObjectStore:
                 return None
         return None
 
+    def reserve_native(self, object_id: ObjectID, nbytes: int):
+        """Public reservation surface (worker-return shm_create): runs
+        the same eviction-retry flow under the store lock; returns the
+        block offset or None."""
+        if self._native is None:
+            return None
+        with self._lock:
+            r = self._reserve_native_locked(object_id, nbytes)
+        if r is None or r[1] == _ADOPT:
+            return None
+        return r[1]
+
+    def create_transfer_writer(self, object_id: ObjectID, nbytes: int,
+                               pin: bool = False):
+        """Writer for an incoming transfer (pull path): reserves a
+        segment block the chunk pipeline assembles into directly, and on
+        seal registers the entry + wakes waiters — no intermediate
+        ``bytearray``.  Falls back to a heap buffer when no native
+        backend is attached or the segment cannot fit the object.
+
+        The store budget is enforced HERE (spilling as needed, raising
+        ObjectStoreFullError when even spilling cannot make room) and
+        the bytes stay charged to ``_transfer_reserved`` until
+        seal/abort, so N concurrent pulls cannot collectively
+        over-commit what a single put could not."""
+        with self._lock:
+            self._ensure_capacity(nbytes)
+            self._transfer_reserved += nbytes
+            r = None
+            if self._native is not None:
+                try:
+                    r = self._reserve_native_locked(object_id, nbytes)
+                except BaseException:
+                    self._transfer_reserved -= nbytes
+                    raise
+        if r is not None and r[1] != _ADOPT:
+            return _SegmentTransferWriter(self, object_id, nbytes,
+                                          r[1], pin)
+        return _HeapTransferWriter(self, object_id, nbytes, pin)
+
+    def _release_transfer_reservation(self, nbytes: int) -> None:
+        with self._lock:
+            self._transfer_reserved -= nbytes
+
     def register_native_entry(self, object_id: ObjectID, size: int):
         """Adopt an object a CLIENT created+sealed directly in the
         native segment (worker-written return): table entry wrapping
@@ -274,7 +413,10 @@ class NodeObjectStore:
     def get(self, object_id: ObjectID) -> Optional[_Entry]:
         with self._lock:
             e = self._entries.get(object_id)
-            if e is None:
+            if e is None or not e.sealed:
+                # Unsealed = a put's bulk copy is still in flight; the
+                # bytes are not readable yet (plasma: Get sees sealed
+                # objects only).
                 return None
             e.last_access = time.monotonic()
             if e.data is None and e.spilled_path is not None:
@@ -312,7 +454,12 @@ class NodeObjectStore:
             e = self._entries.pop(object_id, None)
             if e is None:
                 return
-            self._used -= e.size if e.data is not None else 0
+            # An entry holds store budget while its bytes are in memory
+            # (data set) or reserved by an in-flight put (unsealed
+            # placeholder); spilled entries released theirs at spill.
+            if e.data is not None or (not e.sealed
+                                      and e.spilled_path is None):
+                self._used -= e.size
             if isinstance(e.data, _NativeHandle):
                 # Client (worker-held) pins defer the actual free.
                 e.data.delete()
@@ -326,42 +473,50 @@ class NodeObjectStore:
     def _ensure_capacity(self, incoming: int):
         # Must hold lock.  Spill least-recently-used unpinned-or-pinned
         # entries until the incoming object fits under the threshold.
+        # In-flight transfer reservations count as used: their chunks
+        # have not landed yet but the bytes are committed.
         limit = int(self.capacity * self.spill_threshold)
-        if self._used + incoming <= limit:
+        if self._used + self._transfer_reserved + incoming <= limit:
             return
         candidates = sorted(
             ((e.last_access, oid) for oid, e in self._entries.items()
-             if e.data is not None and not e.is_device),
+             if e.data is not None and e.sealed and not e.is_device),
             key=lambda t: t[0])
         for _, oid in candidates:
-            if self._used + incoming <= limit:
+            if self._used + self._transfer_reserved + incoming <= limit:
                 break
             self._spill(oid, self._entries[oid])
-        if self._used + incoming > self.capacity:
+        if self._used + self._transfer_reserved + incoming > self.capacity:
             raise exceptions.ObjectStoreFullError(
                 f"Object of {incoming} bytes exceeds store capacity "
-                f"({self._used}/{self.capacity} used; spilling exhausted)")
+                f"({self._used}/{self.capacity} used, "
+                f"{self._transfer_reserved} reserved by in-flight "
+                f"transfers; spilling exhausted)")
 
     def _spill(self, object_id: ObjectID, e: _Entry):
         data = e.data
-        if isinstance(data, _NativeHandle):
-            # Materialize before freeing: read() is a view into the
-            # segment, invalid once the allocator reuses the block.
-            # (A client-pinned object's native free defers to its last
-            # release; the spill copy is taken regardless.)
-            blob = bytes(data.read())
-            data.delete()
-        elif isinstance(data, DeviceObject):
-            blob = data.to_serialized().to_bytes()
-        else:
-            blob = data.to_bytes()
         path = os.path.join(self.spill_dir, object_id.hex())
-        with open(path, "wb") as f:
-            f.write(blob)
+        if isinstance(data, _NativeHandle):
+            # Stream the segment view straight to disk, THEN free: the
+            # view is invalid once the allocator reuses the block.  (A
+            # client-pinned object's native free defers to its last
+            # release; the spill copy is taken regardless.)
+            view = data.read()
+            nbytes = view.nbytes
+            with open(path, "wb") as f:
+                f.write(view)
+            del view
+            data.delete()
+        else:
+            if isinstance(data, DeviceObject):
+                data = data.to_serialized()
+            nbytes = data.flat_nbytes
+            with open(path, "wb") as f:
+                f.write(data.to_bytes())
         e.spilled_path = path
         e.data = None
         self._used -= e.size
-        self.stats["spilled_bytes"] += len(blob)
+        self.stats["spilled_bytes"] += nbytes
         self.stats["spilled_objects"] += 1
 
     def _restore(self, object_id: ObjectID, e: _Entry):
@@ -377,7 +532,7 @@ class NodeObjectStore:
         n = 0
         with self._lock:
             for oid, e in list(self._entries.items()):
-                if e.data is not None and not e.is_device:
+                if e.data is not None and e.sealed and not e.is_device:
                     self._spill(oid, e)
                     n += 1
         return n
@@ -406,6 +561,11 @@ class InPlasmaMarker:
         self.total_bytes = 0
 
 
+#: Reservation sentinel: the key is already sealed in the segment —
+#: adopt the existing block instead of copying.
+_ADOPT = -1
+
+
 class _NativeHandle:
     """Handle to an object held by the native C++ shm store."""
 
@@ -424,6 +584,128 @@ class _NativeHandle:
             self.store.delete(self.key)
         except Exception:
             pass
+
+
+class _SegmentTransferWriter:
+    """Incoming-transfer sink over a reserved shm block: the chunk
+    pipeline writes each arriving chunk straight into the segment at
+    its final offset (ObjectBufferPool chunk assembly without the
+    intermediate ``bytearray``); ``seal`` publishes the entry."""
+
+    __slots__ = ("_store", "_object_id", "nbytes", "_offset", "_pin",
+                 "_view", "_reserved")
+
+    def __init__(self, store: "NodeObjectStore", object_id: ObjectID,
+                 nbytes: int, offset: int, pin: bool):
+        self._store = store
+        self._object_id = object_id
+        self.nbytes = nbytes
+        self._offset = offset
+        self._pin = pin
+        self._view = store._native.view(offset, nbytes)
+        self._reserved = True
+
+    def write(self, offset: int, data) -> None:
+        from ray_tpu._private.serialization import copy_into_view
+        copy_into_view(self._view, offset, data)
+
+    def _release(self) -> None:
+        if self._reserved:
+            self._reserved = False
+            self._store._release_transfer_reservation(self.nbytes)
+
+    def seal(self) -> None:
+        store = self._store
+        key = self._object_id.binary()
+        self._view = None
+        store._native.seal(key)
+        with store._lock:
+            if self._reserved:
+                self._reserved = False
+                store._transfer_reserved -= self.nbytes
+            existing = store._entries.get(self._object_id)
+            if existing is not None:
+                # Lost a materialization race; keep the winner unless it
+                # is (now) backed by this very block.
+                if not (isinstance(existing.data, _NativeHandle)
+                        and existing.data.key == key):
+                    store._native.delete(key)
+                return
+            e = _Entry(data=_NativeHandle(store._native, key, self.nbytes),
+                       size=self.nbytes)
+            e.pin_count = 1 if self._pin else 0
+            store._entries[self._object_id] = e
+            store._used += self.nbytes
+            store._lock.notify_all()
+
+    def abort(self) -> None:
+        self._view = None
+        self._release()
+        try:
+            self._store._native.delete(self._object_id.binary())
+        except Exception:
+            pass
+
+
+class _HeapTransferWriter:
+    """Fallback transfer sink when no native segment is available (or
+    the object exceeds it): assembles on the heap, seals via a normal
+    store put."""
+
+    __slots__ = ("_store", "_object_id", "nbytes", "_pin", "_buf",
+                 "_reserved")
+
+    def __init__(self, store: "NodeObjectStore", object_id: ObjectID,
+                 nbytes: int, pin: bool):
+        self._store = store
+        self._object_id = object_id
+        self.nbytes = nbytes
+        self._pin = pin
+        self._buf = bytearray(nbytes)
+        self._reserved = True
+
+    def write(self, offset: int, data) -> None:
+        self._buf[offset:offset + len(data)] = data
+
+    def _release(self) -> None:
+        if self._reserved:
+            self._reserved = False
+            self._store._release_transfer_reservation(self.nbytes)
+
+    def seal(self) -> None:
+        restored = SerializedObject.from_bytes(bytes(self._buf))
+        self._buf = None
+        self._release()         # put() re-charges _used itself
+        self._store.put(self._object_id, restored, pin=self._pin)
+
+    def abort(self) -> None:
+        self._buf = None
+        self._release()
+
+
+def segment_chunk_source(store: "NodeObjectStore"):
+    """``get_source`` hook for :class:`ray_tpu.rpc.chunked.ChunkServer`:
+    serve outgoing transfers straight from the store's shm segment under
+    a native pin (released when the session closes), so the SENDER never
+    flattens the object either."""
+
+    def get_source(oid_bin: bytes):
+        native = store._native if store is not None else None
+        if native is None:
+            return None
+        entry = store.get(ObjectID(oid_bin))
+        if entry is None or not isinstance(entry.data, _NativeHandle):
+            return None
+        key = entry.data.key
+        if not native.pin(key):
+            return None              # freed in the window
+        view = native.get(key)
+        if view is None:
+            native.unpin(key)
+            return None
+        return view, lambda: native.unpin(key)
+
+    return get_source
 
 
 def entry_value(entry: _Entry):

@@ -40,12 +40,13 @@ class Raylet:
         self.local_resources = NodeResources(resources, labels=labels)
         self.cluster_view = ClusterResourceView()   # local (dirty) view
         self.loop = EventLoop(f"raylet-{self.node_id.hex()[:6]}")
+        store_capacity = object_store_memory or cfg.object_store_memory
         self.object_store = NodeObjectStore(
             self.node_id,
-            object_store_memory or cfg.object_store_memory,
+            store_capacity,
             spill_dir=f"{cfg.temp_dir}/spill/{self.node_id.hex()[:8]}",
             spill_threshold=cfg.object_spilling_threshold,
-            native_backend=_maybe_native_store(cfg))
+            native_backend=_maybe_native_store(cfg, store_capacity))
         self.worker_pool = WorkerPool(self)
         self.local_task_manager = LocalTaskManager(self)
         self.cluster_task_manager = ClusterTaskManager(self)
@@ -265,14 +266,38 @@ class _WorkerIdHolder:
 _native_store_failed = False
 
 
-def _maybe_native_store(cfg):
-    """Load the native C++ shm store if built (ray_tpu/native)."""
+def _maybe_native_store(cfg, capacity_bytes: int = 0):
+    """Load the native C++ shm store if built (ray_tpu/native).
+
+    The segment is sized to the node store's capacity (clamped to the
+    free space actually available on /dev/shm): a segment smaller than
+    the store forced every large put onto the python-held fallback path
+    — and through its extra flatten copy (ENVELOPE_r05's 1.44 GB/s put).
+    tmpfs pages are allocated on first touch, so an over-provisioned
+    segment costs nothing until objects actually land in it."""
     global _native_store_failed
     if not cfg.use_native_object_store or _native_store_failed:
         return None
+    capacity = capacity_bytes or cfg.object_store_memory
     try:
         from ray_tpu.native import shm_store
-        return shm_store.open_store()
+    except Exception:
+        _native_store_failed = True
+        return None
+    try:
+        import shutil
+        # tmpfs pages are first-touch, so df-free does not reflect other
+        # open sparse segments; subtract this process's outstanding
+        # reservations and keep a 4x headroom for sibling processes —
+        # over-committed segments die with SIGBUS when filled, not with
+        # a catchable error.
+        shm_free = shutil.disk_usage("/dev/shm").free \
+            - shm_store.reserved_bytes()
+        capacity = max(64 * 1024 * 1024, min(capacity, shm_free // 4))
+    except Exception:
+        pass
+    try:
+        return shm_store.open_store(capacity=capacity)
     except Exception:
         _native_store_failed = True
         return None

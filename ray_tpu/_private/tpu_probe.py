@@ -1,0 +1,85 @@
+"""Bounded-timeout accelerator probe.
+
+A sick TPU backend hangs ``jax.devices()`` indefinitely (round-5
+evidence: ``import jax; jax.devices()`` blocked >120 s and poisoned both
+driver artifacts).  Nothing that merely needs a DECISION — "is the chip
+usable?" — may pay that risk in its own process.  This helper runs the
+backend initialization in a subprocess with a hard timeout and a couple
+of retries, and reports a structured verdict the caller can act on
+(re-exec on CPU, emit a skip row, fall back to a scaled problem).
+
+Used by ``__graft_entry__.dryrun_multichip`` and ``bench.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from typing import Optional
+
+_PROBE_CODE = (
+    "import json, sys\n"
+    "import jax\n"
+    "devs = jax.devices()\n"
+    "print(json.dumps({'backend': jax.default_backend(),"
+    " 'device_count': len(devs),"
+    " 'device_kind': getattr(devs[0], 'device_kind', '?')}))\n"
+)
+
+
+def probe_backend(timeout: float = 60.0, retries: int = 2,
+                  env: Optional[dict] = None) -> dict:
+    """Initialize the default jax backend in a subprocess, bounded.
+
+    Returns ``{"ok": True, "backend", "device_count", "device_kind",
+    "attempts"}`` on success, or ``{"ok": False, "error", "timed_out",
+    "attempts"}`` when every attempt hung or crashed.  The parent
+    process never initializes a backend here."""
+    last_error = "unknown"
+    timed_out = False
+    attempts = 0
+    for attempts in range(1, retries + 2):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", _PROBE_CODE],
+                env=dict(env) if env is not None else dict(os.environ),
+                capture_output=True, text=True, timeout=timeout)
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            last_error = f"backend init exceeded {timeout:.0f}s"
+            continue
+        if proc.returncode == 0 and proc.stdout.strip():
+            try:
+                info = json.loads(proc.stdout.strip().splitlines()[-1])
+            except (ValueError, IndexError):
+                last_error = "unparseable probe output"
+                continue
+            info.update({"ok": True, "attempts": attempts})
+            return info
+        timed_out = False
+        last_error = (proc.stderr or "probe crashed")[-500:]
+    return {"ok": False, "error": last_error, "timed_out": timed_out,
+            "attempts": attempts}
+
+
+def chip_unavailable_marker(probe: dict, **extra) -> str:
+    """One structured JSON line announcing an unusable accelerator —
+    drivers grep for ``"event": "chip_unavailable"`` instead of parsing
+    tracebacks."""
+    row = {"event": "chip_unavailable",
+           "error": probe.get("error"),
+           "timed_out": bool(probe.get("timed_out")),
+           "attempts": probe.get("attempts")}
+    row.update(extra)
+    return json.dumps(row)
+
+
+def backend_initialized_in_process() -> bool:
+    """True when THIS process already has a live jax backend — checking
+    costs nothing and triggers no initialization."""
+    if sys.modules.get("jax") is None:
+        return False
+    xb = sys.modules.get("jax._src.xla_bridge")
+    return bool(getattr(xb, "_backends", None))

@@ -35,13 +35,73 @@ from typing import Dict, Optional
 
 from ray_tpu import exceptions
 from ray_tpu._private.serialization import (
-    SerializedObject, deserialize, loads_function, serialize)
+    SerializedObject, deserialize, loads_function, serialize,
+    serialize_into)
 from ray_tpu.rpc import RpcClient, RpcServer
 
 _SHM_MISS = object()
 # Returns below this ride the reply socket (the owner memory-store
 # inline path wants them anyway); above it they go through the segment.
 _SHM_RETURN_MIN = 100 * 1024
+
+
+class _ShmReturnWriter:
+    """serialize_into writer for the write-through-shm return path
+    (plasma Create/Seal): ``reserve`` asks the host for a segment
+    block, the serializer fills it through this process's mapping (the
+    single data copy — no intermediate flattened bytes), ``commit``
+    seals it host-side.  Declines small values (they ride the reply
+    socket, which the owner memory-store inline path wants anyway) and
+    cleans up its own reservation on any failure, so a False outcome
+    simply means "use the socket fallback"."""
+
+    __slots__ = ("_runtime", "_oid_bin", "_off")
+
+    def __init__(self, runtime: "_WorkerRuntime", oid_bin: bytes):
+        self._runtime = runtime
+        self._oid_bin = oid_bin
+        self._off = None
+
+    def reserve(self, nbytes: int):
+        shm = self._runtime._shm
+        if shm is None or nbytes <= _SHM_RETURN_MIN:
+            return None
+        try:
+            off = self._runtime.node_client.call(
+                "shm_create", {"object_id": self._oid_bin,
+                               "size": nbytes}, timeout=30.0)
+        except Exception:
+            return None
+        if off is None:
+            return None
+        self._off = int(off)
+        return shm.view(self._off, nbytes)
+
+    def commit(self, _serialized, nbytes: int) -> bool:
+        try:
+            if self._runtime.node_client.call(
+                    "shm_seal", {"object_id": self._oid_bin,
+                                 "size": nbytes}, timeout=30.0):
+                return True
+        except Exception:
+            pass
+        self._abort_reservation()
+        return False
+
+    def abort(self, _exc) -> None:
+        self._abort_reservation()
+
+    def _abort_reservation(self) -> None:
+        # The write/seal failed mid-way: the reservation is invisible
+        # to eviction — abort it host-side or it leaks.
+        if self._off is None:
+            return
+        try:
+            self._runtime.node_client.call_async(
+                "shm_abort", {"object_id": self._oid_bin},
+                lambda _r, _e: None)
+        except Exception:
+            pass
 
 
 class _CtxSpec:
@@ -289,36 +349,14 @@ class _WorkerRuntime:
                 f"task returned {len(values)} values, expected {num}")
         out = []
         for oid_bin, value in zip(payload["return_ids"], values):
-            blob = serialize(value).to_bytes()
-            if self._shm is not None and len(blob) > _SHM_RETURN_MIN:
-                # Write-through-shm return (plasma Create/Seal): reserve
-                # host-side, fill via this mapping, seal registers the
-                # entry — the bytes never cross the socket.
-                off = None
-                try:
-                    off = self.node_client.call(
-                        "shm_create", {"object_id": oid_bin,
-                                       "size": len(blob)}, timeout=30.0)
-                    if off is not None:
-                        self._shm.write(int(off), blob)
-                        if self.node_client.call(
-                                "shm_seal", {"object_id": oid_bin,
-                                             "size": len(blob)},
-                                timeout=30.0):
-                            out.append((oid_bin, None))   # sealed in shm
-                            continue
-                except Exception:
-                    pass
-                if off is not None:
-                    # Write/seal failed mid-way: the reservation is
-                    # invisible to eviction — abort it or it leaks.
-                    try:
-                        self.node_client.call_async(
-                            "shm_abort", {"object_id": oid_bin},
-                            lambda _r, _e: None)
-                    except Exception:
-                        pass
-            out.append((oid_bin, blob))
+            # Single-copy return: serialize straight into the mapped
+            # segment when the host grants a reservation (sealed
+            # host-side, nothing crosses the socket); otherwise the
+            # SAME SerializedObject rides the reply socket flattened.
+            serialized, in_shm = serialize_into(
+                value, _ShmReturnWriter(self, oid_bin))
+            out.append((oid_bin, None if in_shm
+                        else serialized.to_bytes()))
         return out
 
     def _load_function(self, key: bytes):

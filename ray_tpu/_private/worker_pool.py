@@ -235,10 +235,12 @@ class WorkerHostService:
         # replies ride chunk sessions.
         from ray_tpu._private.client_service import register_client_surface
         from ray_tpu._private.worker import global_worker_or_none
+        from ray_tpu._private.object_store import segment_chunk_source
         from ray_tpu.rpc.chunked import serve_chunks
         self._chunk_server = serve_chunks(
             self.server,
-            lambda oid_bin: self._get_object(oid_bin))
+            lambda oid_bin: self._get_object(oid_bin),
+            get_source=segment_chunk_source(node.object_store))
 
         def _namespace():
             w = global_worker_or_none()
@@ -369,12 +371,15 @@ class WorkerHostService:
 
     def _shm_create(self, payload):
         """Reserve space for a worker-written return value; the worker
-        fills the bytes through its own mapping, then shm_seal."""
-        _store, native = self._native_store()
+        fills the bytes through its own mapping, then shm_seal.  Runs
+        the store's eviction-retry reservation (create_request_queue.h
+        flow), so a full segment spills LRU victims instead of kicking
+        the return onto the socket path."""
+        store, native = self._native_store()
         if native is None:
             return None
-        off = native.create(payload["object_id"], int(payload["size"]))
-        return off
+        return store.reserve_native(ObjectID(payload["object_id"]),
+                                    int(payload["size"]))
 
     def _shm_seal(self, payload):
         """Seal a worker-written object and register it in the node
@@ -422,7 +427,8 @@ class WorkerHostService:
                 object_id)
 
     def release_worker_pins(self, worker_id_hex: str):
-        """Drop the put-object pins a (now dead) worker accumulated."""
+        """Drop the put-object pins a (cleanly exited) worker
+        accumulated."""
         with self._lock:
             oids = self._worker_pins.pop(worker_id_hex, [])
         core = self._node.core_worker
@@ -431,6 +437,24 @@ class WorkerHostService:
         for oid in oids:
             try:
                 core.reference_counter.remove_local_ref(oid)
+            except Exception:
+                pass
+
+    def fail_worker_owned_objects(self, worker_id_hex: str):
+        """Owner-death semantics for a CRASHED worker process: objects
+        it put are invalidated with OwnerDiedError so borrowers holding
+        the refs observe the death instead of ObjectLost-after-timeout
+        (reference: reference_count.cc OWNER_DIED; clean exits release
+        pins normally via :meth:`release_worker_pins`)."""
+        from ray_tpu import exceptions as exc
+        with self._lock:
+            oids = self._worker_pins.pop(worker_id_hex, [])
+        core = self._node.core_worker
+        if core is None:
+            return
+        for oid in oids:
+            try:
+                core.fail_owned_object(oid, exc.OwnerDiedError(oid))
             except Exception:
                 pass
 
@@ -456,6 +480,7 @@ class ProcessWorker:
         self.actor_instance = None      # lives in the child process
         self._max_concurrency = 1
         self._killed = threading.Event()
+        self._died_abnormally = False   # crash vs clean stop/cull
         self._queue: "queue.Queue" = queue.Queue()
         self._client = None
         host = pool.host_service()
@@ -541,6 +566,7 @@ class ProcessWorker:
         port = self._pool.host_service().wait_for_worker(
             self.worker_id.hex(), timeout=120.0)
         if port is None:
+            self._died_abnormally = True
             self._fail_until_exit("worker process failed to register")
             return
         self._client = RpcClient(("127.0.0.1", port))
@@ -548,6 +574,18 @@ class ProcessWorker:
             try:
                 kind, spec, on_done = self._queue.get(timeout=1.0)
             except queue.Empty:
+                # Liveness sweep between pushes: a child that died while
+                # idle (crash, OOM-kill) must trigger owner-death
+                # handling promptly, not on the next task push.  Drain
+                # anything enqueued in the detection window first — an
+                # abandoned spec's on_done would otherwise never fire
+                # and the submitter's get would hang.
+                if self._proc.poll() is not None:
+                    self._died_abnormally = True
+                    self._killed.set()
+                    self._drain_queue_failing(
+                        "worker process died while idle")
+                    break
                 continue
             if kind == "exit":
                 break
@@ -586,6 +624,7 @@ class ProcessWorker:
         except Exception as e:
             on_done(exceptions.RayTpuError(
                 f"worker process died: {e}"))
+            self._died_abnormally = True
             self._killed.set()
             return
         self._handle_reply(reply, spec, on_done, kind)
@@ -594,6 +633,7 @@ class ProcessWorker:
         err = fut.exception()
         if err is not None:
             on_done(exceptions.RayTpuError(f"worker process died: {err}"))
+            self._died_abnormally = True
             self._killed.set()
             return
         self._handle_reply(fut.result(), spec, on_done, kind)
@@ -672,6 +712,16 @@ class ProcessWorker:
             core.put_serialized_return(
                 oid, SerializedObject.from_bytes(blob), self.node)
 
+    def _drain_queue_failing(self, reason: str):
+        """Fail every spec currently queued (non-blocking drain)."""
+        while True:
+            try:
+                kind, _spec, on_done = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if kind != "exit" and on_done is not None:
+                on_done(exceptions.RayTpuError(reason))
+
     def _fail_until_exit(self, reason: str):
         while not self._killed.is_set():
             try:
@@ -690,7 +740,13 @@ class ProcessWorker:
         host = self._pool._host_service
         if host is not None:
             try:
-                host.release_worker_pins(self.worker_id.hex())
+                if self._died_abnormally:
+                    # Crash: the worker OWNED its put objects — seal
+                    # OwnerDiedError for borrowers (reference:
+                    # OWNER_DIED), then drop whatever it still pinned.
+                    host.fail_worker_owned_objects(self.worker_id.hex())
+                else:
+                    host.release_worker_pins(self.worker_id.hex())
                 host.release_worker_shm_pins(self.worker_id.hex())
             except Exception:
                 pass

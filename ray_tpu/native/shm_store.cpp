@@ -3,12 +3,18 @@
 // TPU-native equivalent of the reference's Plasma store
 // (src/ray/object_manager/plasma/: dlmalloc over mmap'd shm, object table,
 // create/seal lifecycle, eviction hooks).  Differences by design:
-//   * one flat shm segment with a first-fit free-list allocator
-//     (coalescing on free) instead of vendored dlmalloc;
-//   * the object table lives in process memory (the store is owned by the
-//     node daemon); process-mode worker clients mmap the same segment and
-//     receive (offset, size) handles over their RPC channel — zero-copy
-//     reads/writes, the plasma client model (plasma/client.cc);
+//   * one flat shm segment with a two-tier allocator: size-class bins
+//     (segregated free lists, jemalloc/dlmalloc smallbin spirit) for
+//     small/medium blocks and an offset-ordered coalescing free map for
+//     large ones — instead of vendored dlmalloc;
+//   * the object table is SHARDED: hash(key) picks one of kShards
+//     independently-locked maps, so concurrent workers putting returns
+//     do not serialize on a single store mutex (the seed store's global
+//     lock was the write-path bottleneck);
+//   * bulk copies happen OUTSIDE any lock: Put allocates (allocator
+//     lock), memcpys into the segment with no lock held, then publishes
+//     the entry (shard lock).  Create/Seal expose the same lifecycle to
+//     clients writing through their own mappings (plasma/client.cc);
 //   * LRU eviction policy (pin counts, victim selection,
 //     delete-while-pinned deferred free) is native
 //     (eviction_policy.h parity); the spill IO callback stays in the
@@ -20,10 +26,13 @@
 #include <cstring>
 #include <stdexcept>
 #include <fcntl.h>
+#include <array>
+#include <atomic>
 #include <map>
 #include <mutex>
 #include <string>
 #include <sys/mman.h>
+#include <time.h>
 #include <sys/stat.h>
 #include <unistd.h>
 #include <unordered_map>
@@ -32,6 +41,12 @@
 
 namespace {
 
+constexpr int kShards = 16;          // object-table stripes
+constexpr uint64_t kAlign = 64;      // block alignment
+constexpr uint64_t kBinMax = 1 << 20;  // blocks above 1 MiB skip the bins
+constexpr uint64_t kLinearMax = 4096;  // 64 B linear classes up to here
+constexpr size_t kBinCap = 64;       // max cached blocks per bin
+
 struct Block {
   uint64_t offset;
   uint64_t size;
@@ -39,191 +54,117 @@ struct Block {
 
 struct ObjectEntry {
   uint64_t offset;
-  uint64_t size;
+  uint64_t size;        // payload size
+  uint64_t alloc_size;  // rounded block size actually reserved
   bool sealed;
   uint32_t pin_count;
-  uint64_t lru_tick;  // global counter value at last touch
-  bool deleted;       // delete-while-pinned: freed on last unpin
+  uint64_t lru_tick;    // global counter value at last touch
+  bool deleted;         // delete-while-pinned: freed on last unpin
+  uint64_t created_ms;  // monotonic ms at creation (stale-reclaim gate)
 };
 
-class ShmStore {
+inline uint64_t NowMs() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<uint64_t>(ts.tv_sec) * 1000 +
+         static_cast<uint64_t>(ts.tv_nsec) / 1000000;
+}
+
+// An unsealed, unpinned entry is reclaimable only once it is OLDER
+// than any plausible live write window: every host put, transfer
+// writer and worker create/seal leaves its entry unsealed while the
+// bulk copy runs, and reclaiming a LIVE reservation would free a block
+// another writer is actively filling (segment corruption).  Stale ones
+// (crashed client, abort lost) must still be reclaimed or the key is
+// poisoned forever.
+constexpr uint64_t kStaleReservationMs = 60 * 1000;
+
+inline uint64_t AlignUp(uint64_t n) {
+  return (n + kAlign - 1) & ~(kAlign - 1);
+}
+
+// Size-class rounding: 64 B linear steps up to 4 KiB, then four classes
+// per power-of-two doubling (quarter-pow2, <= 25% internal
+// fragmentation) up to kBinMax.  Returns the rounded block size.
+inline uint64_t ClassSize(uint64_t n) {
+  if (n <= kLinearMax) return AlignUp(n ? n : 1);
+  // n in (p, 2p] for the largest power of two p < n.
+  uint64_t p = 1ull << (63 - __builtin_clzll(n - 1));
+  uint64_t step = p / 4;
+  if (step < kAlign) step = kAlign;
+  return ((n + step - 1) / step) * step;
+}
+
+// Reservation size for a payload: size-class rounded while the block
+// can live in a bin, plain 64B alignment above kBinMax (class rounding
+// there would waste up to 25% exactly where capacity pressure is
+// highest, and those blocks never hit the bins anyway).
+inline uint64_t ReserveSize(uint64_t n) {
+  if (n == 0) n = 1;
+  return n <= kBinMax ? ClassSize(n) : AlignUp(n);
+}
+
+// Dense bin index for a CLASS size (result of ClassSize <= kBinMax).
+inline int BinIndex(uint64_t cls) {
+  if (cls <= kLinearMax) return static_cast<int>(cls / kAlign) - 1;  // 0..63
+  int base = static_cast<int>(kLinearMax / kAlign) - 1;  // last linear bin
+  uint64_t p = 1ull << (63 - __builtin_clzll(cls - 1));
+  uint64_t step = p / 4;
+  int doubling = static_cast<int>(63 - __builtin_clzll(p)) - 12;  // p=4096 -> 0
+  int within = static_cast<int>(cls / step) - 5;  // cls/step in {5,6,7,8}
+  return base + 1 + doubling * 4 + within;
+}
+
+constexpr int kBinCount = 64 + 4 * 9 + 4;  // linear + doublings 4K..1M + slack
+
+// Two-tier segment allocator.  Fast path: exact-class reuse from a bin
+// (O(1), short critical section).  Slow path: first-fit over the
+// offset-ordered coalescing map; bins are flushed into it (coalescing
+// then) before reporting OOM, so binning never causes a spurious OOM.
+class Allocator {
  public:
-  ShmStore(const char* name, uint64_t capacity)
-      : name_(name), capacity_(capacity) {
-    fd_ = shm_open(name, O_CREAT | O_RDWR, 0600);
-    if (fd_ < 0) throw std::runtime_error("shm_open failed");
-    if (ftruncate(fd_, static_cast<off_t>(capacity)) != 0) {
-      close(fd_);
-      throw std::runtime_error("ftruncate failed");
-    }
-    base_ = static_cast<uint8_t*>(mmap(nullptr, capacity,
-                                       PROT_READ | PROT_WRITE, MAP_SHARED,
-                                       fd_, 0));
-    if (base_ == MAP_FAILED) {
-      close(fd_);
-      throw std::runtime_error("mmap failed");
-    }
-    // One free block spanning the whole segment.
-    free_by_offset_[0] = capacity;
-  }
+  explicit Allocator(uint64_t capacity) { free_by_offset_[0] = capacity; }
 
-  ~ShmStore() {
-    munmap(base_, capacity_);
-    close(fd_);
-    shm_unlink(name_.c_str());
-  }
-
-  // Returns offset, -1 on OOM, -2 if already present, -3 if the key is
-  // in deleted-pending state (freed on last unpin; not re-usable yet).
-  int64_t Put(const std::string& key, const uint8_t* data, uint64_t size) {
+  // size must already be a ClassSize/AlignUp result.
+  int64_t Allocate(uint64_t size) {
     std::lock_guard<std::mutex> g(mu_);
-    auto it = objects_.find(key);
-    if (it != objects_.end()) {
-      if (it->second.deleted) return -3;
-      if (!it->second.sealed && it->second.pin_count == 0) {
-        // Stale create-reservation (client write/seal failed): the
-        // bytes were never valid — reclaim and write fresh.
-        EraseLocked(it);
-      } else {
-        return -2;
+    if (size <= kBinMax) {
+      auto& bin = bins_[BinIndex(size)];
+      if (!bin.empty()) {
+        uint64_t off = bin.back().offset;
+        bin.pop_back();
+        binned_bytes_ -= size;
+        return static_cast<int64_t>(off);
       }
     }
-    int64_t off = Allocate(Align(size));
-    if (off < 0) return -1;
-    std::memcpy(base_ + off, data, size);
-    objects_[key] =
-        ObjectEntry{static_cast<uint64_t>(off), size, true, 0, ++tick_,
-                    false};
-    used_ += Align(size);
-    return off;
+    int64_t off = FirstFitLocked(size);
+    if (off >= 0) return off;
+    FlushBinsLocked();
+    return FirstFitLocked(size);
   }
 
-  // Create without copying (caller writes through the mapped segment,
-  // then seals) — the plasma create/seal lifecycle.
-  int64_t Create(const std::string& key, uint64_t size) {
+  void Free(uint64_t offset, uint64_t size) {
     std::lock_guard<std::mutex> g(mu_);
-    auto eit = objects_.find(key);
-    if (eit != objects_.end()) return eit->second.deleted ? -3 : -2;
-    int64_t off = Allocate(Align(size));
-    if (off < 0) return -1;
-    objects_[key] =
-        ObjectEntry{static_cast<uint64_t>(off), size, false, 0, ++tick_,
-                    false};
-    used_ += Align(size);
-    return off;
-  }
-
-  int Seal(const std::string& key) {
-    std::lock_guard<std::mutex> g(mu_);
-    auto it = objects_.find(key);
-    if (it == objects_.end()) return -1;
-    it->second.sealed = true;
-    return 0;
-  }
-
-  // Returns (offset, size) through out params; -1 if missing/unsealed.
-  // Touches the LRU clock (eviction_policy.h parity: reads refresh).
-  int Get(const std::string& key, uint64_t* offset, uint64_t* size) {
-    std::lock_guard<std::mutex> g(mu_);
-    auto it = objects_.find(key);
-    if (it == objects_.end() || !it->second.sealed ||
-        it->second.deleted) {
-      return -1;
-    }
-    it->second.lru_tick = ++tick_;
-    *offset = it->second.offset;
-    *size = it->second.size;
-    return 0;
-  }
-
-  int Pin(const std::string& key) {
-    std::lock_guard<std::mutex> g(mu_);
-    auto it = objects_.find(key);
-    if (it == objects_.end() || it->second.deleted) return -1;
-    it->second.pin_count++;
-    return 0;
-  }
-
-  int Unpin(const std::string& key) {
-    std::lock_guard<std::mutex> g(mu_);
-    auto it = objects_.find(key);
-    if (it == objects_.end() || it->second.pin_count == 0) return -1;
-    it->second.pin_count--;
-    if (it->second.pin_count == 0 && it->second.deleted) {
-      EraseLocked(it);
-    }
-    return 0;
-  }
-
-  // LRU victim selection (eviction_policy.h ChooseObjectsToEvict
-  // parity): pick least-recently-touched sealed+unpinned objects until
-  // >= needed bytes are covered (best effort — fewer bytes when little
-  // is evictable; the caller inspects covered_out).  Writes
-  // [u32 len][key bytes]* into out; returns #victims, or -2 if the
-  // out buffer is too small.
-  int ChooseVictims(uint64_t needed, uint8_t* out, uint32_t out_cap,
-                    uint64_t* covered_out) {
-    std::lock_guard<std::mutex> g(mu_);
-    std::vector<std::pair<uint64_t, const std::string*>> cand;
-    for (auto& kv : objects_) {
-      if (kv.second.sealed && kv.second.pin_count == 0 &&
-          !kv.second.deleted) {
-        cand.emplace_back(kv.second.lru_tick, &kv.first);
+    if (size <= kBinMax) {
+      auto& bin = bins_[BinIndex(size)];
+      if (bin.size() < kBinCap) {
+        bin.push_back(Block{offset, size});
+        binned_bytes_ += size;
+        return;
       }
     }
-    std::sort(cand.begin(), cand.end());
-    uint64_t covered = 0;
-    uint32_t pos = 0;
-    int n = 0;
-    for (auto& c : cand) {
-      if (covered >= needed) break;
-      const std::string& k = *c.second;
-      if (pos + 4 + k.size() > out_cap) return -2;
-      uint32_t len = static_cast<uint32_t>(k.size());
-      std::memcpy(out + pos, &len, 4);
-      std::memcpy(out + pos + 4, k.data(), k.size());
-      pos += 4 + len;
-      covered += Align(objects_[k].size);
-      n++;
-    }
-    *covered_out = covered;
-    return n;
+    CoalesceLocked(offset, size);
   }
 
-  int Delete(const std::string& key) {
+  // Largest allocation the segment could currently satisfy after
+  // coalescing everything (diagnostic for the eviction escalation).
+  void FlushBins() {
     std::lock_guard<std::mutex> g(mu_);
-    auto it = objects_.find(key);
-    if (it == objects_.end()) return -1;
-    if (it->second.pin_count > 0) {
-      // Deferred free (plasma release semantics): a client still reads
-      // through its mapping; hide the object and free on last unpin.
-      it->second.deleted = true;
-      return 0;
-    }
-    EraseLocked(it);
-    return 0;
+    FlushBinsLocked();
   }
-
-  uint64_t Used() const { return used_; }
-  uint64_t Capacity() const { return capacity_; }
-  uint64_t NumObjects() {
-    std::lock_guard<std::mutex> g(mu_);
-    return objects_.size();
-  }
-  uint8_t* Base() const { return base_; }
-  int Fd() const { return fd_; }
 
  private:
-  static uint64_t Align(uint64_t n) { return (n + 63) & ~uint64_t(63); }
-
-  void EraseLocked(std::unordered_map<std::string, ObjectEntry>::iterator it) {
-    Free(it->second.offset, Align(it->second.size));
-    used_ -= Align(it->second.size);
-    objects_.erase(it);
-  }
-
-  // First-fit over the offset-ordered free map; splits the block.
-  int64_t Allocate(uint64_t size) {
+  int64_t FirstFitLocked(uint64_t size) {
     for (auto it = free_by_offset_.begin(); it != free_by_offset_.end();
          ++it) {
       if (it->second >= size) {
@@ -237,15 +178,21 @@ class ShmStore {
     return -1;
   }
 
-  // Free with coalescing of adjacent blocks.
-  void Free(uint64_t offset, uint64_t size) {
+  void FlushBinsLocked() {
+    for (auto& bin : bins_) {
+      for (const Block& b : bin) CoalesceLocked(b.offset, b.size);
+      bin.clear();
+    }
+    binned_bytes_ = 0;
+  }
+
+  // Insert with coalescing of adjacent blocks.
+  void CoalesceLocked(uint64_t offset, uint64_t size) {
     auto next = free_by_offset_.lower_bound(offset);
-    // Merge with next block if adjacent.
     if (next != free_by_offset_.end() && offset + size == next->first) {
       size += next->second;
       next = free_by_offset_.erase(next);
     }
-    // Merge with previous block if adjacent.
     if (next != free_by_offset_.begin()) {
       auto prev = std::prev(next);
       if (prev->first + prev->second == offset) {
@@ -256,15 +203,254 @@ class ShmStore {
     free_by_offset_[offset] = size;
   }
 
+  std::mutex mu_;
+  std::map<uint64_t, uint64_t> free_by_offset_;  // offset -> size
+  std::array<std::vector<Block>, kBinCount> bins_;
+  uint64_t binned_bytes_ = 0;
+};
+
+class ShmStore {
+ public:
+  ShmStore(const char* name, uint64_t capacity)
+      : name_(name), capacity_(capacity), alloc_(capacity) {
+    fd_ = shm_open(name, O_CREAT | O_RDWR, 0600);
+    if (fd_ < 0) throw std::runtime_error("shm_open failed");
+    if (ftruncate(fd_, static_cast<off_t>(capacity)) != 0) {
+      close(fd_);
+      throw std::runtime_error("ftruncate failed");
+    }
+    base_ = static_cast<uint8_t*>(mmap(nullptr, capacity,
+                                       PROT_READ | PROT_WRITE, MAP_SHARED,
+                                       fd_, 0));
+    if (base_ == MAP_FAILED) {
+      close(fd_);
+      throw std::runtime_error("mmap failed");
+    }
+  }
+
+  ~ShmStore() {
+    munmap(base_, capacity_);
+    close(fd_);
+    shm_unlink(name_.c_str());
+  }
+
+  // Returns offset, -1 on OOM, -2 if already present, -3 if the key is
+  // in deleted-pending state (freed on last unpin; not re-usable yet).
+  // The memcpy runs with NO lock held: the block is private until the
+  // entry is published into its shard.
+  int64_t Put(const std::string& key, const uint8_t* data, uint64_t size) {
+    Shard& sh = ShardFor(key);
+    {
+      std::lock_guard<std::mutex> g(sh.mu);
+      auto it = sh.objects.find(key);
+      if (it != sh.objects.end()) {
+        if (it->second.deleted) return -3;
+        if (!it->second.sealed && it->second.pin_count == 0 &&
+            NowMs() - it->second.created_ms > kStaleReservationMs) {
+          // Stale create-reservation (client write/seal failed long
+          // ago): the bytes were never valid — reclaim, write fresh.
+          EraseLocked(sh, it);
+        } else {
+          return -2;
+        }
+      }
+    }
+    uint64_t cls = ReserveSize(size);
+    int64_t off = alloc_.Allocate(cls);
+    if (off < 0) return -1;
+    std::memcpy(base_ + off, data, size);
+    std::lock_guard<std::mutex> g(sh.mu);
+    auto it = sh.objects.find(key);
+    if (it != sh.objects.end()) {
+      // Lost a publish race (concurrent put of the same key): keep the
+      // winner, drop our private block.
+      alloc_.Free(static_cast<uint64_t>(off), cls);
+      return it->second.deleted ? -3 : -2;
+    }
+    sh.objects[key] = ObjectEntry{
+        static_cast<uint64_t>(off), size, cls, true, 0,
+        tick_.fetch_add(1, std::memory_order_relaxed) + 1, false,
+        NowMs()};
+    used_.fetch_add(cls, std::memory_order_relaxed);
+    num_objects_.fetch_add(1, std::memory_order_relaxed);
+    return off;
+  }
+
+  // Create without copying (caller writes through the mapped segment,
+  // then seals) — the plasma create/seal lifecycle.
+  int64_t Create(const std::string& key, uint64_t size) {
+    Shard& sh = ShardFor(key);
+    {
+      std::lock_guard<std::mutex> g(sh.mu);
+      auto it = sh.objects.find(key);
+      if (it != sh.objects.end()) {
+        if (it->second.deleted) return -3;
+        if (!it->second.sealed && it->second.pin_count == 0 &&
+            NowMs() - it->second.created_ms > kStaleReservationMs) {
+          EraseLocked(sh, it);  // stale (aged-out) reservation: reclaim
+        } else {
+          return -2;
+        }
+      }
+    }
+    uint64_t cls = ReserveSize(size);
+    int64_t off = alloc_.Allocate(cls);
+    if (off < 0) return -1;
+    std::lock_guard<std::mutex> g(sh.mu);
+    auto it = sh.objects.find(key);
+    if (it != sh.objects.end()) {
+      alloc_.Free(static_cast<uint64_t>(off), cls);
+      return it->second.deleted ? -3 : -2;
+    }
+    sh.objects[key] = ObjectEntry{
+        static_cast<uint64_t>(off), size, cls, false, 0,
+        tick_.fetch_add(1, std::memory_order_relaxed) + 1, false,
+        NowMs()};
+    used_.fetch_add(cls, std::memory_order_relaxed);
+    num_objects_.fetch_add(1, std::memory_order_relaxed);
+    return off;
+  }
+
+  int Seal(const std::string& key) {
+    Shard& sh = ShardFor(key);
+    std::lock_guard<std::mutex> g(sh.mu);
+    auto it = sh.objects.find(key);
+    if (it == sh.objects.end()) return -1;
+    it->second.sealed = true;
+    return 0;
+  }
+
+  // Returns (offset, size) through out params; -1 if missing/unsealed.
+  // Touches the LRU clock (eviction_policy.h parity: reads refresh).
+  int Get(const std::string& key, uint64_t* offset, uint64_t* size) {
+    Shard& sh = ShardFor(key);
+    std::lock_guard<std::mutex> g(sh.mu);
+    auto it = sh.objects.find(key);
+    if (it == sh.objects.end() || !it->second.sealed ||
+        it->second.deleted) {
+      return -1;
+    }
+    it->second.lru_tick = tick_.fetch_add(1, std::memory_order_relaxed) + 1;
+    *offset = it->second.offset;
+    *size = it->second.size;
+    return 0;
+  }
+
+  int Pin(const std::string& key) {
+    Shard& sh = ShardFor(key);
+    std::lock_guard<std::mutex> g(sh.mu);
+    auto it = sh.objects.find(key);
+    if (it == sh.objects.end() || it->second.deleted) return -1;
+    it->second.pin_count++;
+    return 0;
+  }
+
+  int Unpin(const std::string& key) {
+    Shard& sh = ShardFor(key);
+    std::lock_guard<std::mutex> g(sh.mu);
+    auto it = sh.objects.find(key);
+    if (it == sh.objects.end() || it->second.pin_count == 0) return -1;
+    it->second.pin_count--;
+    if (it->second.pin_count == 0 && it->second.deleted) {
+      EraseLocked(sh, it);
+    }
+    return 0;
+  }
+
+  // LRU victim selection (eviction_policy.h ChooseObjectsToEvict
+  // parity): pick least-recently-touched sealed+unpinned objects until
+  // >= needed bytes are covered (best effort — fewer bytes when little
+  // is evictable; the caller inspects covered_out).  Writes
+  // [u32 len][key bytes]* into out; returns #victims, or -2 if the
+  // out buffer is too small.  Candidates are gathered shard by shard
+  // (each under its own lock), then merged by LRU tick.
+  int ChooseVictims(uint64_t needed, uint8_t* out, uint32_t out_cap,
+                    uint64_t* covered_out) {
+    struct Cand {
+      uint64_t tick;
+      uint64_t bytes;
+      std::string key;
+    };
+    std::vector<Cand> cand;
+    for (Shard& sh : shards_) {
+      std::lock_guard<std::mutex> g(sh.mu);
+      for (auto& kv : sh.objects) {
+        if (kv.second.sealed && kv.second.pin_count == 0 &&
+            !kv.second.deleted) {
+          cand.push_back(
+              Cand{kv.second.lru_tick, kv.second.alloc_size, kv.first});
+        }
+      }
+    }
+    std::sort(cand.begin(), cand.end(),
+              [](const Cand& a, const Cand& b) { return a.tick < b.tick; });
+    uint64_t covered = 0;
+    uint32_t pos = 0;
+    int n = 0;
+    for (auto& c : cand) {
+      if (covered >= needed) break;
+      if (pos + 4 + c.key.size() > out_cap) return -2;
+      uint32_t len = static_cast<uint32_t>(c.key.size());
+      std::memcpy(out + pos, &len, 4);
+      std::memcpy(out + pos + 4, c.key.data(), c.key.size());
+      pos += 4 + len;
+      covered += c.bytes;
+      n++;
+    }
+    *covered_out = covered;
+    return n;
+  }
+
+  int Delete(const std::string& key) {
+    Shard& sh = ShardFor(key);
+    std::lock_guard<std::mutex> g(sh.mu);
+    auto it = sh.objects.find(key);
+    if (it == sh.objects.end()) return -1;
+    if (it->second.pin_count > 0) {
+      // Deferred free (plasma release semantics): a client still reads
+      // through its mapping; hide the object and free on last unpin.
+      it->second.deleted = true;
+      return 0;
+    }
+    EraseLocked(sh, it);
+    return 0;
+  }
+
+  uint64_t Used() const { return used_.load(std::memory_order_relaxed); }
+  uint64_t Capacity() const { return capacity_; }
+  uint64_t NumObjects() const {
+    return num_objects_.load(std::memory_order_relaxed);
+  }
+  uint8_t* Base() const { return base_; }
+  int Fd() const { return fd_; }
+
+ private:
+  struct Shard {
+    std::mutex mu;
+    std::unordered_map<std::string, ObjectEntry> objects;
+  };
+
+  Shard& ShardFor(const std::string& key) {
+    return shards_[std::hash<std::string>{}(key) % kShards];
+  }
+
+  void EraseLocked(Shard& sh,
+                   std::unordered_map<std::string, ObjectEntry>::iterator it) {
+    alloc_.Free(it->second.offset, it->second.alloc_size);
+    used_.fetch_sub(it->second.alloc_size, std::memory_order_relaxed);
+    num_objects_.fetch_sub(1, std::memory_order_relaxed);
+    sh.objects.erase(it);
+  }
+
   std::string name_;
   uint64_t capacity_;
   int fd_;
   uint8_t* base_;
-  std::mutex mu_;
-  std::unordered_map<std::string, ObjectEntry> objects_;
-  std::map<uint64_t, uint64_t> free_by_offset_;  // offset -> size
-  uint64_t used_ = 0;
-  uint64_t tick_ = 0;  // LRU clock
+  Allocator alloc_;
+  std::array<Shard, kShards> shards_;
+  std::atomic<uint64_t> used_{0};
+  std::atomic<uint64_t> num_objects_{0};
+  std::atomic<uint64_t> tick_{0};  // LRU clock
 };
 
 std::string MakeKey(const uint8_t* key, uint32_t keylen) {

@@ -21,6 +21,27 @@ _BUILD_LOCK = threading.Lock()
 _SRC = os.path.join(os.path.dirname(__file__), "shm_store.cpp")
 _SO = os.path.join(os.path.dirname(__file__), "_build", "libshm_store.so")
 
+# tmpfs pages are first-touch, so `df /dev/shm` does not reflect open
+# (sparse) segments — a sizing decision based on free space alone
+# over-commits, and filling over-committed segments later dies with
+# SIGBUS, not a catchable error.  Track this process's outstanding
+# segment capacity so sizing (raylet._maybe_native_store) can subtract
+# its own reservations.
+_RESERVED_LOCK = threading.Lock()
+_RESERVED_BYTES = 0
+
+
+def reserved_bytes() -> int:
+    """Total capacity of segments currently open in THIS process."""
+    with _RESERVED_LOCK:
+        return _RESERVED_BYTES
+
+
+def _reserve(delta: int) -> None:
+    global _RESERVED_BYTES
+    with _RESERVED_LOCK:
+        _RESERVED_BYTES += delta
+
 
 def _build() -> str:
     with _BUILD_LOCK:
@@ -94,6 +115,7 @@ class NativeShmStore:
             os.close(fd)
         self.capacity = capacity
         self._closed = False
+        _reserve(capacity)
 
     def put(self, key: bytes, data: bytes) -> None:
         rc = self._lib.store_put(self._handle, key, len(key), data,
@@ -119,11 +141,20 @@ class NativeShmStore:
     def delete(self, key: bytes) -> bool:
         return self._lib.store_delete(self._handle, key, len(key)) == 0
 
+    def view(self, offset: int, size: int) -> memoryview:
+        """Writable view over a reserved block — the create/seal write
+        surface for the owning process (clients use AttachedSegment)."""
+        return memoryview(self._mm)[offset:offset + size]
+
     # ---- plasma create/seal lifecycle (client writes through shm) -----
     def create(self, key: bytes, size: int) -> Optional[int]:
         """Reserve `size` bytes; returns the offset the writer fills
-        through its own mapping, or None on OOM/duplicate."""
+        through its own mapping, or None on duplicate/deleted-pending.
+        Raises MemoryError when the segment cannot fit the block (the
+        caller runs the eviction-retry flow, create_request_queue.h)."""
         off = self._lib.store_create(self._handle, key, len(key), size)
+        if off == -1:
+            raise MemoryError("native store full")
         return None if off < 0 else int(off)
 
     def seal(self, key: bytes) -> bool:
@@ -174,6 +205,7 @@ class NativeShmStore:
     def close(self):
         if not self._closed:
             self._closed = True
+            _reserve(-self.capacity)
             try:
                 self._mm.close()
             except BufferError:
@@ -219,6 +251,11 @@ class AttachedSegment:
 
     def write(self, offset: int, data) -> None:
         self._rw[offset:offset + len(data)] = data
+
+    def view(self, offset: int, size: int) -> memoryview:
+        """Writable view over a create-reservation: the worker's
+        single-copy return path serializes straight into this."""
+        return memoryview(self._rw)[offset:offset + size]
 
     def close(self):
         for mm in (self._ro, self._rw):

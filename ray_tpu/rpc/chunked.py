@@ -31,59 +31,119 @@ from typing import Callable, Dict, Optional
 
 from ray_tpu._private.config import get_config
 
+# One process-wide TTL sweeper over every ChunkServer that has admitted
+# a PINNED-view session: a receiver that dies without fetch_close would
+# otherwise leak its native pin forever (the deferred-free path never
+# fires, the block becomes unevictable) — expiry cannot rely on further
+# handler traffic arriving.  WeakSet so the sweeper retains nothing;
+# one daemon thread for the whole process, however many servers and
+# cluster lifecycles come and go.
+_sweep_lock = threading.Lock()
+_sweep_servers = None   # weakref.WeakSet, created with the thread
+
+
+def _register_for_sweep(server: "ChunkServer") -> None:
+    global _sweep_servers
+    import weakref
+    with _sweep_lock:
+        if _sweep_servers is None:
+            _sweep_servers = weakref.WeakSet()
+
+            def sweep():
+                while True:
+                    time.sleep(ChunkServer.SESSION_TTL_S / 4.0)
+                    with _sweep_lock:
+                        servers = list(_sweep_servers)
+                    for s in servers:
+                        with s._lock:
+                            s._expire_locked()
+
+            threading.Thread(target=sweep, daemon=True,
+                             name="ray_tpu::chunk-session-sweeper"
+                             ).start()
+        _sweep_servers.add(server)
+
 
 class _Session:
-    __slots__ = ("blob", "created", "last_access")
+    __slots__ = ("blob", "created", "last_access", "release")
 
-    def __init__(self, blob: bytes):
-        self.blob = blob
+    def __init__(self, blob, release=None):
+        self.blob = blob              # bytes OR a pinned memoryview
         self.created = time.monotonic()
         self.last_access = self.created
+        self.release = release        # unpin callback for view sessions
+
+    def close(self):
+        release, self.release, self.blob = self.release, None, b""
+        if release is not None:
+            try:
+                release()
+            except Exception:
+                pass
 
 
 class ChunkServer:
-    """Sender side: sessions over serialized blobs with admission
-    control (PushManager parity)."""
+    """Sender side: sessions over serialized payloads with admission
+    control (PushManager parity).
+
+    A session's payload is either a materialized ``bytes`` blob or —
+    via the ``get_source`` hook — a memoryview pinned straight into the
+    sender's shm segment, so serving a transfer never flattens the
+    object (the sender half of the zero-copy data plane; the pin is
+    released on close/expiry)."""
 
     SESSION_TTL_S = 120.0
 
     def __init__(self, get_blob: Callable[[bytes], Optional[bytes]],
-                 max_sessions: int = 8):
+                 max_sessions: int = 8, get_source=None):
         self._get_blob = get_blob
+        self._get_source = get_source   # key -> (buf, release)|None
         self._max_sessions = max_sessions
         self._lock = threading.Lock()
         self._sessions: Dict[str, _Session] = {}
 
     # ---- handlers ------------------------------------------------------
     def handle_meta(self, payload):
-        blob = self._get_blob(payload["object_id"])
-        if blob is None:
+        buf, release = None, None
+        if self._get_source is not None:
+            src = self._get_source(payload["object_id"])
+            if src is not None:
+                buf, release = src
+        if buf is None:
+            buf = self._get_blob(payload["object_id"])
+        if buf is None:
             return None
         chunk = get_config().object_manager_chunk_size
-        if len(blob) <= chunk:
-            return {"inline": blob}
-        with self._lock:
-            self._expire_locked()
-            if len(self._sessions) >= self._max_sessions:
-                # Admission control: receiver backs off and retries
-                # (pull_manager.cc bounded active pulls).
-                return {"busy": True}
-            token = uuid.uuid4().hex
-            self._sessions[token] = _Session(blob)
-        return {"token": token, "size": len(blob), "chunk_size": chunk}
+        nbytes = len(buf)
+        if nbytes <= chunk:
+            inline = bytes(buf)
+            if release is not None:
+                release()
+            return {"inline": inline}
+        meta = self._admit(buf, release)
+        if meta is None and release is not None:
+            release()
+        return meta if meta is not None else {"busy": True}
 
     def open_session(self, blob: bytes) -> Optional[dict]:
         """Open a transfer session over an ALREADY-materialized blob
         (lets composite handlers avoid fetching the bytes twice);
         returns the meta dict, or None when admission-full."""
+        return self._admit(blob, None)
+
+    def _admit(self, buf, release) -> Optional[dict]:
         chunk = get_config().object_manager_chunk_size
         with self._lock:
             self._expire_locked()
             if len(self._sessions) >= self._max_sessions:
+                # Admission control: receiver backs off and retries
+                # (pull_manager.cc bounded active pulls).
                 return None
             token = uuid.uuid4().hex
-            self._sessions[token] = _Session(blob)
-        return {"token": token, "size": len(blob), "chunk_size": chunk}
+            self._sessions[token] = _Session(buf, release)
+        if release is not None:
+            _register_for_sweep(self)
+        return {"token": token, "size": len(buf), "chunk_size": chunk}
 
     def handle_chunk(self, payload) -> Optional[bytes]:
         token, index = payload["token"], payload["index"]
@@ -95,24 +155,31 @@ class ChunkServer:
             blob = session.blob
         chunk = get_config().object_manager_chunk_size
         start = index * chunk
-        return blob[start:start + chunk]
+        # bytes() also materializes memoryview slices for the wire codec
+        # (the per-chunk copy IS the send serialization, not an extra).
+        return bytes(blob[start:start + chunk])
 
     def handle_close(self, payload) -> bool:
         with self._lock:
-            return self._sessions.pop(payload["token"], None) is not None
+            session = self._sessions.pop(payload["token"], None)
+        if session is None:
+            return False
+        session.close()
+        return True
 
     def _expire_locked(self):
         now = time.monotonic()
         for token in [t for t, s in self._sessions.items()
                       if now - s.last_access > self.SESSION_TTL_S]:
-            del self._sessions[token]
+            self._sessions.pop(token).close()
 
 
 def serve_chunks(server, get_blob: Callable[[bytes], Optional[bytes]],
                  max_sessions: int = 8,
-                 prefix: str = "fetch") -> ChunkServer:
+                 prefix: str = "fetch", get_source=None) -> ChunkServer:
     """Register the chunk protocol on an RpcServer."""
-    cs = ChunkServer(get_blob, max_sessions=max_sessions)
+    cs = ChunkServer(get_blob, max_sessions=max_sessions,
+                     get_source=get_source)
     server.register(f"{prefix}_meta", cs.handle_meta)
     server.register(f"{prefix}_chunk", cs.handle_chunk)
     server.register(f"{prefix}_close", cs.handle_close)
@@ -150,11 +217,39 @@ def fetch_chunked(client, object_id_bin: bytes,
 def fetch_session(client, meta: dict, timeout: float = 300.0,
                   prefix: str = "fetch",
                   pipeline: int = 4) -> Optional[bytes]:
-    """Pull an already-opened transfer session to completion."""
+    """Pull an already-opened transfer session into a fresh buffer."""
+    out = bytearray(meta["size"])
+    mv = memoryview(out)
+    ok = fetch_session_into(client, meta,
+                            lambda off, data: _assign(mv, off, data),
+                            timeout=timeout, prefix=prefix,
+                            pipeline=pipeline)
+    mv.release()
+    return bytes(out) if ok else None
+
+
+def _assign(mv: memoryview, off: int, data) -> None:
+    mv[off:off + len(data)] = data
+
+
+def fetch_session_into(client, meta: dict, sink, timeout: float = 300.0,
+                       prefix: str = "fetch", pipeline: int = 4,
+                       on_chunk=None) -> bool:
+    """Pull an already-opened transfer session through a WINDOWED
+    pipeline straight into caller-provided memory.
+
+    ``sink(offset, chunk_bytes)`` lands each chunk at its final offset
+    — when the caller hands a reserved shm-segment view this is the
+    transfer's ONLY copy (no intermediate ``bytearray``).  ``pipeline``
+    chunk requests stay in flight to hide round-trip latency; each
+    completed request implicitly acks its chunk (the receiver-driven
+    flow of push_manager.cc).  ``on_chunk(nbytes, inflight)`` is an
+    optional per-chunk metrics hook.  Returns False on timeout or
+    sender-side session expiry (partial writes may have landed; the
+    caller aborts its reservation)."""
     deadline = time.monotonic() + timeout
     token, size, chunk = meta["token"], meta["size"], meta["chunk_size"]
     n_chunks = (size + chunk - 1) // chunk
-    out = bytearray(size)
     try:
         next_index = 0
         inflight = {}
@@ -166,19 +261,20 @@ def fetch_session(client, meta: dict, timeout: float = 300.0,
                                         "index": next_index})
                 next_index += 1
             # Wait for the OLDEST in flight (ordered assembly keeps the
-            # buffer write sequential and the ack stream dense).
+            # sink write sequential and the ack stream dense).
             index = min(inflight)
             fut = inflight.pop(index)
             remaining = deadline - time.monotonic()
             if remaining <= 0:
-                return None
+                return False
             data = fut.result(timeout=remaining)
             if data is None:
-                return None       # session expired sender-side
-            start = index * chunk
-            out[start:start + len(data)] = data
+                return False      # session expired sender-side
+            sink(index * chunk, data)
             received += 1
-        return bytes(out)
+            if on_chunk is not None:
+                on_chunk(len(data), len(inflight))
+        return True
     finally:
         try:
             client.call_async(f"{prefix}_close", {"token": token},

@@ -27,6 +27,29 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _config_isolation():
+    """Roll back process-global Config mutations after every test.
+
+    Tests tune fields on the singleton (spill thresholds, chunk sizes,
+    worker modes); a leaked value silently changes the behavior of every
+    later test in the alphabetical run — the classic source of
+    order-dependent flakes (VERDICT weak-#5)."""
+    import dataclasses
+
+    import ray_tpu._private.config as config_mod
+    prev = config_mod._global_config
+    snapshot = dataclasses.asdict(prev) if prev is not None else None
+    yield
+    with config_mod._lock:
+        if snapshot is None:
+            config_mod._global_config = None
+        else:
+            for k, v in snapshot.items():
+                setattr(prev, k, v)
+            config_mod._global_config = prev
+
+
 @pytest.fixture
 def ray_start_regular():
     import ray_tpu

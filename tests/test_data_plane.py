@@ -1,0 +1,203 @@
+"""Zero-copy data-plane regression tests.
+
+Copy-COUNT guarantees, not just correctness (reference: plasma's
+create/seal path writes client bytes once into the arena;
+``ObjectBufferPool`` assembles pulled chunks straight into the store):
+
+* ``put`` of a buffer-protocol payload moves each payload byte at most
+  ONCE (serialize captures views; ``write_into`` lands them in the shm
+  segment) and never materializes the flattened blob;
+* ``NodeObjectManager._fetch_from`` assembles transfers directly into a
+  reserved segment block — no intermediate ``bytearray``, no flatten;
+* the windowed chunk pipeline (``fetch_session_into``) keeps multiple
+  requests in flight and reassembles out-of-order completions
+  correctly.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.object_store import _NativeHandle
+from ray_tpu._private.serialization import (SerializedObject, copy_stats,
+                                            serialize, serialize_into)
+
+
+def _poison_to_bytes(monkeypatch):
+    """Any flatten-to-bytes on the hot path is a failed regression."""
+    def boom(self):
+        raise AssertionError(
+            "SerializedObject.to_bytes() called on a zero-copy path")
+    monkeypatch.setattr(SerializedObject, "to_bytes", boom)
+
+
+class TestSingleCopyPut:
+    def test_put_copies_each_byte_at_most_once(self, ray_start_regular,
+                                               monkeypatch):
+        head = worker_mod.global_worker().cluster.head_node
+        assert head.object_store._native is not None, \
+            "native store must be active for copy accounting"
+        arr = np.ones(32 * 1024 * 1024, dtype=np.uint8)
+        _poison_to_bytes(monkeypatch)
+        before = copy_stats["bytes_copied"]
+        ref = ray_tpu.put(arr)
+        copied = copy_stats["bytes_copied"] - before
+        # One pass over the payload plus the (tiny) header+inband.
+        assert arr.nbytes <= copied <= arr.nbytes + 64 * 1024, \
+            f"put copied {copied} bytes for a {arr.nbytes}-byte payload"
+        e = head.object_store.get(ref.object_id())
+        assert isinstance(e.data, _NativeHandle), \
+            "large put should land in the native segment"
+        monkeypatch.undo()
+        out = ray_tpu.get(ref)
+        assert out.nbytes == arr.nbytes and out[0] == 1 and out[-1] == 1
+
+    def test_store_put_never_flattens(self, ray_start_regular,
+                                      monkeypatch):
+        head = worker_mod.global_worker().cluster.head_node
+        if head.object_store._native is None:
+            pytest.skip("no native backend")
+        _poison_to_bytes(monkeypatch)
+        # Exercises the store-level put directly (the worker-return and
+        # fetch paths reuse it).
+        from ray_tpu._private.ids import ObjectID
+        oid = ObjectID.from_random()
+        s = serialize(np.arange(500_000, dtype=np.int64))
+        head.object_store.put(oid, s, pin=False)
+        e = head.object_store.get(oid)
+        assert isinstance(e.data, _NativeHandle)
+        head.object_store.delete(oid)
+
+    def test_serialize_into_tracking_writer(self):
+        """serialize_into drives the writer protocol with exactly one
+        reserve/commit and a byte-exact write."""
+        written = {}
+
+        class TrackingWriter:
+            def __init__(self):
+                self.buf = None
+                self.commits = 0
+
+            def reserve(self, nbytes):
+                self.buf = bytearray(nbytes)
+                return memoryview(self.buf)
+
+            def commit(self, serialized, nbytes):
+                self.commits += 1
+                written["nbytes"] = nbytes
+                return True
+
+            def abort(self, exc):
+                raise AssertionError(f"abort: {exc}")
+
+        w = TrackingWriter()
+        arr = np.arange(100_000, dtype=np.float32)
+        s, delivered = serialize_into({"a": arr, "tag": "x"}, w)
+        assert delivered and w.commits == 1
+        assert written["nbytes"] == len(bytes(w.buf)) == s.flat_nbytes
+        back = ray_tpu._private.serialization.deserialize(
+            SerializedObject.from_bytes(bytes(w.buf)))
+        np.testing.assert_array_equal(back["a"], arr)
+        assert back["tag"] == "x"
+
+
+class TestSingleCopyFetch:
+    def test_fetch_assembles_into_segment_no_bytearray(
+            self, ray_start_cluster, monkeypatch):
+        cluster = ray_start_cluster(num_cpus=1)
+        n2 = cluster.add_node(num_cpus=1)
+        head = cluster.head_node
+        if head.object_store._native is None or \
+                n2.object_store._native is None:
+            pytest.skip("no native backend")
+        arr = np.full(8 * 1024 * 1024, 7, dtype=np.uint8)
+        ref = ray_tpu.put(arr)
+        oid = ref.object_id()
+        assert head.object_store.contains(oid)
+
+        # The pull must use the reserved-segment writer, never the heap
+        # fallback, and never flatten the source.
+        def no_heap(*a, **k):
+            raise AssertionError("heap fallback used with native present")
+        monkeypatch.setattr(
+            "ray_tpu._private.object_store._HeapTransferWriter", no_heap)
+        _poison_to_bytes(monkeypatch)
+        before = copy_stats["bytes_copied"]
+        done = threading.Event()
+        result = {}
+
+        def cb(ok):
+            result["ok"] = ok
+            done.set()
+
+        n2.object_manager.pull_async(oid, cb)
+        assert done.wait(timeout=60)
+        assert result["ok"], "pull failed"
+        copied = copy_stats["bytes_copied"] - before
+        assert copied <= arr.nbytes + 64 * 1024, \
+            f"fetch copied {copied} bytes for {arr.nbytes}-byte payload"
+        e = n2.object_store.get(oid)
+        assert e is not None and isinstance(e.data, _NativeHandle), \
+            "pulled copy should live in the destination segment"
+        assert n2.object_manager.stats["pulled_objects"] >= 1
+        assert n2.object_manager.stats["chunks_transferred"] >= 2
+        assert n2.object_manager.stats["transfer_gbps_last"] > 0
+
+    def test_fetched_value_correct(self, ray_start_cluster):
+        cluster = ray_start_cluster(num_cpus=1)
+        n2 = cluster.add_node(num_cpus=1)
+        arr = np.arange(2_000_000, dtype=np.int64)
+        ref = ray_tpu.put(arr)
+        done = threading.Event()
+        n2.object_manager.pull_async(ref.object_id(),
+                                     lambda ok: done.set())
+        assert done.wait(timeout=60)
+        from ray_tpu._private.object_store import entry_value
+        e = n2.object_store.get(ref.object_id())
+        np.testing.assert_array_equal(entry_value(e), arr)
+
+
+class TestChunkPipeline:
+    def _serve(self, blob, chunk_size):
+        from ray_tpu._private.config import get_config
+        from ray_tpu.rpc import RpcServer
+        from ray_tpu.rpc.chunked import serve_chunks
+        get_config().object_manager_chunk_size = chunk_size
+        server = RpcServer(name="chunk-test")
+        serve_chunks(server, lambda key: blob)
+        return server
+
+    def test_windowed_pipeline_reassembles(self):
+        from ray_tpu._private.config import get_config
+        from ray_tpu.rpc import RpcClient
+        from ray_tpu.rpc.chunked import fetch_session_into
+        old_chunk = get_config().object_manager_chunk_size
+        rng = np.random.default_rng(7)
+        blob = rng.integers(0, 256, 1_000_000, dtype=np.uint8).tobytes()
+        server = self._serve(blob, 64 * 1024)
+        try:
+            client = RpcClient(server.address)
+            meta = client.call("fetch_meta", {"object_id": b"k"})
+            assert "token" in meta
+            out = bytearray(meta["size"])
+            window_peak = [0]
+
+            def on_chunk(_n, inflight):
+                window_peak[0] = max(window_peak[0], inflight)
+
+            ok = fetch_session_into(
+                client, meta,
+                lambda off, data: out.__setitem__(
+                    slice(off, off + len(data)), data),
+                pipeline=6, on_chunk=on_chunk)
+            assert ok
+            assert bytes(out) == blob
+            assert window_peak[0] >= 2, \
+                "pipeline never had multiple chunks in flight"
+            client.close()
+        finally:
+            server.stop()
+            get_config().object_manager_chunk_size = old_chunk

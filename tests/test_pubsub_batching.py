@@ -98,6 +98,7 @@ class TestWireProtocol:
 
 
 class TestClusterLogSpam:
+    @pytest.mark.slow
     def test_spoke_log_spam_batched_no_drops(self):
         """Several process nodes spam print(); every line reaches the
         driver's subscriber and the head sees a BOUNDED number of

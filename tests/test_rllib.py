@@ -55,6 +55,7 @@ class TestRolloutFleet:
 
 
 class TestPPO:
+    @pytest.mark.slow
     def test_ppo_learns_cartpole(self, ray_start_regular):
         """Mean episode reward must clearly improve within a few
         iterations (reference smoke criterion for PPO)."""
@@ -131,6 +132,7 @@ class TestReplayBuffer:
 
 
 class TestDQN:
+    @pytest.mark.slow
     def test_dqn_learns_cartpole(self, ray_start_regular):
         from ray_tpu.rllib import DQNTrainer
         trainer = DQNTrainer(CartPole, {
@@ -219,6 +221,7 @@ class TestIMPALA:
         np.testing.assert_allclose(np.asarray(vs), vs_o[:T], rtol=1e-5)
         np.testing.assert_allclose(np.asarray(pg_adv), pg_o, rtol=1e-5)
 
+    @pytest.mark.slow
     def test_impala_learns_cartpole(self, ray_start_regular):
         from ray_tpu.rllib import IMPALATrainer
         trainer = IMPALATrainer(CartPole, {

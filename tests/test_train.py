@@ -213,6 +213,7 @@ def ray_process_mode():
     ray_tpu.shutdown()
 
 
+@pytest.mark.slow
 def test_torch_backend_real_process_group(ray_process_mode):
     """With OS-process workers, TorchConfig must wire a REAL
     torch.distributed gloo group: all_reduce works natively inside the
