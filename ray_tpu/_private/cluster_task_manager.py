@@ -17,15 +17,24 @@ tolerant, exactly like spillback.
 
 from __future__ import annotations
 
+import logging
 import threading
 from collections import defaultdict, deque
 from typing import Callable, Dict, Tuple
 
 import time
 
+from ray_tpu._private import fault_injection
 from ray_tpu._private.config import get_config
 from ray_tpu._private.task_spec import TaskSpec
 from ray_tpu.scheduler import policy as policy_mod
+
+logger = logging.getLogger(__name__)
+
+# Consecutive pop->dispatch failures of one task before the lease is
+# rejected back to the submitter (which charges the task's retry
+# budget) — bounds the requeue loop under a deterministic fault.
+_MAX_DISPATCH_REQUEUES = 20
 
 # Tick-latency histogram bounds (seconds).  The north-star budget is
 # 50 ms/tick at 1M tasks x 10k nodes (BASELINE.md); the sub-ms buckets
@@ -50,7 +59,11 @@ class ClusterTaskManager:
         self._node_label = self._raylet.node_id.hex()[:12]
         self.tick_stats = {"ticks": 0, "busy_ticks": 0,
                            "spillbacks": 0, "jnp_fallbacks": 0,
-                           "last_batch_classes": 0, "last_batch_tasks": 0}
+                           "last_batch_classes": 0, "last_batch_tasks": 0,
+                           "dispatch_errors": 0}
+        # Consecutive failed dispatch handoffs per task (cleared on
+        # success): past _MAX_DISPATCH_REQUEUES the lease is rejected.
+        self._dispatch_failures: Dict = {}
         from ray_tpu._private.metrics_agent import (get_metrics_registry,
                                                     record_internal)
         label = {"node": self._node_label}
@@ -139,6 +152,67 @@ class ClusterTaskManager:
                          task_events.SCHEDULED,
                          node_id=self._raylet.node_id.hex())
 
+    # A scheduled task is POPPED from its queue before its lease reply
+    # fires, so any exception between the pop and the reply silently
+    # loses the lease request: the submitter waits forever and the
+    # caller's get() times out (the seed-era "lost dispatch" flake —
+    # a rare exception on the tick thread, e.g. an import race or IO
+    # error deep in a dispatch callback, was swallowed by the event
+    # loop WITH the popped work).  Every pop->reply edge below
+    # therefore runs through one of these guards, which requeue the
+    # task on failure instead of unwinding the tick.
+
+    def _dispatch_local(self, spec: TaskSpec, reply: Callable) -> bool:
+        """Hand a locally-scheduled task to the dispatch path.  Returns
+        False (never raises) when the handoff failed BEFORE the reply
+        was registered — the caller requeues the task and returns its
+        resource reservation."""
+        try:
+            fault_injection.hook("worker.dispatch")
+            self._emit_scheduled(spec)
+            self._raylet.local_task_manager.queue_and_schedule(spec, reply)
+            self._dispatch_failures.pop(spec.task_id, None)
+            return True
+        except Exception:
+            self.tick_stats["dispatch_errors"] += 1
+            logger.exception("local dispatch of %s failed; requeueing",
+                             spec.task_id)
+            return False
+
+    def _reply_spillback(self, spec: TaskSpec, reply: Callable,
+                         target) -> None:
+        """Deliver a spillback reply; an exception inside the reply
+        chain is counted but NOT requeued (the submitter may already
+        have acted on it — task-level retries cover the remainder)."""
+        try:
+            self.tick_stats["spillbacks"] += 1
+            reply({"retry_at": target})
+        except Exception:
+            self.tick_stats["dispatch_errors"] += 1
+            logger.exception("spillback reply for %s failed",
+                             spec.task_id)
+
+    def _requeue(self, spec: TaskSpec, reply: Callable) -> None:
+        # Capped: a dispatch path that fails DETERMINISTICALLY (wedged
+        # worker pool, persistent fault) must escalate to the submitter
+        # as a rejection, not livelock the tick loop in an endless
+        # pop -> fail -> requeue -> re-post cycle.
+        n = self._dispatch_failures.get(spec.task_id, 0) + 1
+        self._dispatch_failures[spec.task_id] = n
+        if n > _MAX_DISPATCH_REQUEUES:
+            self._dispatch_failures.pop(spec.task_id, None)
+            try:
+                reply({"rejected": True,
+                       "reason": f"local dispatch failed {n} times"})
+            except Exception:
+                logger.exception("dispatch-failure reply for %s failed",
+                                 spec.task_id)
+            return
+        with self._lock:
+            self._queues[spec.scheduling_class].append((spec, reply))
+        self._raylet.loop.post(self.schedule_and_dispatch,
+                               "cluster.schedule")
+
     def _schedule_greedy(self):
         """Reference-parity greedy loop: per class, per task, pick the best
         node, dispatch locally or spill back."""
@@ -181,9 +255,9 @@ class ClusterTaskManager:
                                 view.add_back(local_id, spec.resources)
                                 continue
                             self._queues[cls].popleft()
-                        self._emit_scheduled(spec)
-                        self._raylet.local_task_manager.queue_and_schedule(
-                            spec, reply)
+                        if not self._dispatch_local(spec, reply):
+                            view.add_back(local_id, spec.resources)
+                            self._requeue(spec, reply)
                         progress = True
                     else:
                         if not view.subtract(target, spec.resources):
@@ -200,8 +274,7 @@ class ClusterTaskManager:
                         # subtract above stops this tick from spilling
                         # everything to the same node; the broadcast
                         # corrects it.
-                        self.tick_stats["spillbacks"] += 1
-                        reply({"retry_at": target})
+                        self._reply_spillback(spec, reply, target)
                         progress = True
             if not progress:
                 return
@@ -232,8 +305,16 @@ class ClusterTaskManager:
         self.tick_stats["last_batch_tasks"] = len(work)
         self.tick_stats["last_batch_classes"] = len(
             {spec.scheduling_class for spec, _ in work})
-        assignments = self._jax_solver.solve(
-            view, [spec for spec, _ in work])
+        try:
+            assignments = self._jax_solver.solve(
+                view, [spec for spec, _ in work])
+        except Exception:
+            # The solver guards its device path internally, but the
+            # whole batch was already POPPED — any escaped exception
+            # (e.g. the non-hybrid fallback leg) must not take the
+            # popped lease requests down with it.
+            logger.exception("batched solve failed; requeueing batch")
+            assignments = None
         if assignments is None:
             # Device solve failed — put everything back for greedy.
             with self._lock:
@@ -243,25 +324,38 @@ class ClusterTaskManager:
         local_id = self._raylet.node_id
         for (spec, reply), target in zip(work, assignments):
             if target is None:
+                # The device solve yields None for can't-place-THIS-TICK,
+                # which conflates busy (no availability right now) with
+                # structurally infeasible (no node's TOTAL fits).  Only
+                # the latter may park in _infeasible — that queue is
+                # retried solely on cluster-membership changes, so a
+                # merely-busy task parked there stalls until an
+                # unrelated broadcast rescues it (or forever).
+                feasible_somewhere = view.is_feasible_anywhere(
+                    spec.resources)
                 with self._lock:
-                    self._infeasible[spec.scheduling_class].append(
-                        (spec, reply))
+                    if feasible_somewhere:
+                        self._queues[spec.scheduling_class].append(
+                            (spec, reply))
+                    else:
+                        self._infeasible[spec.scheduling_class].append(
+                            (spec, reply))
             elif target == local_id:
                 if not view.subtract(local_id, spec.resources):
                     with self._lock:
                         self._queues[spec.scheduling_class].append(
                             (spec, reply))
                     continue
-                self._emit_scheduled(spec)
-                self._raylet.local_task_manager.queue_and_schedule(spec, reply)
+                if not self._dispatch_local(spec, reply):
+                    view.add_back(local_id, spec.resources)
+                    self._requeue(spec, reply)
             else:
                 # Validate against the exact vectors before committing the
                 # spill (kernel output validated by IsSchedulable,
                 # SURVEY.md §7.4).
                 node = view.node_resources(target)
                 if node is not None and node.is_feasible(spec.resources):
-                    self.tick_stats["spillbacks"] += 1
-                    reply({"retry_at": target})
+                    self._reply_spillback(spec, reply, target)
                 else:
                     with self._lock:
                         self._queues[spec.scheduling_class].append(

@@ -66,6 +66,13 @@ class Config:
     #: Min number of objects batched into one spill operation
     #: (reference: local_object_manager.h min_spilling_size).
     min_spilling_size: int = 100 * 1024 * 1024
+    #: How long an over-capacity create/put/transfer reservation may
+    #: queue for space (retried as seals/evictions/spills free room)
+    #: before ObjectStoreFullError surfaces (reference:
+    #: oom_grace_period_s over the plasma create_request_queue).
+    object_store_full_grace_period_s: float = 10.0
+    #: Delay between retries while a queued create waits for space.
+    object_store_full_retry_ms: int = 20
     #: Use the native C++ shared-memory store when available.
     use_native_object_store: bool = True
     #: Chunk size for node-to-node object transfer (object_manager.cc).
@@ -85,6 +92,13 @@ class Config:
     lineage_pinning_enabled: bool = True
     #: Max lineage bytes kept per owner before disabling reconstruction.
     max_lineage_bytes: int = 1024 * 1024 * 1024
+    #: Max recursion depth when reconstructing a lost object whose
+    #: creating task's args are themselves lost (object_recovery_manager
+    #: parity: recovery walks the lineage DAG, bounded).
+    max_lineage_reconstruction_depth: int = 10
+    #: Base of the per-task exponential backoff between repeated
+    #: reconstruction attempts of the same creating task.
+    lineage_reconstruction_backoff_s: float = 0.2
 
     # ------ worker pool ------
     #: "thread" = executor threads in the node process (default; one

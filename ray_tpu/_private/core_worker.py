@@ -51,7 +51,12 @@ class CoreWorker:
         self.metrics: Dict[str, float] = {"tasks_finished": 0,
                                           "task_exec_seconds": 0.0,
                                           "tasks_submitted": 0,
-                                          "actor_tasks_submitted": 0}
+                                          "actor_tasks_submitted": 0,
+                                          "lineage_reconstructions": 0}
+        # Per-creating-task reconstruction state (attempt count +
+        # exponential-backoff gate) — object_recovery_manager parity.
+        self._recon_lock = threading.Lock()
+        self._reconstructions: Dict[TaskID, _ReconState] = {}
         # Exported at scrape time (/metrics): the hot path only bumps
         # these plain counters.
         from ray_tpu._private.metrics_agent import (get_metrics_registry,
@@ -64,6 +69,11 @@ class CoreWorker:
                 record_internal(f"ray_tpu.core_worker.{k}", v, **wlabel)
             record_internal("ray_tpu.core_worker.objects_in_memory_store",
                             len(cw.memory_store._entries), **wlabel)
+            # Promoted to a top-level name: the recovery dashboards and
+            # chaos tests key on ray_tpu_lineage_reconstructions.
+            record_internal("ray_tpu.lineage_reconstructions",
+                            cw.metrics["lineage_reconstructions"],
+                            **wlabel)
         get_metrics_registry().register_collector(self, _collect)
         # Free stored copies when objects go out of scope.
         self.reference_counter.subscribe_deleted(self._free_object)
@@ -164,12 +174,18 @@ class CoreWorker:
     def _get_one(self, ref: ObjectRef, timeout: Optional[float]) -> Any:
         object_id = ref.object_id()
         deadline = None if timeout is None else time.monotonic() + timeout
-        recovery_attempted = False
         nowhere_streak = 0
         while True:
             value, found = self._try_get_local(object_id)
             if found:
                 return value
+            # Deadline gate sits at the TOP: every retry arm below
+            # `continue`s back here, so a bottom-of-loop check would be
+            # skipped exactly on the paths that loop (e.g. a recovery
+            # backoff window outlasting the caller's timeout).
+            if deadline is not None and time.monotonic() >= deadline:
+                raise exceptions.GetTimeoutError(
+                    f"Get timed out for {object_id}")
             # Not local: is it in some node's store?
             locations = self.cluster.object_directory.get_locations(object_id)
             if locations:
@@ -198,24 +214,28 @@ class CoreWorker:
                     continue
             # Object nowhere and not pending: try lineage reconstruction.
             if not self._is_pending(object_id) and not locations:
-                if not recovery_attempted and self.recover_object(object_id):
-                    recovery_attempted = True
+                if self.recover_object(object_id):
+                    # Resubmitted now, or a backoff window is pending.
+                    # recover_object is backoff-gated internally, so it
+                    # is polled EVERY pass: a reconstructed copy lost
+                    # again (second node death) gets its next attempt
+                    # when the window opens, instead of being abandoned
+                    # by a one-shot flag and surfacing a premature
+                    # ObjectLostError ~50ms later.
+                    nowhere_streak = 0
                     continue
                 # Unrecoverable: allow a few rechecks (a producing task
                 # may seal between store reads), then surface the loss
                 # instead of spinning until the deadline.
                 nowhere_streak += 1
                 if nowhere_streak >= 5:
-                    raise exceptions.ObjectLostError(
+                    raise self._lost_error(
                         object_id,
                         "all copies lost and lineage reconstruction "
                         "unavailable")
                 time.sleep(0.01)
             else:
                 nowhere_streak = 0
-            if deadline is not None and time.monotonic() >= deadline:
-                raise exceptions.GetTimeoutError(
-                    f"Get timed out for {object_id}")
 
     def _try_get_local(self, object_id: ObjectID) -> Tuple[Any, bool]:
         entry = self.memory_store.get_entry(object_id)
@@ -279,8 +299,7 @@ class CoreWorker:
                 time.sleep(min(0.005 * misses, 0.1))
             remaining = deadline - time.monotonic()
             if remaining <= 0:
-                raise exceptions.ObjectLostError(object_id,
-                                                 "arg fetch failed")
+                raise self._lost_error(object_id, "arg fetch failed")
             done = threading.Event()
             ok_box = [False]
 
@@ -289,13 +308,12 @@ class CoreWorker:
                 done.set()
             node.object_manager.pull_async(object_id, _cb)
             if not done.wait(timeout=remaining):
-                raise exceptions.ObjectLostError(object_id,
-                                                 "arg fetch timed out")
+                raise self._lost_error(object_id, "arg fetch timed out")
             if not ok_box[0]:
                 # Failed pull (e.g. source node died): try lineage
                 # reconstruction, then loop to re-check/pull again.
                 if not self.recover_object(object_id):
-                    raise exceptions.ObjectLostError(
+                    raise self._lost_error(
                         object_id, "arg fetch failed and not recoverable")
                 time.sleep(0.01)
             else:
@@ -480,9 +498,23 @@ class CoreWorker:
         return actor
 
     # ---- recovery (object_recovery_manager.cc) -------------------------
-    def recover_object(self, object_id: ObjectID) -> bool:
-        """Resubmit the creating task from pinned lineage."""
-        if not get_config().lineage_pinning_enabled:
+    def recover_object(self, object_id: ObjectID, _depth: int = 0) -> bool:
+        """Resubmit the creating task from pinned lineage.
+
+        Recovery walks the lineage DAG: lost ARGS of the creating task
+        are recovered first (recursively, bounded by
+        ``max_lineage_reconstruction_depth`` — a cycle or a chain of
+        losses deeper than the bound fails the recovery rather than
+        recursing forever).  Repeated reconstructions of the same
+        creating task are gated by exponential backoff: within the
+        window the call reports in-progress WITHOUT resubmitting, so
+        polling get/pull loops cannot stampede the scheduler with
+        duplicate resubmissions.  Returns True when the object is being
+        recomputed (now or already), False when it is unrecoverable."""
+        cfg = get_config()
+        if not cfg.lineage_pinning_enabled:
+            return False
+        if _depth > cfg.max_lineage_reconstruction_depth:
             return False
         spec = self.task_manager.lineage_spec_for_object(object_id)
         if spec is None:
@@ -491,9 +523,80 @@ class CoreWorker:
             return True  # already being recomputed
         if spec.is_actor_task() or spec.is_actor_creation():
             return False  # actor state is not reconstructable
+        now = time.monotonic()
+        with self._recon_lock:
+            st = self._reconstructions.get(spec.task_id)
+            if st is None:
+                st = self._reconstructions[spec.task_id] = _ReconState()
+            if now < st.next_allowed:
+                return True   # backoff window: resubmission pending
+            st.attempts += 1
+            st.next_allowed = now + cfg.lineage_reconstruction_backoff_s \
+                * (2 ** (st.attempts - 1))
+            attempt = st.attempts
+        # Recover lost args BEFORE resubmitting: the recomputed task
+        # cannot run if its own inputs are gone too.
+        for arg_id in spec.arg_object_ids():
+            if not self._object_available(arg_id):
+                self.recover_object(arg_id, _depth=_depth + 1)
+        from ray_tpu.gcs import task_events
+        self.metrics["lineage_reconstructions"] += 1
+        # Attempt rides above the retry band (prior retries never
+        # exceed max_retries) so the task-event manager rewinds the
+        # FINISHED record into RECONSTRUCTING, retry-style.
+        task_events.emit(self.cluster, spec.task_id,
+                         task_events.RECONSTRUCTING,
+                         name=spec.function_name,
+                         attempt=spec.max_retries + attempt)
         self.task_manager.add_pending_task(spec)
         self.task_submitter.submit(spec)
         return True
+
+    def _lost_error(self, object_id: ObjectID,
+                    reason: str) -> exceptions.ObjectLostError:
+        """Build an ObjectLostError with actionable context: who owned
+        the object, where its copies last were, whether lineage could
+        (or did) try to recompute it, and any spill record — the
+        debugging trail for "why is my object gone"."""
+        parts = [reason]
+        ref = self.reference_counter.describe(object_id)
+        if ref is not None:
+            parts.append("owner worker=" +
+                         ("this driver" if ref["owned"] else "borrowed") +
+                         f" ({self.worker_id.hex()[:12]})")
+            if ref.get("spilled_url"):
+                parts.append(f"spilled_url={ref['spilled_url']}")
+        locations = self.cluster.object_directory.get_locations(object_id)
+        parts.append("known locations=" +
+                     (",".join(n.hex()[:12] for n in locations)
+                      if locations else "none"))
+        spec = self.task_manager.lineage_spec_for_object(object_id)
+        if spec is None:
+            parts.append("lineage=not pinned (cannot reconstruct; "
+                         "check lineage_pinning_enabled / "
+                         "max_lineage_bytes)")
+        elif spec.is_actor_task() or spec.is_actor_creation():
+            parts.append(f"lineage={spec.function_name} is an actor "
+                         "task (actor state is not reconstructable)")
+        else:
+            with self._recon_lock:
+                st = self._reconstructions.get(spec.task_id)
+                attempts = st.attempts if st is not None else 0
+            parts.append(f"lineage=pinned ({spec.function_name}), "
+                         f"{attempts} reconstruction attempt(s)")
+        return exceptions.ObjectLostError(object_id, "; ".join(parts))
+
+    def _object_available(self, object_id: ObjectID) -> bool:
+        """An object needs no recovery: sealed value (not a marker
+        pointing at a store that may have died), a live store location,
+        or a pending producing task."""
+        entry = self.memory_store.get_entry(object_id)
+        if entry is not None and entry.sealed and \
+                not isinstance(entry.data, InPlasmaMarker):
+            return True
+        if self.cluster.object_directory.get_locations(object_id):
+            return True
+        return self.task_manager.is_pending(object_id.task_id())
 
     def on_node_death(self, node_id, lost_objects: List[ObjectID]):
         """Proactively reconstruct referenced lost objects."""
@@ -529,6 +632,8 @@ class CoreWorker:
                 raylet.object_store.delete(object_id)
         directory.remove_object(object_id)
         self.task_manager.evict_lineage(object_id.task_id())
+        with self._recon_lock:
+            self._reconstructions.pop(object_id.task_id(), None)
 
     def free_objects(self, refs: Sequence[ObjectRef]):
         for ref in refs:
@@ -548,6 +653,16 @@ def _is_device_array(value) -> bool:
     if jax is None:
         return False
     return isinstance(value, jax.Array) and not value.is_deleted()
+
+
+class _ReconState:
+    """Reconstruction bookkeeping for one creating task."""
+
+    __slots__ = ("attempts", "next_allowed")
+
+    def __init__(self):
+        self.attempts = 0
+        self.next_allowed = 0.0
 
 
 class _Retry(Exception):

@@ -21,6 +21,12 @@ from ray_tpu import exceptions
 from ray_tpu._private.config import get_config
 from ray_tpu._private.task_spec import TaskSpec
 
+# Re-lease cadence/window for leases bounced off a not-yet-declared-dead
+# node: 0.2s x 150 = 30s, comfortably past any heartbeat-timeout
+# declaration, after which the bounce becomes a real failure.
+_LEASE_BOUNCE_DELAY_S = 0.2
+_MAX_LEASE_BOUNCES = 150
+
 
 class _SchedulingKeyState:
     __slots__ = ("queue", "idle_workers", "pending_leases", "leased_task_ids")
@@ -43,6 +49,7 @@ class DirectTaskSubmitter:
         self._lock = threading.RLock()
         self._keys: Dict[int, _SchedulingKeyState] = defaultdict(
             _SchedulingKeyState)
+        self._lease_bounces: Dict = {}   # task_id -> transient rejects
         self._max_pending = get_config(
         ).max_pending_lease_requests_per_scheduling_category
 
@@ -114,6 +121,7 @@ class DirectTaskSubmitter:
                     state = self._keys[key]
                     state.pending_leases -= 1
                     state.leased_task_ids.discard(spec.task_id)
+                    self._lease_bounces.pop(spec.task_id, None)
                     if state.queue and state.queue[0].task_id == spec.task_id:
                         state.queue.popleft()
                         dispatch = spec
@@ -143,13 +151,17 @@ class DirectTaskSubmitter:
                     self._request_lease(spec, key, raylet=target,
                                         hops=hops + 1)
             else:
+                reason = str(result.get("reason", "lease rejected"))
+                transient = bool(result.get("rejected")) and (
+                    "connection lost" in reason or "node dead" in reason)
                 self._on_lease_failed(
-                    spec, key, exceptions.RayTpuError(
-                        result.get("reason", "lease rejected")))
+                    spec, key, exceptions.RayTpuError(reason),
+                    transient=transient)
 
         raylet.request_worker_lease(spec, on_reply)
 
-    def _on_lease_failed(self, spec: TaskSpec, key: int, err):
+    def _on_lease_failed(self, spec: TaskSpec, key: int, err,
+                         transient: bool = False):
         with self._lock:
             state = self._keys[key]
             state.pending_leases = max(0, state.pending_leases - 1)
@@ -158,8 +170,46 @@ class DirectTaskSubmitter:
                 state.queue.remove(spec)
             except ValueError:
                 pass
+        if transient:
+            # The lease bounced off a dying/unreachable node whose death
+            # the GCS has not declared yet, so the scheduler may keep
+            # pointing at it for a few heartbeats.  That is a
+            # scheduling-plane hiccup, not a task failure: hold the spec
+            # and re-lease after a beat WITHOUT burning the task's retry
+            # budget (reference: lease failures against a dead raylet are
+            # retried at the lease layer, task retries cover execution).
+            # Bounded — past the window it becomes a real failure.
+            with self._lock:
+                n = self._lease_bounces.get(spec.task_id, 0) + 1
+                self._lease_bounces[spec.task_id] = n
+            if n <= _MAX_LEASE_BOUNCES:
+                # Delayed re-lease rides the raylet event loop's timer
+                # heap — a node death can bounce hundreds of queued
+                # tasks every 0.2s for several heartbeats, and a Timer
+                # THREAD per bounce would be thread churn exactly while
+                # the scheduler is busiest.
+                raylet = self._core.local_raylet
+                if raylet is not None and not getattr(raylet, "_dead",
+                                                      False):
+                    raylet.loop.schedule_after(
+                        _LEASE_BOUNCE_DELAY_S,
+                        lambda: self._resubmit_bounced(spec),
+                        "lease.rebounce")
+                return
+        with self._lock:
+            self._lease_bounces.pop(spec.task_id, None)
         self._core.task_manager.fail_or_retry(
             spec, err, resubmit=self.submit)
+
+    def _resubmit_bounced(self, spec: TaskSpec):
+        """Timer-thread re-lease of a transiently bounced task.  A
+        cluster torn down while the timer was pending must not be
+        resubmitted into (the re-lease would bounce-loop against dead
+        raylets across later tests in the same process)."""
+        raylet = self._core.local_raylet
+        if raylet is None or getattr(raylet, "_dead", False):
+            return
+        self.submit(spec)
 
     # ---- dispatch -------------------------------------------------------
     def _push(self, spec: TaskSpec, worker, raylet, key: int):

@@ -1,0 +1,160 @@
+"""Deterministic fault injection for chaos testing.
+
+Parity: the reference's chaos tooling is probabilistic (``test_chaos.py``
+``NodeKillerActor`` kills a random node every N seconds) which makes
+failures unreproducible under CI load.  Here failure POINTS are named
+call sites compiled into the runtime — each ``hook(point)`` is a no-op
+until a test arms it — and arming is count-based, not random, so a test
+that says "fail the first two spill writes" fails exactly those two,
+every run, on every machine.
+
+Named points wired into the runtime (grep ``fault_injection.hook``):
+
+========================  ====================================================
+``spill.write``           before a spill batch is written to disk
+``restore.read``          before a spilled object is read back
+``transfer.chunk``        per received chunk of a streamed object transfer
+``node.heartbeat``        before a raylet sends its GCS heartbeat
+``worker.dispatch``       before a scheduled task is handed to local dispatch
+========================  ====================================================
+
+Modes:
+
+* ``error`` — raise :class:`FaultInjectedError` at the hook;
+* ``delay`` — sleep ``delay_s`` at the hook (slow-IO / slow-network);
+* ``kill``  — ``SIGKILL`` the calling process (real process death; for
+  node-host / worker OS processes).
+
+Arming is in-process via :func:`arm` or cross-process via the
+``RAY_TPU_FAULT_POINTS`` env var (parsed at import in every daemon):
+
+    RAY_TPU_FAULT_POINTS="spill.write:error:2,transfer.chunk:delay:-1:0.05"
+
+format per entry: ``point:mode[:count[:delay_s]]`` (count -1 = every
+hit).  Malformed entries are skipped, never fatal: this parses at
+import time in every daemon, and a typo in an env var must not take
+the cluster down.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+from ray_tpu import exceptions
+
+
+class FaultInjectedError(exceptions.RayTpuError):
+    """Raised by an armed ``error``-mode failure point."""
+
+    def __init__(self, point: str):
+        self.point = point
+        super().__init__(f"injected fault at {point!r}")
+
+
+class _Arming:
+    __slots__ = ("mode", "remaining", "skip", "delay_s", "fired")
+
+    def __init__(self, mode: str, count: int, skip: int, delay_s: float):
+        self.mode = mode
+        self.remaining = count     # -1 = unlimited
+        self.skip = skip           # let the first N hits through
+        self.delay_s = delay_s
+        self.fired = 0
+
+
+_lock = threading.Lock()
+_points: Dict[str, _Arming] = {}
+#: Total hits per point since arming began (kept after disarm so tests
+#: can assert "the fault actually fired" — a chaos test that passes
+#: because its fault never triggered proves nothing).
+_fired: Dict[str, int] = {}
+
+
+def hook(point: str) -> None:
+    """Failure-point call site.  No-op unless ``point`` is armed.
+
+    The disarmed fast path is one dict read with no lock — cheap enough
+    for per-chunk and per-heartbeat sites.
+    """
+    if not _points:
+        return
+    with _lock:
+        arming = _points.get(point)
+        if arming is None:
+            return
+        if arming.skip > 0:
+            arming.skip -= 1
+            return
+        if arming.remaining == 0:
+            return
+        if arming.remaining > 0:
+            arming.remaining -= 1
+        arming.fired += 1
+        _fired[point] = _fired.get(point, 0) + 1
+        mode, delay_s = arming.mode, arming.delay_s
+    if mode == "delay":
+        time.sleep(delay_s)
+        return
+    if mode == "kill":
+        import signal
+        os.kill(os.getpid(), signal.SIGKILL)
+    raise FaultInjectedError(point)
+
+
+def arm(point: str, mode: str = "error", count: int = 1, skip: int = 0,
+        delay_s: float = 0.0) -> None:
+    """Arm ``point``: the next ``count`` hits (after ``skip`` free
+    passes) inject ``mode``.  Re-arming replaces the previous arming."""
+    if mode not in ("error", "delay", "kill"):
+        raise ValueError(f"unknown fault mode {mode!r}")
+    with _lock:
+        _points[point] = _Arming(mode, count, skip, delay_s)
+
+
+def disarm(point: Optional[str] = None) -> None:
+    """Disarm one point, or every point when ``point`` is None (test
+    teardown).  Fired counts are kept."""
+    with _lock:
+        if point is None:
+            _points.clear()
+        else:
+            _points.pop(point, None)
+
+
+def fired(point: str) -> int:
+    """Times ``point`` actually injected (cumulative, survives disarm)."""
+    with _lock:
+        return _fired.get(point, 0)
+
+
+def reset() -> None:
+    """Full reset: disarm everything and zero the fired counters."""
+    with _lock:
+        _points.clear()
+        _fired.clear()
+
+
+def load_from_env(env: Optional[str] = None) -> None:
+    """Parse ``RAY_TPU_FAULT_POINTS`` — how spawned daemons (node_host,
+    worker_main) inherit a test's arming across the process boundary."""
+    raw = env if env is not None else os.environ.get(
+        "RAY_TPU_FAULT_POINTS", "")
+    if not raw:
+        return
+    for part in raw.split(","):
+        try:
+            fields = part.strip().split(":")
+            if len(fields) < 2:
+                continue
+            point, mode = fields[0], fields[1]
+            count = int(fields[2]) if len(fields) > 2 else 1
+            delay_s = float(fields[3]) if len(fields) > 3 else 0.0
+            arm(point, mode, count=count, delay_s=delay_s)
+        except ValueError:
+            continue
+
+
+load_from_env()

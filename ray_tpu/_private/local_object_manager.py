@@ -1,0 +1,142 @@
+"""Async spill/restore IO manager — one per raylet.
+
+Parity: reference ``src/ray/raylet/local_object_manager.{h,cc}`` — the
+raylet-side spill orchestrator that batches unpinned sealed objects into
+fused spill files through dedicated IO workers, frees the plasma block
+once the write lands, records the ``spilled_url`` with the owner, and
+restores on demand.  Here the IO worker pool collapses to one daemon
+thread per raylet (spilling is disk-bound, not CPU-bound), but the
+semantics match:
+
+* **fused batches** — many small objects per spill file
+  (``min_spilling_size``), each recorded as ``path?offset=&size=``;
+* **copy-out outside the store lock** — victims are marked + their
+  native blocks pinned under the lock (``select_spill_victims``), the
+  bulk write runs unlocked, finalization publishes atomically
+  (``finish_spill_batch``); a delete racing the copy wins;
+* **backpressure integration** — queued create requests
+  (``_ensure_capacity``) kick ``request_spill`` and are woken by each
+  finalized batch;
+* **zero-restore serving** — spilled objects are read back lazily on
+  ``get`` and can be served to remote pulls straight from the file
+  (``NodeObjectStore.open_spilled_view``), never forcing a restore.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import uuid
+
+from ray_tpu._private import fault_injection
+from ray_tpu._private.config import get_config
+
+
+class LocalObjectManager:
+    """io_worker-style spill thread over one :class:`NodeObjectStore`."""
+
+    def __init__(self, store, spill_dir: str, node_label: str = ""):
+        self._store = store
+        self._spill_dir = spill_dir
+        os.makedirs(spill_dir, exist_ok=True)
+        self._wake = threading.Event()
+        self._stopped = threading.Event()
+        self.stats = {"spill_batches": 0, "spilled_objects": 0,
+                      "spilled_bytes": 0, "spill_errors": 0}
+        from ray_tpu._private.metrics_agent import (get_metrics_registry,
+                                                    record_internal)
+        labels = {"node": node_label or "local"}
+
+        def _collect(mgr):
+            for k, v in mgr.stats.items():
+                record_internal(f"ray_tpu.local_object_manager.{k}", v,
+                                **labels)
+        get_metrics_registry().register_collector(self, _collect)
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"ray_tpu::spill::{node_label or 'local'}")
+        self._thread.start()
+
+    # ---- control --------------------------------------------------------
+    def request_spill(self) -> None:
+        """Hot-path kick (queued create, over-threshold put): one Event
+        set, no locks, no IO."""
+        self._wake.set()
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._wake.set()
+
+    # ---- the io thread --------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stopped.is_set():
+            self._wake.wait(timeout=0.5)
+            if self._stopped.is_set():
+                return
+            self._wake.clear()
+            try:
+                while self._store.spill_shortfall() > 0:
+                    if not self._spill_once():
+                        break
+            except Exception:
+                # The spiller must survive anything (disk full,
+                # injected faults): the store's inline path and queue
+                # deadline still bound callers.
+                self.stats["spill_errors"] += 1
+
+    def _spill_once(self) -> bool:
+        cfg = get_config()
+        shortfall = self._store.spill_shortfall()
+        if shortfall <= 0:
+            return False
+        # Fuse small objects: batch at least min_spilling_size (capped
+        # to half the store — tiny test stores must not spill
+        # everything in one sweep) per file.
+        max_bytes = max(shortfall,
+                        min(cfg.min_spilling_size,
+                            self._store.capacity // 2))
+        batch = self._store.select_spill_victims(max_bytes)
+        if not batch:
+            return False
+        path = os.path.join(self._spill_dir,
+                            f"batch-{uuid.uuid4().hex[:12]}")
+        results = []
+        offset = 0
+        try:
+            fault_injection.hook("spill.write")
+            with open(path, "wb") as f:
+                for object_id, entry, source in batch:
+                    if isinstance(source, memoryview):
+                        nbytes = source.nbytes
+                        f.write(source)
+                    else:
+                        blob = source.to_bytes()
+                        nbytes = len(blob)
+                        f.write(blob)
+                    results.append((object_id, entry, offset, nbytes,
+                                    True))
+                    offset += nbytes
+        except Exception:
+            # Whole batch fails closed: victims are unmarked/unpinned
+            # and stay in memory; the file (possibly partial) goes.
+            self.stats["spill_errors"] += 1
+            results = [(object_id, entry, 0, 0, False)
+                       for object_id, entry, _ in batch]
+            self._store.finish_spill_batch(path, results)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return False
+        n = self._store.finish_spill_batch(path, results)
+        if n == 0:
+            # Every victim was deleted mid-copy: drop the orphan file.
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return True
+        self.stats["spill_batches"] += 1
+        self.stats["spilled_objects"] += n
+        self.stats["spilled_bytes"] += offset
+        return True

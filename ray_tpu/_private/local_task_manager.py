@@ -107,6 +107,11 @@ class LocalTaskManager:
         self._dispatch_queue: deque = deque()
         # Resources held by leased workers: worker_id -> ResourceRequest.
         self._allocated: Dict = {}
+        # Arg objects pinned for a lease (GetAndPinArgsForExecutor
+        # parity): worker_id -> [ObjectID].  Released with the lease —
+        # a dispatch-time pin left forever would make every
+        # arg-consumed object unspillable (spill starvation).
+        self._arg_pins: Dict = {}
         self.dependency_manager = DependencyManager(raylet)
 
     # step 1-2: queue + wait for args
@@ -150,7 +155,9 @@ class LocalTaskManager:
                             self._raylet.node_id, ResourceRequest(delta))
                         self._raylet.cluster_task_manager.on_resources_freed()
                 self._allocated[worker.worker_id] = held
-            for oid in spec.arg_object_ids():
+                pinned = list(spec.arg_object_ids())
+                self._arg_pins[worker.worker_id] = pinned
+            for oid in pinned:
                 self._raylet.object_store.pin(oid)
             # NOTE no SUBMITTED_TO_WORKER event here: the lease reply's
             # worker may end up running a DIFFERENT task than this
@@ -163,6 +170,10 @@ class LocalTaskManager:
     def release_worker_resources(self, worker) -> None:
         with self._lock:
             req = self._allocated.pop(worker.worker_id, None)
+            pinned = self._arg_pins.pop(worker.worker_id, None)
+        if pinned:
+            for oid in pinned:
+                self._raylet.object_store.unpin(oid)
         if req is not None:
             self._raylet.cluster_view.add_back(self._raylet.node_id, req)
             self._raylet.loop.post(self.dispatch, "local.dispatch")

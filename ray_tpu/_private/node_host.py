@@ -162,10 +162,16 @@ class _PeerStoreReader:
                 nbytes = fetch_object_into(
                     client, object_id, local_store, pipeline=pipeline,
                     on_chunk=on_chunk, timeout=timeout)
-            except exc.ObjectStoreFullError:
+            except exc.ObjectStoreFullError as err:
                 # LOCAL store cannot take the object: the peer is not
                 # at fault (don't tear its link down) and the head leg
-                # would fail identically — surface the failure.
+                # would fail identically — surface the failure.  The
+                # infeasible variant (object larger than the whole
+                # store) propagates so the executor fails the task with
+                # the actionable raise-object_store_memory message
+                # instead of looping its 60s arg-fetch deadline.
+                if getattr(err, "infeasible", False):
+                    raise
                 return None
             except Exception:
                 nbytes = None
@@ -353,6 +359,14 @@ class _RemoteCoreWorker:
         the executor must block until the owner produces it.  Loop:
         local store -> owner fetch (errors propagate) -> event-driven
         ``wait_object`` on the head, bounded by a deadline.
+
+        A FAILED pull (the directory redirected us to a peer that died
+        with the bytes, or a chunk session tore mid-transfer) is NOT a
+        lost object: the owner reconstructs lost objects from lineage
+        once the node is declared dead, so the executor loops — re-ask,
+        short backoff — and only the deadline turns persistent failure
+        into ObjectLostError.  Raising on the first failed pull would
+        fail the whole task over a loss the owner was about to repair.
         """
         import pickle
         import time
@@ -361,6 +375,7 @@ class _RemoteCoreWorker:
         from ray_tpu._private.serialization import deserialize
 
         deadline = time.monotonic() + 60.0
+        last_failure = None
         while True:
             entry = node.object_store.get(object_id)
             if entry is not None:
@@ -378,25 +393,40 @@ class _RemoteCoreWorker:
                     self._host.peers.note_address(
                         peer_id, blob.get("host"), blob.get("port"))
                     reader = _PeerStoreReader(self._host, peer_id)
-                    serialized = reader.get_serialized(object_id)
-                    if serialized is None:
-                        raise exceptions.ObjectLostError(
-                            object_id, "peer arg fetch failed")
-                    return deserialize(serialized)
-                if kind == "chunked":
+                    try:
+                        serialized = reader.get_serialized(object_id)
+                    except Exception:
+                        serialized = None
+                    if serialized is not None:
+                        return deserialize(serialized)
+                    last_failure = "peer arg fetch failed"
+                elif kind == "chunked":
                     from ray_tpu.rpc.chunked import (
                         fetch_chunked, fetch_session)
-                    if blob is not None:     # pre-opened session meta
-                        blob = fetch_session(self._host.client, blob,
-                                             timeout=300.0)
-                    else:                    # admission-full: retry path
-                        blob = fetch_chunked(self._host.client,
-                                             object_id.binary(),
-                                             timeout=300.0)
-                    if blob is None:
-                        raise exceptions.ObjectLostError(
-                            object_id, "chunked arg fetch failed")
-                return deserialize(SerializedObject.from_bytes(blob))
+                    try:
+                        if blob is not None:  # pre-opened session meta
+                            blob = fetch_session(self._host.client, blob,
+                                                 timeout=300.0)
+                        else:                 # admission-full: retry path
+                            blob = fetch_chunked(self._host.client,
+                                                 object_id.binary(),
+                                                 timeout=300.0)
+                    except Exception:
+                        blob = None
+                    if blob is not None:
+                        return deserialize(
+                            SerializedObject.from_bytes(blob))
+                    last_failure = "chunked arg fetch failed"
+                else:
+                    return deserialize(SerializedObject.from_bytes(blob))
+                if time.monotonic() >= deadline:
+                    raise exceptions.ObjectLostError(
+                        object_id, last_failure)
+                # Re-ask after a beat: the stale location must age out
+                # (heartbeat timeout) before the directory stops
+                # redirecting us to the dead peer.
+                time.sleep(0.2)
+                continue
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 raise exceptions.ObjectLostError(

@@ -15,6 +15,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Set
 
+from ray_tpu import exceptions
+from ray_tpu._private import fault_injection
 from ray_tpu._private.config import get_config
 from ray_tpu._private.ids import NodeID, ObjectID
 from ray_tpu._private.object_store import NodeObjectStore, _NativeHandle
@@ -54,8 +56,20 @@ def fetch_object_into(client, object_id: ObjectID, local_store,
             time.sleep(backoff)
             backoff = min(backoff * 2, 1.0)
             continue
-        writer = local_store.create_transfer_writer(object_id,
-                                                    meta["size"])
+        try:
+            # May QUEUE behind the receiver store's create-request
+            # backpressure (space freed by seals/evictions/spills); a
+            # grace-deadline miss is a failed pull, not a crash.
+            writer = local_store.create_transfer_writer(object_id,
+                                                        meta["size"])
+        except exceptions.ObjectStoreFullError as err:
+            if getattr(err, "infeasible", False):
+                # The object exceeds this store's TOTAL capacity: no
+                # amount of spilling/retrying can ever admit it.
+                # Surface the actionable error instead of burning the
+                # pull deadline on futile retries.
+                raise
+            return None
         ok = False
         try:
             ok = fetch_session_into(
@@ -281,6 +295,9 @@ class NodeObjectManager:
         window_peak = [0]
 
         def on_chunk(nbytes: int, inflight: int):
+            # Chaos point: per-chunk delay (slow network) or error
+            # (truncated transfer -> abort + retry path).
+            fault_injection.hook("transfer.chunk")
             self.stats["chunks_transferred"] += 1
             if inflight > window_peak[0]:
                 window_peak[0] = inflight
@@ -322,7 +339,17 @@ class NodeObjectManager:
         """In-process store-to-store transfer: chunked copy from the
         source's segment view directly into a local reservation.  The
         source block is pinned for the duration so eviction cannot
-        recycle it mid-read."""
+        recycle it mid-read.  A SPILLED source is served straight from
+        its spill-file mmap — the transfer never forces the sender to
+        restore the bytes into its store budget."""
+        spilled = src.open_spilled_view(object_id)
+        if spilled is not None:
+            view, release = spilled
+            try:
+                return self._chunk_copy_into_local(object_id, view,
+                                                   on_chunk)
+            finally:
+                release()
         entry = src.get(object_id)
         if entry is None:
             return None
@@ -336,23 +363,29 @@ class NodeObjectManager:
                 try:
                     view = data.read()
                     if view is not None:
-                        nbytes = view.nbytes
-                        store = self._raylet.object_store
-                        writer = store.create_transfer_writer(
-                            object_id, nbytes)
-                        try:
-                            chunk = get_config().object_manager_chunk_size
-                            for off in range(0, nbytes, chunk):
-                                writer.write(off, view[off:off + chunk])
-                                on_chunk(min(chunk, nbytes - off), 0)
-                            writer.seal()
-                        except BaseException:
-                            writer.abort()
-                            raise
-                        return nbytes
+                        return self._chunk_copy_into_local(
+                            object_id, view, on_chunk)
                 finally:
                     src._native.unpin(key)
         return self._copy_via_serialized(object_id, src, on_chunk)
+
+    def _chunk_copy_into_local(self, object_id: ObjectID, view,
+                               on_chunk) -> int:
+        """Chunk-copy a flat source view (pinned segment block or
+        spill-file mmap) into a reserved local store block."""
+        nbytes = view.nbytes
+        store = self._raylet.object_store
+        writer = store.create_transfer_writer(object_id, nbytes)
+        try:
+            chunk = get_config().object_manager_chunk_size
+            for off in range(0, nbytes, chunk):
+                writer.write(off, view[off:off + chunk])
+                on_chunk(min(chunk, nbytes - off), 0)
+            writer.seal()
+        except BaseException:
+            writer.abort()
+            raise
+        return nbytes
 
     def _copy_via_serialized(self, object_id: ObjectID, reader,
                              on_chunk) -> Optional[int]:

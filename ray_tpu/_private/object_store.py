@@ -19,11 +19,37 @@ from __future__ import annotations
 import os
 import threading
 import time
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Optional, Tuple
 
 from ray_tpu import exceptions
+from ray_tpu._private import fault_injection
+from ray_tpu._private.config import get_config
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.serialization import SerializedObject, deserialize
+
+try:
+    from ray_tpu.native import shm_store as _shm
+except Exception:  # pragma: no cover — native backend absent entirely
+    _shm = None
+
+
+def _spill_url(path: str, offset: int, size: int) -> str:
+    """Spill location record: fused batch files hold many objects, so a
+    bare path is not enough — reference ``spilled_url`` carries
+    ``?offset=&size=`` exactly like this."""
+    return f"{path}?offset={offset}&size={size}"
+
+
+def _parse_spill_url(url: str) -> Tuple[str, int, int]:
+    path, _, query = url.partition("?")
+    offset = size = 0
+    for part in query.split("&"):
+        k, _, v = part.partition("=")
+        if k == "offset":
+            offset = int(v)
+        elif k == "size":
+            size = int(v)
+    return path, offset, size
 
 
 class DeviceObject:
@@ -50,14 +76,28 @@ class DeviceObject:
 
 class _Entry:
     __slots__ = ("data", "error", "size", "pin_count", "last_access",
-                 "spilled_path", "sealed", "is_device")
+                 "spilled_path", "sealed", "is_device", "primary",
+                 "spilling")
 
     def __init__(self, data=None, error=None, size=0):
         self.data = data              # SerializedObject | DeviceObject | None
         self.error = error            # Exception to raise at get()
         self.size = size
+        # READER pins only (executor arg reads, shm_locate clients):
+        # a reader-pinned entry is never spilled out from under the
+        # read.  Primary copies ARE spillable (that is the whole point
+        # of spilling; reference local_object_manager spills pinned
+        # primary copies and records the URL), which is why the owner's
+        # primary-copy claim is this separate flag and not a pin.
+        # Nothing gates on ``primary`` yet — it is bookkeeping for the
+        # owner-copy semantics replacing the old put-time pin.
         self.pin_count = 0
+        self.primary = False
+        # An async spill has copied-out/is copying this entry's bytes;
+        # guards double-selection (the delete path still wins).
+        self.spilling = False
         self.last_access = time.monotonic()
+        #: Spill location URL (``path?offset=&size=``) once on disk.
         self.spilled_path: Optional[str] = None
         self.sealed = data is not None or error is not None
         self.is_device = isinstance(data, DeviceObject)
@@ -175,7 +215,8 @@ class NodeObjectStore:
     """
 
     def __init__(self, node_id, capacity_bytes: int, spill_dir: str,
-                 spill_threshold: float = 0.8, native_backend=None):
+                 spill_threshold: float = 0.8, native_backend=None,
+                 on_spilled: Optional[Callable] = None):
         self.node_id = node_id
         self.capacity = capacity_bytes
         self.spill_threshold = spill_threshold
@@ -189,10 +230,25 @@ class NodeObjectStore:
         # budget; moved into _used at seal, dropped at abort).
         self._transfer_reserved = 0
         self._native = native_backend  # ray_tpu.native shm store, optional
+        # Create-request queue state (create_request_queue.h parity):
+        # over-capacity reservations wait on the store condition and are
+        # retried as deletes/spills free space; depth is a live gauge.
+        self._create_waiters = 0
+        # Async spill manager (LocalObjectManager), attached by the
+        # raylet; stores constructed bare still spill inline.
+        self._spill_manager = None
+        #: ``on_spilled(object_id, url)`` — owner-side spilled_url
+        #: recording (reference_counter), wired by the raylet.
+        self._on_spilled = on_spilled
+        # Live objects per spill file: fused batch files are unlinked
+        # only once every object they hold is deleted.
+        self._spill_files: Dict[str, set] = {}
         self.stats = {"spilled_bytes": 0, "restored_bytes": 0,
                       "spilled_objects": 0, "restored_objects": 0,
                       "evicted_objects": 0, "native_put_bytes": 0,
-                      "native_puts": 0}
+                      "native_puts": 0, "queued_creates": 0,
+                      "create_queue_wait_ms": 0.0,
+                      "create_queue_timeouts": 0, "spill_errors": 0}
         from ray_tpu._private.metrics_agent import (get_metrics_registry,
                                                     record_internal)
         nid = getattr(node_id, "hex", lambda: str(node_id))()[:12]
@@ -205,9 +261,18 @@ class NodeObjectStore:
                             store.capacity, **labels)
             record_internal("ray_tpu.object_store.num_objects",
                             len(store._entries), **labels)
+            record_internal("ray_tpu.object_store.create_queue_depth",
+                            store._create_waiters, **labels)
             for k, v in store.stats.items():
                 record_internal(f"ray_tpu.object_store.{k}", v, **labels)
         get_metrics_registry().register_collector(self, _collect)
+
+    def attach_spill_manager(self, manager) -> None:
+        """Wire the raylet's LocalObjectManager: over-threshold spilling
+        moves off the put path onto its io thread, and queued creates
+        kick it instead of spilling inline."""
+        with self._lock:
+            self._spill_manager = manager
 
     # ---- create/seal (plasma lifecycle) --------------------------------
     def put(self, object_id: ObjectID, data, pin: bool = True) -> int:
@@ -222,23 +287,18 @@ class NodeObjectStore:
         native_eligible = (self._native is not None
                            and isinstance(data, SerializedObject))
         with self._lock:
-            existing = self._entries.get(object_id)
-            if existing is not None:
-                if existing.sealed:
-                    return existing.size
-                # Another putter is mid-copy: wait for its seal
-                # (idempotent re-put, plasma create-in-progress reply).
-                self._wait_sealed_locked(object_id)
-                existing = self._entries.get(object_id)
-                if existing is not None:
-                    # Sealed: idempotent success with the winner's size.
-                    # Still unsealed after the wait: stuck writer —
-                    # don't double-store under it.
-                    return existing.size if existing.sealed else size
-                # Deleted mid-copy: the winner's bytes are gone — fall
-                # through and store OUR copy (returning success with no
-                # stored value would surface as a spurious ObjectLost).
-            self._ensure_capacity(size)
+            done, result = self._existing_put_outcome_locked(object_id,
+                                                             size)
+            if done:
+                return result
+            if self._ensure_capacity(size):
+                # The create request QUEUED (over-capacity, admitted
+                # once seals/evictions/spills freed space): the lock was
+                # released while waiting, so re-run the duplicate check.
+                done, result = self._existing_put_outcome_locked(
+                    object_id, size)
+                if done:
+                    return result
             reservation = None
             if native_eligible:
                 reservation = self._reserve_native_locked(
@@ -246,7 +306,7 @@ class NodeObjectStore:
             e = _Entry(data=None if reservation is not None else data,
                        size=size)
             e.sealed = reservation is None
-            e.pin_count = 1 if pin else 0
+            e.primary = pin
             self._entries[object_id] = e
             self._used += size
             if reservation is None:
@@ -255,6 +315,31 @@ class NodeObjectStore:
         # Bulk copy OUTSIDE the lock.
         self._fill_reservation(object_id, e, data, reservation)
         return size
+
+    def _existing_put_outcome_locked(self, object_id: ObjectID,
+                                     size: int):
+        """Duplicate-put handling (must hold lock): returns
+        ``(True, size_to_return)`` when the put should short-circuit on
+        an existing entry, ``(False, 0)`` when the caller should store
+        its own copy."""
+        existing = self._entries.get(object_id)
+        if existing is None:
+            return False, 0
+        if existing.sealed:
+            return True, existing.size
+        # Another putter is mid-copy: wait for its seal
+        # (idempotent re-put, plasma create-in-progress reply).
+        self._wait_sealed_locked(object_id)
+        existing = self._entries.get(object_id)
+        if existing is not None:
+            # Sealed: idempotent success with the winner's size.
+            # Still unsealed after the wait: stuck writer —
+            # don't double-store under it.
+            return True, existing.size if existing.sealed else size
+        # Deleted mid-copy: the winner's bytes are gone — store OUR
+        # copy (returning success with no stored value would surface
+        # as a spurious ObjectLost).
+        return False, 0
 
     def _fill_reservation(self, object_id: ObjectID, e: _Entry, data,
                           reservation) -> None:
@@ -301,8 +386,9 @@ class NodeObjectStore:
 
     def _reserve_native_locked(self, object_id: ObjectID, nbytes: int):
         """Reserve a segment block with the create-request retry flow
-        (create_request_queue.h parity): on OOM, ask the native LRU for
-        victims, spill them through the Python IO path, and retry;
+        (create_request_queue.h parity): ``try_create`` returns a
+        RETRIABLE-OOM code (never throws) — on OOM, ask the native LRU
+        for victims, spill them through the Python IO path, and retry;
         returns ``(nbytes, offset)``, ``(nbytes, _ADOPT)`` when the key
         is already sealed natively, or None (python-held buffers, the
         fallback allocation) only when the segment genuinely cannot fit
@@ -311,37 +397,67 @@ class NodeObjectStore:
         need = nbytes + 128
         for attempt in range(4):   # 3 escalations + final retry
             try:
-                off = self._native.create(key, nbytes)
-                if off is None:
-                    # Duplicate key: adopt if sealed, else give up.
-                    loc = self._native.locate(key)
-                    return (loc[1], _ADOPT) if loc is not None else None
+                status, off = self._native.try_create(key, nbytes)
+            except Exception:
+                return None
+            if status == _shm.CREATE_OK:
                 return (nbytes, off)
-            except MemoryError:
-                free = self._native.capacity - self._native.used_bytes()
-                # Escalating eviction: first the byte shortfall, then a
-                # full object's worth of LRU neighbours (total free can
-                # exceed the request while no HOLE fits it), finally
-                # everything evictable — coalescing then yields the
-                # largest hole the pinned islands allow.
-                if attempt == 0:
-                    shortfall = max(1, need - free)
-                elif attempt == 1:
-                    shortfall = need
-                else:
-                    shortfall = self._native.capacity
-                victims = self._native.choose_victims(shortfall)
-                if not victims:
-                    return None
-                for vkey in victims:
-                    voi = ObjectID(vkey)
-                    ve = self._entries.get(voi)
-                    if ve is not None and isinstance(ve.data, _NativeHandle):
+            if status == _shm.CREATE_DUPLICATE:
+                # Duplicate key: adopt if sealed, else give up.
+                loc = self._native.locate(key)
+                return (loc[1], _ADOPT) if loc is not None else None
+            if status == _shm.CREATE_PENDING:
+                # Deferred-free in progress (a client still holds the
+                # old bytes pinned): the key is unusable until the last
+                # release — python fallback.
+                return None
+            # CREATE_OOM — retriable.
+            free = self._native.capacity - self._native.used_bytes()
+            # Escalating eviction: first the byte shortfall, then a
+            # full object's worth of LRU neighbours (total free can
+            # exceed the request while no HOLE fits it), finally
+            # everything evictable — coalescing then yields the
+            # largest hole the pinned islands allow.
+            if attempt == 0:
+                shortfall = max(1, need - free)
+            elif attempt == 1:
+                shortfall = need
+            else:
+                shortfall = self._native.capacity
+            victims = self._native.choose_victims(shortfall)
+            if not victims:
+                return None
+            progressed = False
+            for vkey in victims:
+                voi = ObjectID(vkey)
+                ve = self._entries.get(voi)
+                if ve is not None and isinstance(ve.data, _NativeHandle):
+                    # The native LRU only knows CLIENT pins: a python
+                    # reader pin (spill-during-pin refused), an async
+                    # spill in flight (spilling — finish_spill_batch
+                    # would re-release the budget a second time), and
+                    # an unsealed put must all refuse eviction here,
+                    # same as the spill paths.  (No recency guard: OOM
+                    # eviction must work on hot stores — plasma
+                    # semantics — readers are protected by pins.)
+                    if not self._spillable_locked(ve):
+                        continue
+                    try:
                         self._spill(voi, ve)     # reads + frees native
                         self.stats["evicted_objects"] += 1
-                    else:
-                        self._native.delete(vkey)
-            except Exception:
+                        progressed = True
+                    except Exception:
+                        # Victim couldn't spill (e.g. disk fault): skip
+                        # it — other victims / the python fallback keep
+                        # the put alive.
+                        self.stats["spill_errors"] += 1
+                else:
+                    self._native.delete(vkey)
+                    progressed = True
+            if not progressed:
+                # Every victim refused (pinned / mid-spill / recently
+                # read): escalating the shortfall cannot help — fall to
+                # the python path, which queues on the store condition.
                 return None
         return None
 
@@ -392,17 +508,24 @@ class NodeObjectStore:
     def register_native_entry(self, object_id: ObjectID, size: int):
         """Adopt an object a CLIENT created+sealed directly in the
         native segment (worker-written return): table entry wrapping
-        the native handle, owner-pinned like any primary copy."""
+        the native handle, a primary copy.  Admitted UNCONDITIONALLY:
+        the bytes already physically occupy the segment (the client's
+        create reserved them), so blocking or failing here would lose a
+        sealed return — over-threshold pressure is handed to the async
+        spiller instead."""
         with self._lock:
             if object_id in self._entries:
                 return
-            self._ensure_capacity(size)
             e = _Entry(data=_NativeHandle(self._native,
                                           object_id.binary(), size),
                        size=size)
-            e.pin_count = 1
+            e.primary = True
             self._entries[object_id] = e
             self._used += size
+            if self._spill_manager is not None and \
+                    self._used + self._transfer_reserved > \
+                    int(self.capacity * self.spill_threshold):
+                self._spill_manager.request_spill()
             self._lock.notify_all()
 
     def contains(self, object_id: ObjectID) -> bool:
@@ -464,38 +587,186 @@ class NodeObjectStore:
                 # Client (worker-held) pins defer the actual free.
                 e.data.delete()
             if e.spilled_path:
-                try:
-                    os.unlink(e.spilled_path)
-                except OSError:
-                    pass
+                self._release_spill_region_locked(object_id,
+                                                  e.spilled_path)
+            # Freed budget may admit a queued create request.
+            self._lock.notify_all()
+
+    def _release_spill_region_locked(self, object_id: ObjectID,
+                                     url: str) -> None:
+        """Drop ``object_id``'s claim on its spill file; fused batch
+        files are unlinked only when their LAST live object goes."""
+        path, _, _ = _parse_spill_url(url)
+        live = self._spill_files.get(path)
+        if live is not None:
+            live.discard(object_id)
+            if live:
+                return
+            del self._spill_files[path]
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
 
     # ---- capacity / spilling -------------------------------------------
-    def _ensure_capacity(self, incoming: int):
-        # Must hold lock.  Spill least-recently-used unpinned-or-pinned
-        # entries until the incoming object fits under the threshold.
-        # In-flight transfer reservations count as used: their chunks
-        # have not landed yet but the bytes are committed.
-        limit = int(self.capacity * self.spill_threshold)
-        if self._used + self._transfer_reserved + incoming <= limit:
-            return
+    def _spillable_locked(self, e: _Entry) -> bool:
+        """Spill candidate: sealed bytes in memory, no READER pins (an
+        executor or shm client is mid-read — spill-during-pin refused),
+        not device-resident, not already being spilled by the async
+        manager."""
+        return (e.data is not None and e.sealed and not e.is_device
+                and e.pin_count == 0 and not e.spilling)
+
+    def _spill_safe_locked(self, e: _Entry, now: float) -> bool:
+        """Spillable AND not touched within the last second.  ``get()``
+        returns the entry and callers read ``e.data`` WITHOUT a pin, so
+        a spill that nulls the payload right after an access races that
+        unpinned read (deserialize(None) on a healthy object).  Recency
+        is the guard every background/eviction path shares; only the
+        explicit test hook ``spill_now`` skips it."""
+        return self._spillable_locked(e) and now - e.last_access > 1.0
+
+    def _spill_toward_locked(self, target: int, incoming: int) -> None:
+        """Inline LRU spill until ``used + reserved + incoming`` fits
+        under ``target`` or candidates run out.  Per-victim failures
+        (disk faults) skip the victim rather than failing the caller."""
+        now = time.monotonic()
         candidates = sorted(
             ((e.last_access, oid) for oid, e in self._entries.items()
-             if e.data is not None and e.sealed and not e.is_device),
+             if self._spill_safe_locked(e, now)),
             key=lambda t: t[0])
         for _, oid in candidates:
-            if self._used + self._transfer_reserved + incoming <= limit:
-                break
-            self._spill(oid, self._entries[oid])
-        if self._used + self._transfer_reserved + incoming > self.capacity:
-            raise exceptions.ObjectStoreFullError(
-                f"Object of {incoming} bytes exceeds store capacity "
-                f"({self._used}/{self.capacity} used, "
-                f"{self._transfer_reserved} reserved by in-flight "
-                f"transfers; spilling exhausted)")
+            if self._used + self._transfer_reserved + incoming <= target:
+                return
+            e = self._entries.get(oid)
+            if e is None or not self._spillable_locked(e):
+                continue
+            try:
+                self._spill(oid, e)
+            except Exception:
+                self.stats["spill_errors"] += 1
+
+    def _ensure_capacity(self, incoming: int, wait: bool = True) -> bool:
+        """Admit a reservation of ``incoming`` bytes (must hold lock).
+
+        Fast path: fits under the spill threshold — admit.  Pressure
+        path: inline-spill LRU entries toward the threshold.  Full
+        path (plasma ``create_request_queue`` semantics): the request
+        QUEUES on the store condition — releasing the lock — and is
+        retried as deletes/evictions/spills free space, surfacing
+        ObjectStoreFullError only after the configured grace deadline.
+        Returns True when the request waited (callers must re-validate
+        any state read before the call)."""
+        limit = int(self.capacity * self.spill_threshold)
+        if self._used + self._transfer_reserved + incoming <= limit:
+            return False
+        if self._spill_manager is None:
+            # Bare store (no io thread): spill inline toward the
+            # threshold on the caller's thread.
+            self._spill_toward_locked(limit, incoming)
+        if self._used + self._transfer_reserved + incoming <= \
+                self.capacity:
+            # Over threshold but under hard capacity: admit, and let
+            # the async spiller work the utilization back down off the
+            # put path (fused batches on its io thread — inline
+            # spilling here would serialize one-file-per-object writes
+            # into every over-threshold put).
+            if self._spill_manager is not None:
+                self._spill_manager.request_spill()
+            return False
+        if incoming > self.capacity:
+            raise self._full_error(incoming, infeasible=True)
+        if not wait:
+            raise self._full_error(incoming)
+        cfg = get_config()
+        deadline = time.monotonic() + cfg.object_store_full_grace_period_s
+        retry_s = max(cfg.object_store_full_retry_ms, 1) / 1000.0
+        self._create_waiters += 1
+        self.stats["queued_creates"] += 1
+        t0 = time.monotonic()
+        try:
+            while self._used + self._transfer_reserved + incoming > \
+                    self.capacity:
+                if self._spill_manager is not None:
+                    # The io thread frees space off this thread; its
+                    # finish_spill_batch notify wakes us.  Inline
+                    # spilling here would run per-object disk writes
+                    # UNDER the store lock on every retry, stalling
+                    # every concurrent get/put behind file IO.
+                    self._spill_manager.request_spill()
+                else:
+                    # Bare store: entries sealed while we waited are
+                    # fresh candidates.
+                    self._spill_toward_locked(limit, incoming)
+                if self._used + self._transfer_reserved + incoming <= \
+                        self.capacity:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self.stats["create_queue_timeouts"] += 1
+                    raise self._full_error(incoming, queued=True)
+                self._lock.wait(timeout=min(remaining, retry_s))
+        finally:
+            self._create_waiters -= 1
+            self.stats["create_queue_wait_ms"] += \
+                (time.monotonic() - t0) * 1000.0
+        return True
+
+    def _full_error(self, incoming: int, infeasible: bool = False,
+                    queued: bool = False) -> exceptions.ObjectStoreFullError:
+        """Actionable OOM context: capacity vs request, in-flight
+        reservations, what is evictable, queue depth, segment holes."""
+        nid = getattr(self.node_id, "hex",
+                      lambda: str(self.node_id))()[:12]
+        evictable = sum(e.size for e in self._entries.values()
+                        if self._spillable_locked(e))
+        msg = (f"cannot reserve {incoming} bytes on node {nid}: "
+               f"{self._used}/{self.capacity} bytes used, "
+               f"{self._transfer_reserved} reserved by in-flight "
+               f"transfers, {evictable} evictable, "
+               f"{self._create_waiters} queued create(s)")
+        if self._native is not None:
+            try:
+                msg += (f"; native segment "
+                        f"{self._native.used_bytes()}"
+                        f"/{self._native.capacity} used, largest free "
+                        f"block {self._native.largest_free_block()}")
+            except Exception:
+                pass
+        if infeasible:
+            msg += ("; the object exceeds total store capacity and can "
+                    "NEVER fit — raise object_store_memory")
+        elif queued:
+            grace = get_config().object_store_full_grace_period_s
+            msg += (f"; queued {grace}s (object_store_full_grace_period"
+                    f"_s) without space freeing — raise "
+                    f"object_store_memory, lower "
+                    f"object_spilling_threshold, or check spill_dir "
+                    f"{self.spill_dir}")
+        err = exceptions.ObjectStoreFullError(msg)
+        # Callers that retry/queue on store-full (pulls, puts) must NOT
+        # retry the infeasible variant: the object can never fit, so
+        # retrying just converts the actionable message into a generic
+        # timeout after the full grace/pull deadline.
+        err.infeasible = bool(infeasible)
+        return err
 
     def _spill(self, object_id: ObjectID, e: _Entry):
+        """Synchronous single-object spill (eviction path; must hold
+        lock).  Re-spilling a restored entry is FREE: the on-disk bytes
+        are immutable, so the budget is released without rewriting."""
+        if e.spilled_path is not None:
+            if e.data is not None:
+                if isinstance(e.data, _NativeHandle):
+                    e.data.delete()
+                e.data = None
+                self._used -= e.size
+                self.stats["spilled_objects"] += 1
+                self._lock.notify_all()
+            return
         data = e.data
         path = os.path.join(self.spill_dir, object_id.hex())
+        fault_injection.hook("spill.write")
         if isinstance(data, _NativeHandle):
             # Stream the segment view straight to disk, THEN free: the
             # view is invalid once the allocator reuses the block.  (A
@@ -513,27 +784,179 @@ class NodeObjectStore:
             nbytes = data.flat_nbytes
             with open(path, "wb") as f:
                 f.write(data.to_bytes())
-        e.spilled_path = path
+        self._register_spill_locked(object_id, e, path, 0, nbytes)
+
+    def _register_spill_locked(self, object_id: ObjectID, e: _Entry,
+                               path: str, offset: int,
+                               nbytes: int) -> None:
+        """Publish a completed spill: record the URL, release the
+        budget, wake queued creates, and report the spilled_url to the
+        owner (reference_counter)."""
+        url = _spill_url(path, offset, nbytes)
+        e.spilled_path = url
         e.data = None
+        e.spilling = False
         self._used -= e.size
+        self._spill_files.setdefault(path, set()).add(object_id)
         self.stats["spilled_bytes"] += nbytes
         self.stats["spilled_objects"] += 1
+        self._lock.notify_all()
+        if self._on_spilled is not None:
+            try:
+                self._on_spilled(object_id, url)
+            except Exception:
+                pass
 
     def _restore(self, object_id: ObjectID, e: _Entry):
-        with open(e.spilled_path, "rb") as f:
-            blob = f.read()
+        path, offset, size = _parse_spill_url(e.spilled_path)
+        fault_injection.hook("restore.read")
+        with open(path, "rb") as f:
+            f.seek(offset)
+            blob = f.read(size)
         e.data = SerializedObject.from_bytes(blob)
         self._used += e.size
         self.stats["restored_bytes"] += len(blob)
         self.stats["restored_objects"] += 1
+        # Restores re-charge the budget without a capacity gate (a get
+        # must not deadlock on its own store): hand the overshoot to
+        # the async spiller so a restore-heavy read phase cannot pin
+        # utilization above the threshold indefinitely.
+        if self._spill_manager is not None and \
+                self._used + self._transfer_reserved > \
+                int(self.capacity * self.spill_threshold):
+            self._spill_manager.request_spill()
+
+    # ---- async-spill batch surface (LocalObjectManager) ----------------
+    def select_spill_victims(self, max_bytes: int):
+        """Pick LRU spill candidates totalling up to ``max_bytes``
+        (at least one if any exists), mark them ``spilling`` and pin
+        their native blocks so the copy-out can run OUTSIDE the store
+        lock.  Returns ``[(object_id, entry, source)]`` where source is
+        a pinned segment view or a SerializedObject."""
+        out = []
+        with self._lock:
+            now = time.monotonic()
+            candidates = sorted(
+                ((e.last_access, oid) for oid, e in self._entries.items()
+                 if self._spill_safe_locked(e, now)
+                 and e.spilled_path is None),
+                key=lambda t: t[0])
+            total = 0
+            for _, oid in candidates:
+                if out and total >= max_bytes:
+                    break
+                e = self._entries[oid]
+                source = e.data
+                if isinstance(source, _NativeHandle):
+                    if not self._native.pin(source.key):
+                        continue     # freed in the window
+                    view = source.read()
+                    if view is None:
+                        self._native.unpin(source.key)
+                        continue
+                    source = view
+                elif isinstance(source, DeviceObject):
+                    continue
+                e.spilling = True
+                total += e.size
+                out.append((oid, e, source))
+            # Restored-then-unpinned entries re-spill for free (bytes
+            # already on disk): fold them in — the shared recency guard
+            # keeps an eager re-spill from nulling the payload out from
+            # under an unpinned reader (restore -> respill -> failed
+            # pull loop under sustained pressure).  Recently-read
+            # entries just wait for the next sweep.
+            for oid, e in list(self._entries.items()):
+                if (e.spilled_path is not None and e.data is not None
+                        and self._spill_safe_locked(e, now)):
+                    self._spill(oid, e)
+        return out
+
+    def finish_spill_batch(self, path: str, results) -> int:
+        """Finalize an async batch: ``results`` is
+        ``[(object_id, entry, offset, nbytes, ok)]``.  Entries deleted
+        mid-copy are skipped (delete won; their file region is dead
+        weight until the file's last object goes).  Returns the number
+        of entries actually transitioned to spilled."""
+        done = 0
+        with self._lock:
+            for object_id, e, offset, nbytes, ok in results:
+                if isinstance(e.data, _NativeHandle):
+                    self._native.unpin(e.data.key)
+                current = self._entries.get(object_id)
+                if current is not e:
+                    e.spilling = False   # deleted mid-spill: delete won
+                    continue
+                if not ok:
+                    e.spilling = False
+                    self.stats["spill_errors"] += 1
+                    continue
+                if isinstance(e.data, _NativeHandle):
+                    e.data.delete()      # free the segment block
+                self._register_spill_locked(object_id, e, path, offset,
+                                            nbytes)
+                done += 1
+            self._lock.notify_all()
+        return done
+
+    def over_spill_threshold(self) -> bool:
+        with self._lock:
+            return self._used + self._transfer_reserved > \
+                int(self.capacity * self.spill_threshold)
+
+    def spill_shortfall(self) -> int:
+        """Bytes over the spill threshold (<= 0 when under it)."""
+        with self._lock:
+            return (self._used + self._transfer_reserved
+                    - int(self.capacity * self.spill_threshold))
+
+    def open_spilled_view(self, object_id: ObjectID):
+        """Zero-restore read surface over a spilled object: an mmap'd
+        view of its spill-file region, so a chunked transfer can be
+        served straight from disk without pulling the bytes back into
+        the store budget.  Returns ``(memoryview, release)`` or None."""
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is None or not e.sealed or e.spilled_path is None \
+                    or e.data is not None:
+                return None
+            url = e.spilled_path
+        path, offset, size = _parse_spill_url(url)
+        import mmap as mmap_mod
+        try:
+            f = open(path, "rb")
+        except OSError:
+            return None              # deleted in the window
+        try:
+            mm = mmap_mod.mmap(f.fileno(), 0, prot=mmap_mod.PROT_READ)
+        except (OSError, ValueError):
+            f.close()
+            return None
+        f.close()                    # mmap holds the file alive
+        view = memoryview(mm)[offset:offset + size]
+
+        def release(mm=mm, view=view):
+            try:
+                view.release()
+                mm.close()
+            except Exception:
+                pass
+
+        return view, release
 
     def spill_now(self) -> int:
-        """Force-spill all unpinned entries (test/chaos hook)."""
+        """Force-spill all spillable entries (test/chaos hook).
+        Reader-pinned entries are refused, same as the background
+        path."""
         n = 0
         with self._lock:
             for oid, e in list(self._entries.items()):
-                if e.data is not None and e.sealed and not e.is_device:
-                    self._spill(oid, e)
+                if self._spillable_locked(e):
+                    try:
+                        self._spill(oid, e)
+                    except Exception:
+                        self.stats["spill_errors"] += 1
+                        continue
                     n += 1
         return n
 
@@ -633,7 +1056,7 @@ class _SegmentTransferWriter:
                 return
             e = _Entry(data=_NativeHandle(store._native, key, self.nbytes),
                        size=self.nbytes)
-            e.pin_count = 1 if self._pin else 0
+            e.primary = self._pin
             store._entries[self._object_id] = e
             store._used += self.nbytes
             store._lock.notify_all()
@@ -687,10 +1110,17 @@ def segment_chunk_source(store: "NodeObjectStore"):
     """``get_source`` hook for :class:`ray_tpu.rpc.chunked.ChunkServer`:
     serve outgoing transfers straight from the store's shm segment under
     a native pin (released when the session closes), so the SENDER never
-    flattens the object either."""
+    flattens the object either.  SPILLED objects are served straight
+    from their spill-file region over an mmap — a remote pull never
+    forces a full in-memory restore on the sender."""
 
     def get_source(oid_bin: bytes):
-        native = store._native if store is not None else None
+        if store is None:
+            return None
+        spilled = store.open_spilled_view(ObjectID(oid_bin))
+        if spilled is not None:
+            return spilled
+        native = store._native
         if native is None:
             return None
         entry = store.get(ObjectID(oid_bin))

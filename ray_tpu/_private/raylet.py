@@ -15,10 +15,12 @@ import threading
 import time
 from typing import Callable, Dict, Optional
 
+from ray_tpu._private import fault_injection
 from ray_tpu._private.config import get_config
 from ray_tpu._private.cluster_task_manager import ClusterTaskManager
 from ray_tpu._private.event_loop import EventLoop
 from ray_tpu._private.ids import NodeID, PlacementGroupID
+from ray_tpu._private.local_object_manager import LocalObjectManager
 from ray_tpu._private.local_task_manager import LocalTaskManager
 from ray_tpu._private.object_manager import NodeObjectManager
 from ray_tpu._private.object_store import NodeObjectStore
@@ -41,12 +43,21 @@ class Raylet:
         self.cluster_view = ClusterResourceView()   # local (dirty) view
         self.loop = EventLoop(f"raylet-{self.node_id.hex()[:6]}")
         store_capacity = object_store_memory or cfg.object_store_memory
+        spill_dir = f"{cfg.temp_dir}/spill/{self.node_id.hex()[:8]}"
         self.object_store = NodeObjectStore(
             self.node_id,
             store_capacity,
-            spill_dir=f"{cfg.temp_dir}/spill/{self.node_id.hex()[:8]}",
+            spill_dir=spill_dir,
             spill_threshold=cfg.object_spilling_threshold,
-            native_backend=_maybe_native_store(cfg, store_capacity))
+            native_backend=_maybe_native_store(cfg, store_capacity),
+            on_spilled=self._record_spilled_url)
+        # Async spill IO thread (local_object_manager parity): moves
+        # over-threshold spilling off the put path and feeds the
+        # create-request queue.
+        self.local_object_manager = LocalObjectManager(
+            self.object_store, spill_dir,
+            node_label=self.node_id.hex()[:12])
+        self.object_store.attach_spill_manager(self.local_object_manager)
         self.worker_pool = WorkerPool(self)
         self.local_task_manager = LocalTaskManager(self)
         self.cluster_task_manager = ClusterTaskManager(self)
@@ -144,8 +155,34 @@ class Raylet:
             self.cluster_view.remove_node(node_id)
         self.cluster_task_manager.on_cluster_changed()
 
+    def _record_spilled_url(self, object_id, url: str):
+        """Spill callback: record the spilled_url with the owner's
+        reference counter (the reconstruction/debug surface the
+        reference keeps in the ObjectDirectory/owner table).
+
+        Posted to the event loop, never taken inline: the store invokes
+        this callback while HOLDING its lock, and the reference
+        counter's delete path runs its subscribers (which take the
+        store lock) while holding the refcount lock — recording
+        inline would be an ABBA deadlock between a spill publish and a
+        concurrent last-ref drop."""
+        core = self.core_worker or self.cluster.core_worker
+        if core is None:
+            return
+
+        def record():
+            try:
+                core.reference_counter.set_spilled_url(object_id, url)
+            except Exception:
+                pass
+        self.loop.post(record, "raylet.record_spilled_url")
+
     def _heartbeat(self):
         if not self._dead:
+            # Chaos point: an injected error/delay here simulates a
+            # partitioned or wedged node (missed beats -> declared
+            # dead) without killing the process.
+            fault_injection.hook("node.heartbeat")
             self.cluster.gcs.heartbeat_manager.heartbeat(self.node_id)
 
     def _heartbeat_loop(self, period_s: float):
@@ -237,6 +274,7 @@ class Raylet:
         self._dead = True
         self.worker_pool.shutdown()
         self.object_manager.stop()
+        self.local_object_manager.stop()
         self.loop.stop()
 
     def shutdown(self):
@@ -246,6 +284,7 @@ class Raylet:
         self.cluster.gcs.unregister_raylet(self.node_id)
         self.worker_pool.shutdown()
         self.object_manager.stop()
+        self.local_object_manager.stop()
         self.loop.stop()
 
     def debug_string(self) -> str:

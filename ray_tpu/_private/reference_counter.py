@@ -90,7 +90,10 @@ class ReferenceCounter:
             ref = self._refs.get(object_id)
             if ref is None:
                 return
-            ref.local_refs -= 1
+            # Floored: a duplicate decrement must degrade to a leak, not
+            # a negative count that cancels out refs someone else holds
+            # and frees the object under them.
+            ref.local_refs = max(0, ref.local_refs - 1)
             self._maybe_delete(object_id)
 
     # ---- task-arg refs --------------------------------------------------
@@ -105,7 +108,7 @@ class ReferenceCounter:
                 ref = self._refs.get(oid)
                 if ref is None:
                     continue
-                ref.submitted_task_refs -= 1
+                ref.submitted_task_refs = max(0, ref.submitted_task_refs - 1)
                 self._maybe_delete(oid)
 
     # ---- queries --------------------------------------------------------
@@ -133,6 +136,24 @@ class ReferenceCounter:
             ref = self._refs.get(object_id)
             if ref is not None:
                 ref.pinned_node = node_id
+
+    def describe(self, object_id: ObjectID) -> Optional[dict]:
+        """Debug/error-context snapshot of one reference (ownership,
+        counts, pinned node, spill record) — feeds the actionable
+        ObjectLostError message."""
+        with self._lock:
+            ref = self._refs.get(object_id)
+            if ref is None:
+                return None
+            return {
+                "owned": ref.owned,
+                "local_refs": ref.local_refs,
+                "submitted_task_refs": ref.submitted_task_refs,
+                "borrowers": len(ref.borrowers),
+                "pinned_node": ref.pinned_node,
+                "spilled_url": ref.spilled_url,
+                "out_of_scope": ref.out_of_scope,
+            }
 
     def set_spilled_url(self, object_id: ObjectID, url: str):
         with self._lock:

@@ -70,8 +70,18 @@ class TaskManager:
     def complete_task(self, spec: TaskSpec):
         from ray_tpu.gcs import task_events
         with self._lock:
-            self._pending.pop(spec.task_id, None)
+            t = self._pending.pop(spec.task_id, None)
             self._completion_cv.notify_all()
+        if t is None:
+            # Stale/duplicate completion: a retried task's original
+            # attempt landing after the retry already transitioned it,
+            # or two failure paths racing on node death.  The first
+            # terminal transition already removed the submitted-task
+            # refs — removing them again would drive the args' counts
+            # negative and prematurely free objects the driver still
+            # holds (observed as lost-object + evicted-lineage in the
+            # sigkill chaos test).
+            return
         task_events.emit(self._core.cluster, spec.task_id,
                          task_events.FINISHED)
         self._core.reference_counter.remove_submitted_task_refs(
@@ -115,8 +125,13 @@ class TaskManager:
         """Store the error into all return objects so gets raise."""
         from ray_tpu.gcs import task_events
         with self._lock:
-            self._pending.pop(spec.task_id, None)
+            t = self._pending.pop(spec.task_id, None)
             self._completion_cv.notify_all()
+        if t is None:
+            # Duplicate terminal transition (see complete_task): the
+            # task already completed or failed — don't double-remove
+            # arg refs, and don't overwrite sealed returns with errors.
+            return
         task_events.emit(self._core.cluster, spec.task_id,
                          task_events.FAILED, error=repr(error))
         for oid in spec.return_ids:

@@ -346,7 +346,7 @@ def cluster_resources() -> dict:
 
 def available_resources() -> dict:
     w = _require_connected()
-    return w.cluster.gcs.resource_manager.view.available_cluster_resources()
+    return w.cluster.gcs.resource_manager.live_available_resources()
 
 
 def timeline() -> list:

@@ -129,12 +129,18 @@ def objects_from_cluster(cluster, filters=None, limit: Optional[int] = None,
         if entries is None:
             continue
         for oid, entry in list(entries.items()):
+            spilled_url = getattr(entry, "spilled_path", None)
             rows.append({
                 "object_id": oid.hex() if hasattr(oid, "hex") else str(oid),
                 "node_id": raylet.node_id.hex(),
                 "size_bytes": getattr(entry, "size", 0),
                 "sealed": bool(getattr(entry, "sealed", True)),
                 "pin_count": getattr(entry, "pin_count", 0),
+                # In-memory data gone + a spill URL = the bytes live on
+                # disk only (a restored copy shows spilled=False).
+                "spilled": bool(spilled_url
+                                and getattr(entry, "data", None) is None),
+                "spilled_url": spilled_url or "",
             })
     return _paginate(_apply_filters(rows, filters), limit, offset)
 

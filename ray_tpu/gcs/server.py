@@ -178,7 +178,16 @@ class GcsResourceManager:
 
     def register_raylet(self, node_id: NodeID, raylet, resources: NodeResources):
         self._raylets[node_id] = raylet
-        self.view.add_node(node_id, resources)
+        # COPY, never alias: for in-process raylets ``resources`` is the
+        # raylet's own local_resources — the exact ledger its scheduler
+        # allocates/releases against.  _poll_and_broadcast writes polled
+        # availability snapshots back into this view's row
+        # (update_available), and through an alias that write ERASES any
+        # allocate/release that raced the poll: a stale all-CPUs-busy
+        # report then permanently zeroes the node (every later report
+        # re-reads the poisoned value) and its tasks spin unschedulable
+        # — the long-standing "lost dispatch" hang.
+        self.view.add_node(node_id, resources.copy())
         self._needs_full.add(node_id)
 
     def unregister_raylet(self, node_id: NodeID):
@@ -187,6 +196,39 @@ class GcsResourceManager:
         self._needs_full.discard(node_id)
         self._removed_pending.add(node_id)
         self.view.remove_node(node_id)
+
+    def live_available_resources(self) -> Dict[str, float]:
+        """Exact cluster availability for the debug/state API
+        (``ray_tpu.available_resources``): in-process raylets are read
+        straight from their authoritative local_resources ledgers (zero
+        staleness — the merge view's copied rows lag one poll period);
+        remote nodes fall back to their latest polled row."""
+        out: Dict[str, float] = {}
+        for node_id, raylet in list(self._raylets.items()):
+            ledger = None
+            if not getattr(raylet, "is_remote_proxy", False):
+                ledger = getattr(raylet, "local_resources", None)
+            if ledger is not None:
+                # release() can INSERT a key into the availability dict
+                # mid-iteration; retry the snapshot until clean (bounded
+                # — a public debug API must not leak RuntimeError).
+                for _ in range(8):
+                    try:
+                        av = ledger.to_float_dict("available")
+                        break
+                    except RuntimeError:
+                        continue
+                else:
+                    row = self.view.node_resources(node_id)
+                    av = row.to_float_dict("available") \
+                        if row is not None else {}
+            else:
+                row = self.view.node_resources(node_id)
+                av = row.to_float_dict("available") \
+                    if row is not None else {}
+            for k, v in av.items():
+                out[k] = out.get(k, 0.0) + v
+        return out
 
     def _poll_and_broadcast(self):
         # Poll each raylet's local resource usage (RequestResourceReport),

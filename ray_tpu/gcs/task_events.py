@@ -14,6 +14,10 @@ Lifecycle (task_events.proto ``TaskStatus`` subset)::
     PENDING_ARGS_AVAIL -> SCHEDULED -> SUBMITTED_TO_WORKER -> RUNNING
                                    -> FINISHED | FAILED
 
+plus ``RECONSTRUCTING``: lineage reconstruction resubmitted a finished
+task to recompute a lost object — the record rewinds (attempt bumps,
+like a retry) and runs the lifecycle again.
+
 Loss semantics are explicit, never silent: the emitter-side buffer is
 bounded (events past ``max_buffer`` are dropped and counted), each
 flushed batch carries the cumulative drop counter, and the GCS-side
@@ -38,10 +42,17 @@ SUBMITTED_TO_WORKER = "SUBMITTED_TO_WORKER"
 RUNNING = "RUNNING"
 FINISHED = "FINISHED"
 FAILED = "FAILED"
+# Lineage reconstruction resubmitted this (already finished) task to
+# recompute a lost return object.  Emitted with a BUMPED attempt
+# counter, which is what rewinds the record out of its terminal state
+# (same mechanism as ordinary retries); it sits first in STATE_ORDER so
+# the resubmission's own PENDING->...->FINISHED transitions move the
+# record forward again.
+RECONSTRUCTING = "RECONSTRUCTING"
 
 # Canonical ordering, used by consumers to sanity-check transitions.
-STATE_ORDER = (PENDING_ARGS_AVAIL, SCHEDULED, SUBMITTED_TO_WORKER,
-               RUNNING, FINISHED, FAILED)
+STATE_ORDER = (RECONSTRUCTING, PENDING_ARGS_AVAIL, SCHEDULED,
+               SUBMITTED_TO_WORKER, RUNNING, FINISHED, FAILED)
 TERMINAL_STATES = (FINISHED, FAILED)
 
 # Per-task history cap: a lifecycle is ~6 transitions; retries add a

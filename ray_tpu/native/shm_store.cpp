@@ -163,6 +163,20 @@ class Allocator {
     FlushBinsLocked();
   }
 
+  // Largest contiguous hole after coalescing the bins — the honest
+  // answer to "would a block of size N fit right now?".  Diagnostic
+  // path (OOM error context + backpressure decisions), not the hot
+  // allocation path, so the full flush+scan cost is acceptable.
+  uint64_t LargestFree() {
+    std::lock_guard<std::mutex> g(mu_);
+    FlushBinsLocked();
+    uint64_t best = 0;
+    for (const auto& kv : free_by_offset_) {
+      if (kv.second > best) best = kv.second;
+    }
+    return best;
+  }
+
  private:
   int64_t FirstFitLocked(uint64_t size) {
     for (auto it = free_by_offset_.begin(); it != free_by_offset_.end();
@@ -416,6 +430,8 @@ class ShmStore {
     return 0;
   }
 
+  uint64_t LargestFreeBlock() { return alloc_.LargestFree(); }
+
   uint64_t Used() const { return used_.load(std::memory_order_relaxed); }
   uint64_t Capacity() const { return capacity_; }
   uint64_t NumObjects() const {
@@ -498,6 +514,10 @@ uint64_t store_used(void* s) { return static_cast<ShmStore*>(s)->Used(); }
 
 uint64_t store_capacity(void* s) {
   return static_cast<ShmStore*>(s)->Capacity();
+}
+
+uint64_t store_largest_free(void* s) {
+  return static_cast<ShmStore*>(s)->LargestFreeBlock();
 }
 
 uint64_t store_num_objects(void* s) {

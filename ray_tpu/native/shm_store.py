@@ -30,6 +30,15 @@ _SO = os.path.join(os.path.dirname(__file__), "_build", "libshm_store.so")
 _RESERVED_LOCK = threading.Lock()
 _RESERVED_BYTES = 0
 
+#: ``try_create`` status codes — the retriable-OOM create surface
+#: (plasma ``PlasmaError``: OK / ObjectExists / OutOfMemory).  OOM is a
+#: CODE, not an exception: the caller's create-request queue retries it
+#: as seals/evictions/spills free space instead of unwinding.
+CREATE_OK = 0
+CREATE_DUPLICATE = 1     # key already present (sealed or mid-write)
+CREATE_PENDING = 2       # deleted-pending: freed on last client unpin
+CREATE_OOM = 3           # retriable: no block fits right now
+
 
 def reserved_bytes() -> int:
     """Total capacity of segments currently open in THIS process."""
@@ -94,6 +103,8 @@ def _load() -> ctypes.CDLL:
     lib.store_choose_victims.argtypes = [
         ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p,
         ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint64)]
+    lib.store_largest_free.restype = ctypes.c_uint64
+    lib.store_largest_free.argtypes = [ctypes.c_void_p]
     return lib
 
 
@@ -147,15 +158,30 @@ class NativeShmStore:
         return memoryview(self._mm)[offset:offset + size]
 
     # ---- plasma create/seal lifecycle (client writes through shm) -----
-    def create(self, key: bytes, size: int) -> Optional[int]:
-        """Reserve `size` bytes; returns the offset the writer fills
-        through its own mapping, or None on duplicate/deleted-pending.
-        Raises MemoryError when the segment cannot fit the block (the
-        caller runs the eviction-retry flow, create_request_queue.h)."""
+    def try_create(self, key: bytes, size: int):
+        """Reserve ``size`` bytes without throwing: returns
+        ``(status, offset)`` where status is one of the ``CREATE_*``
+        codes and offset is valid only for ``CREATE_OK``.  ``CREATE_OOM``
+        is RETRIABLE — the caller's create-request queue evicts/spills
+        and retries rather than failing the put
+        (create_request_queue.h semantics)."""
         off = self._lib.store_create(self._handle, key, len(key), size)
+        if off >= 0:
+            return CREATE_OK, int(off)
         if off == -1:
+            return CREATE_OOM, -1
+        if off == -3:
+            return CREATE_PENDING, -1
+        return CREATE_DUPLICATE, -1
+
+    def create(self, key: bytes, size: int) -> Optional[int]:
+        """Legacy throwing wrapper over :meth:`try_create` (kept for
+        direct store users/tests): returns the offset, None on
+        duplicate/deleted-pending, raises MemoryError on OOM."""
+        status, off = self.try_create(key, size)
+        if status == CREATE_OOM:
             raise MemoryError("native store full")
-        return None if off < 0 else int(off)
+        return off if status == CREATE_OK else None
 
     def seal(self, key: bytes) -> bool:
         return self._lib.store_seal(self._handle, key, len(key)) == 0
@@ -198,6 +224,12 @@ class NativeShmStore:
 
     def used_bytes(self) -> int:
         return self._lib.store_used(self._handle)
+
+    def largest_free_block(self) -> int:
+        """Largest contiguous hole the allocator could hand out right
+        now (coalesces the bins first) — OOM diagnostics: total free
+        can exceed a request while no single hole fits it."""
+        return self._lib.store_largest_free(self._handle)
 
     def num_objects(self) -> int:
         return self._lib.store_num_objects(self._handle)

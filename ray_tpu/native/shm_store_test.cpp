@@ -38,6 +38,8 @@ int store_choose_victims(void* s, uint64_t needed, uint8_t* out,
                          uint32_t out_cap, uint64_t* covered);
 uint64_t store_used(void* s);
 uint64_t store_num_objects(void* s);
+uint64_t store_capacity(void* s);
+uint64_t store_largest_free(void* s);
 }
 
 namespace {
@@ -96,10 +98,65 @@ void Worker(void* store, int id, int iters) {
     uint32_t kl2 = static_cast<uint32_t>(key2.size());
     int64_t off2 = store_create(store, kb2, kl2, 512);
     if (off2 >= 0) {
+      // Pin across seal: once sealed, any OOM-pressed peer may evict
+      // an UNPINNED object at will, so the get below would race.
+      CHECK(store_pin(store, kb2, kl2) == 0);
       CHECK(store_get(store, kb2, kl2, &o, &sz) == -1);  // unsealed
       CHECK(store_seal(store, kb2, kl2) == 0);
       CHECK(store_get(store, kb2, kl2, &o, &sz) == 0);
+      CHECK(store_unpin(store, kb2, kl2) == 0);
       store_delete(store, kb2, kl2);
+    }
+  }
+}
+
+// Retriable-OOM create flow (create_request_queue parity): drive the
+// segment to OOM with large create/seal reservations, then recover via
+// choose_victims + delete (the spill-free path: the Python side copies
+// the bytes to disk BEFORE delete; here we only exercise the native
+// free) and retry the create.  Every OOM must be a -1 code, never an
+// abort, and after eviction the create must eventually succeed.
+void OomWorker(void* store, int id, int iters) {
+  const uint64_t big = 256 * 1024;
+  for (int i = 0; i < iters; i++) {
+    std::string key = "oom-" + Key(id, i);
+    const uint8_t* kb = reinterpret_cast<const uint8_t*>(key.data());
+    uint32_t kl = static_cast<uint32_t>(key.size());
+    int64_t off = store_create(store, kb, kl, big);
+    int attempts = 0;
+    while (off == -1 && attempts++ < 64) {
+      // Diagnostic surface must stay consistent under concurrency.
+      CHECK(store_largest_free(store) <= store_capacity(store));
+      uint8_t buf[1 << 14];
+      uint64_t covered = 0;
+      int n = store_choose_victims(store, big * 2, buf, sizeof(buf),
+                                   &covered);
+      uint32_t pos = 0;
+      for (int v = 0; v < n; v++) {
+        uint32_t len;
+        std::memcpy(&len, buf + pos, 4);
+        // Spill-free path: a pinned victim must survive the delete
+        // until unpin (another thread may be mid-read through its
+        // mapping); an unpinned one frees immediately.
+        if (store_pin(store, buf + pos + 4, len) == 0) {
+          store_delete(store, buf + pos + 4, len);
+          store_unpin(store, buf + pos + 4, len);
+        } else {
+          store_delete(store, buf + pos + 4, len);
+        }
+        pos += 4 + len;
+      }
+      off = store_create(store, kb, kl, big);
+    }
+    if (off >= 0) {
+      // Pin across seal: a concurrent evictor may take any unpinned
+      // sealed object between our seal and get.
+      CHECK(store_pin(store, kb, kl) == 0);
+      CHECK(store_seal(store, kb, kl) == 0);
+      uint64_t o = 0, sz = 0;
+      CHECK(store_get(store, kb, kl, &o, &sz) == 0);
+      CHECK(sz == big);
+      CHECK(store_unpin(store, kb, kl) == 0);
     }
   }
 }
@@ -117,6 +174,11 @@ int main() {
   std::vector<std::thread> threads;
   for (int t = 0; t < kThreads; t++) {
     threads.emplace_back(Worker, store, t, kIters);
+  }
+  // Concurrent OOM-pressure workers: retriable-OOM create + evict +
+  // retry against the same segment the lifecycle workers churn.
+  for (int t = 0; t < 4; t++) {
+    threads.emplace_back(OomWorker, store, kThreads + t, 64);
   }
   for (auto& th : threads) th.join();
   std::fprintf(stderr, "objects=%llu used=%llu failures=%d\n",

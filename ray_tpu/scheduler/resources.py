@@ -118,6 +118,19 @@ class NodeResources:
             self.available[k] = min(self.total.get(k, 0),
                                     self.available.get(k, 0) + v)
 
+    def copy(self) -> "NodeResources":
+        """Value copy.  A NodeResources is a mutable accounting ledger
+        (allocate/release), so two views must never share one instance:
+        a holder that overwrites ``available`` from a snapshot (e.g. a
+        usage-report merge) would erase the other's in-flight
+        allocations."""
+        nr = NodeResources.__new__(NodeResources)
+        nr.total = dict(self.total)
+        nr.available = dict(self.available)
+        nr.labels = dict(self.labels)
+        nr.draining = self.draining
+        return nr
+
     def to_float_dict(self, which: str = "available") -> Dict[str, float]:
         src = self.available if which == "available" else self.total
         return {k: v / FP_SCALE for k, v in src.items()}

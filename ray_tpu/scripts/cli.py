@@ -207,13 +207,17 @@ def cmd_memory(args) -> int:
     finally:
         client.close()
     print(f"{'NODE':18} {'OBJECTS':>8} {'USED':>12} {'CAPACITY':>12} "
-          f"{'SPILLED':>10} {'EVICTED':>8}")
+          f"{'SPILLED':>10} {'RESTORED':>9} {'EVICTED':>8} "
+          f"{'QUEUED':>7} {'QWAIT_MS':>9}")
     for r in rows:
         stats = r.get("stats", {})
         print(f"{r['node']:18} {r['num_objects']:>8} "
               f"{r['used_bytes']:>12} {r['capacity_bytes']:>12} "
               f"{stats.get('spilled_objects', 0):>10} "
-              f"{stats.get('evicted_objects', 0):>8}")
+              f"{stats.get('restored_objects', 0):>9} "
+              f"{stats.get('evicted_objects', 0):>8} "
+              f"{stats.get('queued_creates', 0):>7} "
+              f"{stats.get('create_queue_wait_ms', 0.0):>9.1f}")
     return 0
 
 
@@ -244,7 +248,7 @@ def cmd_list(args) -> int:
                   "duration_s"),
         "actors": ("actor_id", "state", "name"),
         "objects": ("object_id", "node_id", "size_bytes", "sealed",
-                    "pin_count"),
+                    "pin_count", "spilled"),
         "nodes": ("node_id", "node_name", "state"),
     }[args.resource]
     print(" ".join(f"{c.upper():20}" for c in columns))

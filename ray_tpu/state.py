@@ -53,7 +53,7 @@ class GlobalState:
         return self._gcs().resource_manager.view.total_cluster_resources()
 
     def available_resources(self) -> dict:
-        return self._gcs().resource_manager.view.available_cluster_resources()
+        return self._gcs().resource_manager.live_available_resources()
 
     def chrome_tracing_dump(self) -> List[dict]:
         from ray_tpu.util import tracing

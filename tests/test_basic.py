@@ -237,22 +237,22 @@ def test_function_id_not_confused_by_id_reuse(ray_start_regular):
     for i in range(50):
         def different(x, _i=i):
             return ("different", x, _i)
-        # One resubmit on timeout: this test's subject is WRONG-FUNCTION
-        # detection (the equality assert below stays strict) — but on a
-        # loaded full-suite run a rare, longstanding dispatch ghost can
-        # swallow a single task (the seed's "one flaky failure in the
-        # first 17% of the alphabetical run", VERDICT weak-#5), which
-        # would fail this test for an unrelated reason.  A lost dispatch
-        # is recovered by resubmitting; a function-id confusion is NOT
-        # (the wrong result returns promptly and the assert fires).
+        # No resubmit-on-timeout workaround anymore: the seed-era "lost
+        # dispatch" ghost is fixed at the source.  Root cause: the GCS
+        # resource-manager view ALIASED the raylet's local_resources
+        # ledger, so its usage-poll write-back (update_available) could
+        # erase allocate/release calls that raced the poll — a stale
+        # all-CPUs-busy snapshot (this test's 5 burst probes) then
+        # permanently zeroed the node's availability and every later
+        # task spun unschedulable until get() timed out.  The GCS row
+        # is now a value copy (gcs/server.py register_raylet), and the
+        # batched scheduler no longer parks merely-BUSY tasks in the
+        # membership-gated _infeasible queue.  Every pop->reply edge in
+        # cluster_task_manager also requeues on tick-thread failure
+        # (tests/test_chaos.py pins that with an injected dispatch
+        # fault).
         fn = ray_tpu.remote(different)
-        for attempt in range(2):
-            try:
-                out = ray_tpu.get(fn.remote(7), timeout=60)
-                break
-            except ray_tpu.exceptions.GetTimeoutError:
-                if attempt == 1:
-                    raise
+        out = ray_tpu.get(fn.remote(7), timeout=60)
         assert out == ("different", 7, i), out
         hits += 1
         del different, fn

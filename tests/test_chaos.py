@@ -1,0 +1,394 @@
+"""Chaos suite over the deterministic fault-injection harness
+(reference: test_chaos.py NodeKillerActor, test_reconstruction*.py —
+but count-based named failure points instead of random kills, so every
+failure here is reproducible).
+
+Two tiers share the ``chaos`` marker:
+
+* deterministic fault-point tests — arm a named point, drive the
+  runtime through it, assert the failure was absorbed the way the
+  design says (requeue, fail-closed, retry) AND that the fault really
+  fired (a chaos test whose fault never triggered proves nothing);
+* the acceptance scenario — SIGKILL a node-host OS process
+  mid-broadcast under memory pressure and complete the workload via
+  lineage reconstruction.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import fault_injection
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.object_store import NodeObjectStore, entry_value
+from ray_tpu._private.serialization import serialize
+from ray_tpu._private.worker import global_worker
+
+pytestmark = pytest.mark.chaos
+
+_MB = 1024 * 1024
+
+
+@pytest.fixture(autouse=True)
+def _fault_isolation():
+    """Armings and fired counters never leak across tests."""
+    fault_injection.reset()
+    yield
+    fault_injection.reset()
+
+
+# ---------------------------------------------------------------------------
+# the harness itself
+# ---------------------------------------------------------------------------
+
+def test_fault_point_semantics():
+    """Count/skip arming is exact: skip hits pass, the next `count`
+    hits fire, later hits pass; fired counters survive disarm."""
+    fault_injection.arm("x.point", "error", count=2, skip=1)
+    fault_injection.hook("x.point")                      # skipped
+    for _ in range(2):
+        with pytest.raises(fault_injection.FaultInjectedError):
+            fault_injection.hook("x.point")
+    fault_injection.hook("x.point")                      # exhausted
+    assert fault_injection.fired("x.point") == 2
+    fault_injection.disarm("x.point")
+    assert fault_injection.fired("x.point") == 2
+    # Env-var form (how spawned daemons inherit a test's arming).
+    fault_injection.load_from_env("y.point:delay:3:0.0,bad-entry")
+    t0 = time.monotonic()
+    fault_injection.hook("y.point")
+    assert time.monotonic() - t0 < 1.0
+    assert fault_injection.fired("y.point") == 1
+
+
+# ---------------------------------------------------------------------------
+# worker.dispatch — the seed-era lost-dispatch ghost, pinned
+# ---------------------------------------------------------------------------
+
+def test_dispatch_fault_requeues_instead_of_losing_task(ray_start_regular):
+    """An exception between a task's queue-pop and its lease reply used
+    to silently lose the lease (the seed flake in
+    test_function_id_not_confused_by_id_reuse).  Now the pop->reply
+    edge requeues on failure: an injected dispatch fault delays the
+    task one tick instead of hanging its caller forever."""
+    fault_injection.arm("worker.dispatch", "error", count=1)
+
+    @ray_tpu.remote
+    def probe(x):
+        return x * 3
+
+    assert ray_tpu.get(probe.remote(14), timeout=30) == 42
+    assert fault_injection.fired("worker.dispatch") == 1, \
+        "the dispatch fault never fired — the test proved nothing"
+    head = global_worker().cluster.head_node
+    assert head.cluster_task_manager.tick_stats["dispatch_errors"] >= 1
+
+
+def test_persistent_dispatch_fault_escalates_not_livelocks(
+        ray_start_regular):
+    """A dispatch path that fails EVERY time must escalate to the
+    submitter (bounded requeues -> lease rejection -> the task's retry
+    budget -> a real error) instead of livelocking the tick loop in an
+    endless pop->fail->requeue cycle."""
+    fault_injection.arm("worker.dispatch", "error", count=-1)
+    try:
+        @ray_tpu.remote(max_retries=1)
+        def doomed():
+            return 1
+
+        with pytest.raises(ray_tpu.exceptions.RayTpuError,
+                           match="dispatch failed"):
+            ray_tpu.get(doomed.remote(), timeout=60)
+    finally:
+        fault_injection.disarm("worker.dispatch")
+    # And the scheduler is healthy again once the fault clears.
+    @ray_tpu.remote
+    def fine():
+        return 7
+
+    assert ray_tpu.get(fine.remote(), timeout=30) == 7
+
+
+def _consumer_spec(arg_oid):
+    """A real consumer TaskSpec referencing ``arg_oid`` — drives the
+    task manager's terminal transitions directly."""
+    from ray_tpu._private.ids import FunctionID, JobID, TaskID, WorkerID
+    from ray_tpu._private.task_spec import TaskArg, TaskSpec
+    from ray_tpu.scheduler.policy import SchedulingOptions
+    from ray_tpu.scheduler.resources import ResourceRequest
+    return TaskSpec(
+        task_id=TaskID.from_random(), job_id=JobID.next(),
+        task_type="NORMAL_TASK", function_id=FunctionID.from_random(),
+        function_name="stale_consumer",
+        args=[TaskArg(is_inline=False, object_id=arg_oid)],
+        num_returns=1, resources=ResourceRequest({"CPU": 1.0}),
+        scheduling_options=SchedulingOptions.hybrid(),
+        scheduling_class=1, owner_id=WorkerID.from_random())
+
+
+def test_duplicate_terminal_transition_is_idempotent(ray_start_regular):
+    """A retried task's original attempt can land AFTER the retry
+    already terminally transitioned the task, and two node-death
+    failure paths can race to fail the same attempt.  The duplicate
+    complete/fail must be a no-op: double-removing the args'
+    submitted-task refs drives the count negative, cancels out the
+    driver's live local ref, and ``_free_object`` then deletes every
+    copy AND the pinned lineage of an object the driver still holds —
+    the rare lost-object failure of the sigkill acceptance test."""
+    @ray_tpu.remote
+    def produce():
+        return np.arange(1024, dtype=np.int32)
+
+    ref = produce.remote()
+    expect = np.arange(1024, dtype=np.int32)
+    np.testing.assert_array_equal(ray_tpu.get(ref, timeout=30), expect)
+    cw = global_worker().core_worker
+    rc = cw.reference_counter
+    tm = cw.task_manager
+    oid = ref.object_id()
+    assert rc.has_reference(oid)
+    assert tm.lineage_spec_for_object(oid) is not None
+
+    spec = _consumer_spec(oid)
+    tm.add_pending_task(spec)
+    tm.complete_task(spec)
+    # Every duplicate-terminal flavor observed under chaos:
+    tm.complete_task(spec)                                   # late success
+    tm.fail_task(spec, ray_tpu.exceptions.RayTpuError("stale failure"))
+
+    assert rc.has_reference(oid), \
+        "duplicate terminal transition freed an object the driver holds"
+    d = rc.describe(oid)
+    assert d["local_refs"] >= 1 and d["submitted_task_refs"] == 0
+    assert tm.lineage_spec_for_object(oid) is not None, \
+        "duplicate terminal transition evicted pinned lineage"
+    # The stale fail must not have overwritten the sealed return either.
+    np.testing.assert_array_equal(ray_tpu.get(ref, timeout=30), expect)
+
+
+# ---------------------------------------------------------------------------
+# spill.write / restore.read — IO faults fail closed
+# ---------------------------------------------------------------------------
+
+def test_spill_write_fault_skips_victim_keeps_bytes(tmp_path):
+    """A failed spill write must leave the victim hot and readable
+    (fail closed), not half-spilled; the next spill succeeds."""
+    store = NodeObjectStore(node_id=ObjectID.from_random(),
+                            capacity_bytes=8 * _MB,
+                            spill_dir=str(tmp_path))
+    oid = ObjectID.from_random()
+    value = np.arange(_MB, dtype=np.uint8) % 251
+    store.put(oid, serialize(value))
+    fault_injection.arm("spill.write", "error", count=1)
+    assert store.spill_now() == 0
+    assert store.stats["spill_errors"] == 1
+    e = store.get(oid)
+    assert e is not None and e.data is not None, \
+        "victim of a failed spill must stay hot"
+    assert store.spill_now() == 1          # fault exhausted: succeeds
+    np.testing.assert_array_equal(entry_value(store.get(oid)), value)
+
+
+def test_async_spiller_survives_spill_fault(tmp_path):
+    """The io thread absorbs an injected batch-write failure (victims
+    unmarked, partial file dropped) and completes on its retry."""
+    from ray_tpu._private.local_object_manager import LocalObjectManager
+    store = NodeObjectStore(node_id=ObjectID.from_random(),
+                            capacity_bytes=4 * _MB,
+                            spill_dir=str(tmp_path),
+                            spill_threshold=0.5)
+    mgr = LocalObjectManager(store, str(tmp_path), node_label="chaos")
+    store.attach_spill_manager(mgr)
+    try:
+        fault_injection.arm("spill.write", "error", count=1)
+        oids, values = [], []
+        for i in range(6):
+            oid = ObjectID.from_random()
+            v = np.full(512 * 1024, i, dtype=np.uint8)
+            store.put(oid, serialize(v))
+            oids.append(oid)
+            values.append(v)
+        mgr.request_spill()
+        deadline = time.monotonic() + 10.0
+        while store.spill_shortfall() > 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert store.spill_shortfall() <= 0, \
+            "spiller never recovered from the injected write fault"
+        assert fault_injection.fired("spill.write") >= 1
+        assert mgr.stats["spill_errors"] >= 1
+        for oid, v in zip(oids, values):
+            np.testing.assert_array_equal(entry_value(store.get(oid)), v)
+    finally:
+        mgr.stop()
+
+
+def test_restore_read_fault_surfaces_then_recovers(tmp_path):
+    """A failed restore read surfaces to the caller (no silent
+    corruption); the bytes stay on disk so the retry succeeds."""
+    store = NodeObjectStore(node_id=ObjectID.from_random(),
+                            capacity_bytes=8 * _MB,
+                            spill_dir=str(tmp_path))
+    oid = ObjectID.from_random()
+    value = np.arange(_MB, dtype=np.uint8) % 241
+    store.put(oid, serialize(value))
+    assert store.spill_now() == 1
+    fault_injection.arm("restore.read", "error", count=1)
+    with pytest.raises(fault_injection.FaultInjectedError):
+        store.get(oid)
+    np.testing.assert_array_equal(entry_value(store.get(oid)), value)
+    assert store.stats["restored_objects"] == 1
+
+
+# ---------------------------------------------------------------------------
+# transfer.chunk — a torn transfer is retried/reconstructed, not trusted
+# ---------------------------------------------------------------------------
+
+def test_transfer_chunk_fault_recovers(ray_start_cluster):
+    """An injected per-chunk failure aborts the transfer writer (the
+    receiver never seals torn bytes) and the get loop recovers — by
+    re-pull or lineage resubmission — to the full, correct value."""
+    cluster = ray_start_cluster(num_cpus=1)
+    cluster.add_node(num_cpus=1, resources={"prod": 1})
+    assert cluster.wait_for_nodes(2)
+
+    @ray_tpu.remote(resources={"prod": 0.1}, num_cpus=0, max_retries=2)
+    def produce():
+        return np.arange(2 * _MB, dtype=np.uint8) % 239
+
+    ref = produce.remote()
+    ready, _ = ray_tpu.wait([ref], timeout=30)
+    assert ready
+    fault_injection.arm("transfer.chunk", "error", count=1)
+    out = ray_tpu.get(ref, timeout=60)
+    np.testing.assert_array_equal(out, np.arange(2 * _MB,
+                                                 dtype=np.uint8) % 239)
+    assert fault_injection.fired("transfer.chunk") >= 1, \
+        "the chunk fault never fired — the pull path was not exercised"
+
+
+# ---------------------------------------------------------------------------
+# node.heartbeat — a wedged (not dead) node is declared dead
+# ---------------------------------------------------------------------------
+
+_WIRE_CONFIG = {
+    "scheduler_backend": "native",
+    "raylet_heartbeat_period_milliseconds": 50,
+    "num_heartbeats_timeout": 20,
+    "gcs_resource_broadcast_period_milliseconds": 50,
+}
+
+
+def _wait_until(pred, timeout=20.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def test_heartbeat_fault_declares_live_process_dead():
+    """Arm node.heartbeat (via the env var a spawned daemon parses at
+    import) in a REAL node-host process: the process stays alive but
+    every beat fails, so the GCS declares it dead — the partitioned /
+    wedged-node failure mode, distinct from process death."""
+    ray_tpu.init(num_cpus=1, _system_config=dict(_WIRE_CONFIG))
+    try:
+        cluster = global_worker().cluster
+        os.environ["RAY_TPU_FAULT_POINTS"] = "node.heartbeat:error:-1"
+        try:
+            handle = cluster.add_remote_node(num_cpus=1,
+                                             resources={"wedge": 1.0})
+        finally:
+            del os.environ["RAY_TPU_FAULT_POINTS"]
+        gcs = cluster.gcs
+        assert _wait_until(
+            lambda: not gcs.node_manager.is_alive(handle.node_id)), \
+            "heartbeat-faulted node was never declared dead"
+        assert handle.proc.poll() is None, \
+            "the node process must still be RUNNING (wedged, not dead)"
+        handle.kill()
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance scenario
+# ---------------------------------------------------------------------------
+
+def test_sigkill_node_host_mid_broadcast_reconstructs():
+    """SIGKILL a node-host OS process mid-broadcast under memory
+    pressure; the workload completes via lineage reconstruction.
+
+    The victim is the sole holder of the ``prod`` resource and its
+    object store is ~2/3 the bytes produced, so production itself runs
+    the create-queue + async-spill stack (worker returns block, never
+    crash).  Consumers on a second node-host pull every object with a
+    per-chunk injected delay (inherited via the fault env var), so the
+    SIGKILL provably lands while the broadcast is in flight.  Every
+    object must come back bit-deterministic, the driver's
+    reconstruction counter must move, and the RECONSTRUCTING
+    task-event state must be queryable."""
+    ray_tpu.init(num_cpus=2, _system_config=dict(_WIRE_CONFIG))
+    try:
+        cluster = global_worker().cluster
+        victim = cluster.add_remote_node(
+            num_cpus=2, resources={"prod": 8.0},
+            object_store_memory=24 * _MB)
+        os.environ["RAY_TPU_FAULT_POINTS"] = \
+            "transfer.chunk:delay:-1:0.05"
+        try:
+            cluster.add_remote_node(num_cpus=2,
+                                    resources={"consume": 8.0},
+                                    object_store_memory=64 * _MB)
+        finally:
+            del os.environ["RAY_TPU_FAULT_POINTS"]
+
+        @ray_tpu.remote(resources={"prod": 1.0}, num_cpus=0,
+                        max_retries=4)
+        def produce(i):
+            return np.full(3 * _MB, i % 251, dtype=np.uint8)
+
+        # 12 x 3MiB = 36MiB of returns into a 24MiB store: memory
+        # pressure is real — admission runs through the create queue
+        # and the victim's spiller, not just free space.
+        refs = [produce.remote(i) for i in range(12)]
+        ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=120)
+        assert len(ready) == 12, \
+            "production under memory pressure stalled"
+
+        @ray_tpu.remote(resources={"consume": 1.0}, num_cpus=0,
+                        max_retries=4)
+        def checksum(a):
+            return int(a[0]), a.nbytes
+
+        pending = [checksum.remote(r) for r in refs]
+        victim.kill()                       # SIGKILL, mid-broadcast
+        # Replacement capacity for the resubmitted produce tasks.
+        cluster.add_remote_node(num_cpus=2, resources={"prod": 8.0},
+                                object_store_memory=64 * _MB)
+
+        results = ray_tpu.get(pending, timeout=240)
+        for i, (first, nbytes) in enumerate(results):
+            assert first == i % 251, f"object {i} came back corrupt"
+            assert nbytes == 3 * _MB
+        # The driver can read every object directly too.
+        for i, ref in enumerate(refs):
+            a = ray_tpu.get(ref, timeout=120)
+            assert a[0] == i % 251 and a.nbytes == 3 * _MB
+
+        cw = global_worker().core_worker
+        assert cw.metrics["lineage_reconstructions"] > 0, \
+            "workload completed without any reconstruction — the kill " \
+            "landed after the broadcast finished; nothing was proven"
+        from ray_tpu.experimental.state.api import list_tasks
+        recs = list_tasks(limit=1000)
+        recon = [t for t in recs
+                 if "produce" in (t.get("name") or "")
+                 and "RECONSTRUCTING" in t.get("state_ts", {})]
+        assert recon, "no RECONSTRUCTING task-event state recorded"
+    finally:
+        ray_tpu.shutdown()

@@ -305,10 +305,26 @@ class TestTaskEvents:
     ORDER = ["PENDING_ARGS_AVAIL", "SCHEDULED", "SUBMITTED_TO_WORKER",
              "RUNNING", "FINISHED", "FAILED"]
 
-    def _rows_named(self, fragment):
+    def _rows_named(self, fragment, terminal_within=None):
+        """Rows whose name contains ``fragment``.  With
+        ``terminal_within``, poll up to that many seconds for the last
+        row to reach a terminal state first — events flush on the
+        node-host heartbeat loop, so a just-finished task's FINISHED
+        record can trail the driver's get() by a beat (flaky under
+        full-suite load)."""
         from ray_tpu.experimental.state import list_tasks
-        return [r for r in list_tasks(limit=None)
-                if fragment in r["name"]]
+
+        def rows():
+            return [r for r in list_tasks(limit=None)
+                    if fragment in r["name"]]
+        if terminal_within:
+            deadline = time.monotonic() + terminal_within
+            while time.monotonic() < deadline:
+                out = rows()
+                if out and out[-1]["state"] in ("FINISHED", "FAILED"):
+                    return out
+                time.sleep(0.05)
+        return rows()
 
     def _assert_lifecycle(self, rec):
         # All five states observed, in canonical order, each stamped.
@@ -333,7 +349,7 @@ class TestTaskEvents:
             return x + 1
 
         assert ray_tpu.get(add_one_te.remote(1), timeout=30) == 2
-        rows = self._rows_named("add_one_te")
+        rows = self._rows_named("add_one_te", terminal_within=10.0)
         assert rows, "task never reached the event manager"
         self._assert_lifecycle(rows[-1])
 
@@ -343,7 +359,7 @@ class TestTaskEvents:
             return x + 2
 
         assert ray_tpu.get(add_two_te.remote(1), timeout=60) == 3
-        rows = self._rows_named("add_two_te")
+        rows = self._rows_named("add_two_te", terminal_within=10.0)
         assert rows
         self._assert_lifecycle(rows[-1])
 
