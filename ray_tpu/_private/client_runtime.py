@@ -118,6 +118,11 @@ class _NullReferenceCounter:
     def remove_local_ref(self, _oid):
         pass
 
+    def release_local_ref_async(self, _oid):
+        # ObjectRef.__del__ calls this on every registered ref's GC; it
+        # must exist (not merely be swallowed as AttributeError).
+        pass
+
     def add_borrowed_object(self, _oid, borrower=None):
         pass
 
@@ -174,14 +179,21 @@ class ClientCoreWorker:
         return out, dep_ids, holders, borrowed
 
     def submit_task(self, spec, holders=()) -> List[ObjectRef]:
-        self._client.call("submit_task", {"spec": spec}, timeout=60.0)
+        # worker_id scopes the host-side pin on the RESULT objects to
+        # this client (released with the client, like put pins).
+        self._client.call("submit_task",
+                          {"spec": spec,
+                           "worker_id": self.client_worker_id},
+                          timeout=60.0)
         del holders
         return [ObjectRef(oid, owner_id=self.worker_id,
                           skip_adding_local_ref=True)
                 for oid in spec.return_ids]
 
     def submit_actor_task(self, spec, holders=()) -> List[ObjectRef]:
-        self._client.call("submit_actor_task", {"spec": spec},
+        self._client.call("submit_actor_task",
+                          {"spec": spec,
+                           "worker_id": self.client_worker_id},
                           timeout=60.0)
         del holders
         return [ObjectRef(oid, owner_id=self.worker_id,

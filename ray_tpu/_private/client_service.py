@@ -53,12 +53,32 @@ def register_client_surface(server, *, core: Callable, kv,
         return kv.put(payload["key"], payload["value"],
                       overwrite=payload.get("overwrite", True))
 
+    def _pin_results(refs, payload):
+        """Pin a submission's return objects for the CLIENT, exactly
+        like put_object pins puts: the host-side handle is discarded
+        when this handler returns, and the client's interest must not
+        ride on the handle's destructor losing a race with task
+        completion (a release applied after the result lands would
+        delete it under the client — observed as ObjectLostError on
+        the client's first get).  Scope matches put_object: released
+        with the client where the host tracks one (pin_cb +
+        worker_id), else held until host shutdown — on that path the
+        pre-pin behavior leaked the result BYTES in the memory store
+        instead (entry stored after the rc row was already freed, so
+        no delete callback could ever fire), so the pin makes an
+        existing host-lifetime cost visible rather than adding one."""
+        c = core()
+        for ref in refs or ():
+            c.reference_counter.add_local_ref(ref.object_id())
+            if pin_cb is not None and payload.get("worker_id"):
+                pin_cb(payload["worker_id"], ref.object_id())
+
     def submit_task(payload) -> bool:
-        core().submit_task(payload["spec"])
+        _pin_results(core().submit_task(payload["spec"]), payload)
         return True
 
     def submit_actor_task(payload) -> bool:
-        core().submit_actor_task(payload["spec"])
+        _pin_results(core().submit_actor_task(payload["spec"]), payload)
         return True
 
     def create_actor(payload) -> bool:

@@ -19,6 +19,7 @@ from ray_tpu._private.ids import NodeID
 from ray_tpu._private.object_manager import ObjectDirectory
 from ray_tpu._private.raylet import Raylet
 from ray_tpu.gcs.server import GcsServer
+from ray_tpu._private.debug import diag_lock
 
 
 class Cluster:
@@ -28,7 +29,7 @@ class Cluster:
         self._gcs_storage_path = gcs_storage_path
         self.gcs = GcsServer(storage_path=gcs_storage_path)
         self.object_directory = ObjectDirectory()
-        self._lock = threading.Lock()
+        self._lock = diag_lock("Cluster._lock")
         self._raylets: List[Raylet] = []
         # EVERY in-process raylet ever created, including ones later
         # declared dead (heartbeat timeout) and dropped from

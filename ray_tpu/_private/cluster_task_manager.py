@@ -26,6 +26,7 @@ import time
 
 from ray_tpu._private import fault_injection
 from ray_tpu._private.config import get_config
+from ray_tpu._private.debug import diag_rlock, loop_only
 from ray_tpu._private.task_spec import TaskSpec
 from ray_tpu.scheduler import policy as policy_mod
 
@@ -46,7 +47,7 @@ _TICK_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
 class ClusterTaskManager:
     def __init__(self, raylet):
         self._raylet = raylet
-        self._lock = threading.RLock()
+        self._lock = diag_rlock("ClusterTaskManager._lock")
         self._queues: Dict[int, deque] = defaultdict(deque)
         self._infeasible: Dict[int, deque] = defaultdict(deque)
         self._view_version = -1
@@ -105,7 +106,14 @@ class ClusterTaskManager:
         self._raylet.loop.post(self.schedule_and_dispatch, "cluster.schedule")
 
     # ---- the tick -------------------------------------------------------
+    @loop_only("raylet")
     def schedule_and_dispatch(self):
+        """The scheduling tick.  Loop-affine by design: every caller
+        posts it to the raylet loop (queue_and_schedule, resource-freed
+        and cluster-changed notifications, the periodic tick) so queue
+        pops, the dirty cluster view and tick_stats are only touched
+        from one thread — graftcheck R4 verifies the call sites
+        statically, the decorator enforces it at runtime in tests."""
         from ray_tpu._private.metrics_agent import observe_internal
         from ray_tpu.util import tracing
         cfg = get_config()

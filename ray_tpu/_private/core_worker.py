@@ -31,6 +31,7 @@ from ray_tpu._private.reference_counter import ReferenceCounter
 from ray_tpu._private.serialization import SerializedObject, serialize
 from ray_tpu._private.task_manager import TaskManager
 from ray_tpu._private.task_spec import TaskArg, TaskSpec
+from ray_tpu._private.debug import diag_lock
 
 
 class CoreWorker:
@@ -47,7 +48,7 @@ class CoreWorker:
         self.actor_submitter = DirectActorTaskSubmitter(self)
         self.driver_task_id = TaskID.for_driver(job_id)
         self._put_counter = 0
-        self._put_lock = threading.Lock()
+        self._put_lock = diag_lock("CoreWorker._put_lock")
         self.metrics: Dict[str, float] = {"tasks_finished": 0,
                                           "task_exec_seconds": 0.0,
                                           "tasks_submitted": 0,
@@ -55,7 +56,7 @@ class CoreWorker:
                                           "lineage_reconstructions": 0}
         # Per-creating-task reconstruction state (attempt count +
         # exponential-backoff gate) — object_recovery_manager parity.
-        self._recon_lock = threading.Lock()
+        self._recon_lock = diag_lock("CoreWorker._recon_lock")
         self._reconstructions: Dict[TaskID, _ReconState] = {}
         # Exported at scrape time (/metrics): the hot path only bumps
         # these plain counters.

@@ -13,6 +13,8 @@ import queue
 import threading
 from typing import Callable
 
+from ray_tpu._private.debug import swallow
+
 
 class DaemonPool:
     def __init__(self, max_workers: int, name: str = "pool"):
@@ -38,8 +40,11 @@ class DaemonPool:
                 continue
             try:
                 fn(*args)
-            except Exception:
-                pass  # dispatch errors are the callee's to report
+            except Exception as e:
+                # Dispatch errors are the callee's to report — but the
+                # pump must not eat the evidence (graftcheck R7): count
+                # per site, log the first traceback.
+                swallow.noted("daemon_pool.dispatch", e)
 
     def stop(self):
         self._stopped.set()

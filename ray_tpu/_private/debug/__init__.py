@@ -1,0 +1,24 @@
+"""Runtime concurrency diagnostics (the dynamic half of graftcheck).
+
+Three tools, all zero-cost unless armed by env var:
+
+* :mod:`.lock_order` — ``diag_lock``/``diag_rlock``/``diag_condition``
+  factories wrapping ``threading`` primitives with a global
+  acquisition-graph witness (``RAY_TPU_LOCK_DIAG=1``); raises on
+  lock-order cycle formation and on hold-time over budget.
+* :mod:`.thread_registry` — ``@loop_only`` event-loop affinity asserts
+  (``RAY_TPU_LOOP_AFFINITY=1``).
+* :mod:`.swallow` — accounted exception swallowing for pump loops
+  (always on; it is bookkeeping, not a probe).
+
+The tier-1 conftest arms both probes for the whole suite; the static
+side lives in ``tools/graftcheck``.
+"""
+
+from ray_tpu._private.debug.lock_order import (  # noqa: F401
+    DiagLock, DiagRLock, LockHoldBudgetExceeded, LockOrderViolation,
+    diag_condition, diag_lock, diag_rlock)
+from ray_tpu._private.debug import swallow  # noqa: F401
+from ray_tpu._private.debug.thread_registry import (  # noqa: F401
+    LoopAffinityError, current_loop_kind, loop_only, register_current,
+    unregister_current)

@@ -1,0 +1,420 @@
+"""Runtime lock-order witness — the dynamic half of graftcheck.
+
+Parity: the reference runs plasma/raylet under TSan (SURVEY §5.2), whose
+deadlock detector reports *potential* lock-order inversions from a single
+run, not just ones that actually deadlocked.  Python has no TSan, so this
+module wraps ``threading.Lock/RLock/Condition`` behind factory functions
+(:func:`diag_lock` / :func:`diag_rlock` / :func:`diag_condition`) that are
+zero-cost pass-throughs returning the plain ``threading`` primitive unless
+``RAY_TPU_LOCK_DIAG=1`` is set at creation time.
+
+Armed, every acquisition is recorded against a **name-level** global
+acquisition graph (all instances created at one call site share a node, so
+an ABBA order between two *differently named* locks is caught regardless
+of which instances were involved — exactly the shape of the PR-6
+store-lock -> refcount-lock deadlock).  Reentrancy is tracked by lock
+*instance*: re-acquiring the same object bumps a depth counter, while
+nesting two different instances of the same name (hierarchical
+same-class locking, deadlock-free only under a global instance order
+the name-level graph cannot see) is recorded as a self-edge,
+observable via :func:`same_name_nestings` but never raised on — the
+static analyzer's R1 self-edge check covers the non-reentrant case.
+The witness raises
+:class:`LockOrderViolation` the moment an edge closes a cycle, and
+:class:`LockHoldBudgetExceeded` when a lock is held longer than
+``RAY_TPU_LOCK_HOLD_BUDGET_S`` (0 = unlimited, the default: tier-1 boxes
+can stall multi-second under sanitizer compiles, so the budget is an
+opt-in probe, not an always-on gate).
+
+The tier-1 conftest arms the witness for the whole suite, so every
+existing test doubles as a lock-order probe.
+
+Cost when armed: the steady-state acquire path is thread-local list ops
+plus one dict read (edge dedup); the internal registry lock is taken only
+when a *new* edge is inserted, which happens a bounded number of times
+per process (#locks is small and fixed).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+
+class LockOrderViolation(RuntimeError):
+    """A lock acquisition closed a cycle in the global acquisition graph."""
+
+
+class LockHoldBudgetExceeded(RuntimeError):
+    """A lock was held longer than the configured hold budget."""
+
+
+def _armed() -> bool:
+    return os.environ.get("RAY_TPU_LOCK_DIAG", "") == "1"
+
+
+# One-entry memo for the hold budget: releases are a hot path, so the
+# float parse runs only when the env string actually changes (tests
+# monkeypatch it; production sets it once).
+_budget_memo: Tuple[Optional[str], float] = (None, -1.0)
+
+
+def _hold_budget_s() -> float:
+    global _budget_memo
+    raw = os.environ.get("RAY_TPU_LOCK_HOLD_BUDGET_S", "0")
+    memo_raw, memo_val = _budget_memo
+    if raw == memo_raw:
+        return memo_val
+    try:
+        val = float(raw)
+    except ValueError:
+        val = 0.0
+    _budget_memo = (raw, val)
+    return val
+
+
+# ---------------------------------------------------------------------------
+# Global acquisition graph (name-level).
+#
+# _edges maps (held_name, acquired_name) -> short provenance string for the
+# first time the edge was observed.  Reads are plain dict lookups (GIL-safe,
+# no lock); inserts take _graph_lock and run the cycle check.
+_graph_lock = threading.Lock()
+_edges: Dict[Tuple[str, str], str] = {}
+_succ: Dict[str, List[str]] = {}
+#: Cycles reported so far (kept after raise so the conftest / a test
+#: harness can assert "no cycle reports" over a whole run).
+_violations: List[str] = []
+
+_tls = threading.local()
+
+
+def _stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def _site(skip: int = 2) -> str:
+    """file:line of the acquiring frame, skipping witness internals AND
+    threading.py (a `with cond:` acquires via Condition.__enter__, whose
+    frame says nothing about the caller)."""
+    for fs in reversed(traceback.extract_stack(limit=skip + 8)[:-skip]):
+        fn = fs.filename.replace(os.sep, "/")
+        if "debug/lock_order" in fn or fn.endswith("/threading.py"):
+            continue
+        return f"{os.path.basename(fs.filename)}:{fs.lineno}"
+    return "?"
+
+
+def _stack_summary(depth: int = 12) -> str:
+    """Compact call-path provenance for a NEW edge (bounded: edges are
+    recorded once per (held, acquired) pair, so the cost is one-time)."""
+    frames = []
+    for fs in traceback.extract_stack(limit=depth + 4)[:-2]:
+        fn = fs.filename.replace(os.sep, "/")
+        if "debug/lock_order" in fn or fn.endswith("/threading.py"):
+            continue
+        frames.append(
+            f"{os.path.basename(fs.filename)}:{fs.lineno}:{fs.name}")
+    return " <- ".join(reversed(frames[-depth:]))
+
+
+def _find_path(src: str, dst: str) -> Optional[List[str]]:
+    """DFS over _succ; returns a node path src..dst or None."""
+    seen = {src}
+    path = [src]
+
+    def walk(node: str) -> bool:
+        for nxt in _succ.get(node, ()):
+            if nxt == dst:
+                path.append(nxt)
+                return True
+            if nxt in seen:
+                continue
+            seen.add(nxt)
+            path.append(nxt)
+            if walk(nxt):
+                return True
+            path.pop()
+        return False
+
+    return path if walk(src) else None
+
+
+def _record_edge(held: str, acquired: str,
+                 raise_on_cycle: bool = True) -> None:
+    key = (held, acquired)
+    if key in _edges:          # steady-state fast path: no lock
+        return
+    site = _stack_summary()
+    with _graph_lock:
+        if key in _edges:
+            return
+        # Adding held->acquired closes a cycle iff acquired already
+        # reaches held.
+        back = _find_path(acquired, held)
+        _edges[key] = site
+        _succ.setdefault(held, []).append(acquired)
+        if back is None:
+            return
+        cycle = back + [acquired]
+        legs = []
+        for a, b in zip(cycle, cycle[1:]):
+            legs.append(f"  {a} -> {b}  (first seen at "
+                        f"{_edges.get((a, b), site)})")
+        msg = ("lock-order cycle formed: "
+               + " -> ".join(cycle) + "\n" + "\n".join(legs)
+               + f"\n  closing edge {held} -> {acquired} acquired at {site}")
+        _violations.append(msg)
+    if raise_on_cycle:
+        raise LockOrderViolation(msg)
+
+
+#: name -> count of cross-instance same-name nestings observed.
+_same_name: Dict[str, int] = {}
+
+
+def _note_same_name_nesting(name: str) -> None:
+    with _graph_lock:
+        _same_name[name] = _same_name.get(name, 0) + 1
+
+
+def same_name_nestings() -> Dict[str, int]:
+    """Locks whose instances were nested inside each other (per name).
+    Not a violation by itself — safe under a global instance order —
+    but the place to look first when a same-class deadlock is
+    suspected."""
+    with _graph_lock:
+        return dict(_same_name)
+
+
+def violations() -> List[str]:
+    """Cycle reports recorded so far (for harness-level assertions)."""
+    with _graph_lock:
+        return list(_violations)
+
+
+def reset() -> None:
+    """Clear the global graph and reports (test isolation)."""
+    with _graph_lock:
+        _edges.clear()
+        _succ.clear()
+        _violations.clear()
+        _same_name.clear()
+
+
+def snapshot() -> tuple:
+    """Copy of the global graph state — pair with :func:`restore` so a
+    test that deliberately forms a cycle doesn't leave the report (or
+    its edges) behind for the rest of the suite."""
+    with _graph_lock:
+        return (dict(_edges), {k: list(v) for k, v in _succ.items()},
+                list(_violations), dict(_same_name))
+
+
+def restore(state: tuple) -> None:
+    edges, succ, violations, same_name = state
+    with _graph_lock:
+        _edges.clear()
+        _edges.update(edges)
+        _succ.clear()
+        _succ.update({k: list(v) for k, v in succ.items()})
+        _violations.clear()
+        _violations.extend(violations)
+        _same_name.clear()
+        _same_name.update(same_name)
+
+
+def graph_edges() -> Dict[Tuple[str, str], str]:
+    with _graph_lock:
+        return dict(_edges)
+
+
+# ---------------------------------------------------------------------------
+# Wrappers.
+
+
+class _DiagBase:
+    """Shared acquire/release bookkeeping over an inner threading lock.
+
+    Reentrancy is tracked per-thread by lock INSTANCE: only the
+    outermost acquisition of an instance records an edge / stack entry,
+    so RLock recursion adds no self-edges, nesting two instances of the
+    same name is still observed (``same_name_nestings``), and
+    plain-Lock self-deadlocks hang exactly as they would unwrapped
+    (the witness never *masks* behavior).
+    """
+
+    __slots__ = ("_inner", "name")
+
+    def __init__(self, inner, name: str):
+        self._inner = inner
+        self.name = name
+
+    # -- bookkeeping ----------------------------------------------------
+    # Stack entries: [name, t_acquired, depth, lock_instance_id].
+    def _note_acquired(self, raise_on_cycle: bool = True) -> None:
+        st = _stack()
+        me = id(self)
+        for entry in st:
+            if entry[3] == me:
+                entry[2] += 1          # true reentrancy: same instance
+                return
+        if st:
+            if st[-1][0] == self.name:
+                # A DIFFERENT instance of the same name while one is
+                # held: hierarchical same-class nesting.  Recorded as a
+                # self-edge diagnostic (same_name_nestings), never
+                # raised — name-level ordering cannot validate the
+                # instance order that makes it safe or not.
+                _note_same_name_nesting(self.name)
+            else:
+                _record_edge(st[-1][0], self.name,
+                             raise_on_cycle=raise_on_cycle)
+        st.append([self.name, time.monotonic(), 1, me])
+
+    def _note_released(self) -> None:
+        st = _stack()
+        me = id(self)
+        for i in range(len(st) - 1, -1, -1):
+            if st[i][3] == me:
+                st[i][2] -= 1
+                if st[i][2] == 0:
+                    held_for = time.monotonic() - st[i][1]
+                    del st[i]
+                    budget = _hold_budget_s()
+                    if budget > 0 and held_for > budget:
+                        raise LockHoldBudgetExceeded(
+                            f"{self.name} held {held_for:.3f}s "
+                            f"(budget {budget:.3f}s), released at "
+                            f"{_site()}")
+                return
+
+    # -- lock protocol ---------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            try:
+                self._note_acquired()
+            except LockOrderViolation:
+                # Don't strand the inner lock: the caller's `with` body
+                # never runs, so nothing would ever release it.
+                self._inner.release()
+                raise
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        self._note_released()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            self.release()
+        except LockHoldBudgetExceeded:
+            # Never mask an in-flight exception from the with-body with
+            # the diagnostic — the original error is what the user is
+            # debugging; the budget report rides _violations-style logs
+            # only when it would otherwise be the sole signal.
+            if exc and exc[0] is not None:
+                return False
+            raise
+        return False
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.name} of {self._inner!r}>"
+
+
+class DiagLock(_DiagBase):
+    __slots__ = ()
+
+
+class DiagRLock(_DiagBase):
+    """Adds the private Condition integration hooks so a
+    ``threading.Condition`` built over this wrapper keeps bookkeeping
+    exact across ``wait()`` (which releases all recursion levels and
+    re-acquires them)."""
+
+    __slots__ = ()
+
+    def _release_save(self):
+        st = _stack()
+        me = id(self)
+        depth = 0
+        for i in range(len(st) - 1, -1, -1):
+            if st[i][3] == me:
+                depth = st[i][2]
+                del st[i]
+                break
+        saved = (self._inner._release_save()
+                 if hasattr(self._inner, "_release_save")
+                 else self._inner.release())
+        return (saved, depth)
+
+    def _acquire_restore(self, state):
+        saved, depth = state
+        if hasattr(self._inner, "_acquire_restore"):
+            self._inner._acquire_restore(saved)
+        else:
+            self._inner.acquire()
+        # Re-entering after a wait is a genuine acquisition: record the
+        # edge against whatever the thread still holds — but never raise
+        # here: Condition.wait() must return with the lock held or its
+        # internal state corrupts.  The cycle still lands in
+        # ``violations()`` and will raise at the next normal-path hit.
+        st = _stack()
+        if st and st[-1][0] != self.name:
+            _record_edge(st[-1][0], self.name, raise_on_cycle=False)
+        st.append([self.name, time.monotonic(), max(1, depth), id(self)])
+
+    def _is_owned(self) -> bool:
+        if hasattr(self._inner, "_is_owned"):
+            return self._inner._is_owned()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+
+# ---------------------------------------------------------------------------
+# Factories — the only public construction surface.
+
+
+def diag_lock(name: Optional[str] = None) -> "threading.Lock | DiagLock":
+    """A ``threading.Lock``, wrapped by the witness when armed."""
+    if not _armed():
+        return threading.Lock()
+    return DiagLock(threading.Lock(), name or f"lock@{_site()}")
+
+
+def diag_rlock(name: Optional[str] = None) -> "threading.RLock | DiagRLock":
+    """A ``threading.RLock``, wrapped by the witness when armed."""
+    if not _armed():
+        return threading.RLock()
+    return DiagRLock(threading.RLock(), name or f"rlock@{_site()}")
+
+
+def diag_condition(lock=None, name: Optional[str] = None) -> threading.Condition:
+    """A ``threading.Condition``.  When armed, its underlying lock is a
+    :class:`DiagRLock` (or the caller's already-wrapped diag lock), so
+    ``with cond: ... cond.wait()`` keeps exact held-set bookkeeping —
+    the wait's full release/re-acquire goes through the wrapper's
+    ``_release_save``/``_acquire_restore``."""
+    if not _armed():
+        return threading.Condition(lock)
+    if lock is None:
+        lock = DiagRLock(threading.RLock(), name or f"cond@{_site()}")
+    elif not isinstance(lock, _DiagBase):
+        lock = DiagRLock(lock, name or f"cond@{_site()}")
+    return threading.Condition(lock)

@@ -1,0 +1,64 @@
+"""Accounted exception swallowing for daemon pump loops.
+
+A daemon pump loop (dispatch pool, heartbeat sender, chunk server) must
+survive a bad callback — but ``except Exception: pass`` destroys the
+evidence: graftcheck rule R7 flags exactly that shape because both PR-2
+and PR-6 root-cause hunts lost hours to errors that had been eaten by a
+pump loop.
+
+:func:`noted` is the sanctioned replacement: the loop stays alive, the
+error is counted per site (:func:`count` — tests assert on it) and the
+first occurrence per site is logged with a traceback (first-only, so a
+hot loop hitting the same broken callback cannot flood stderr).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import traceback
+from typing import Dict
+
+_lock = threading.Lock()
+_counts: Dict[str, int] = {}
+_logged: Dict[str, bool] = {}
+
+
+def noted(site: str, exc: BaseException) -> None:
+    """Record a deliberately-swallowed exception at ``site``.
+
+    Call from an ``except Exception as e:`` handler in a loop that must
+    not die.  Never raises."""
+    try:
+        with _lock:
+            _counts[site] = _counts.get(site, 0) + 1
+            first = not _logged.get(site)
+            _logged[site] = True
+        if first:
+            print(f"[ray_tpu] swallowed exception at {site} "
+                  f"(logged once; see debug.swallow.count): "
+                  f"{type(exc).__name__}: {exc}", file=sys.stderr)
+            # Print exc ITSELF, not "the current exception": noted()
+            # may be handed a stored error outside any except block
+            # (captured on one thread, reported on another).
+            traceback.print_exception(type(exc), exc, exc.__traceback__,
+                                      file=sys.stderr)
+    except Exception:
+        pass  # the accounting itself must never take the pump down
+
+
+def count(site: str) -> int:
+    """Swallowed-exception count for ``site`` (0 if never hit)."""
+    with _lock:
+        return _counts.get(site, 0)
+
+
+def counts() -> Dict[str, int]:
+    with _lock:
+        return dict(_counts)
+
+
+def reset() -> None:
+    with _lock:
+        _counts.clear()
+        _logged.clear()

@@ -1,0 +1,90 @@
+"""Event-loop thread identity — the runtime half of graftcheck rule R4.
+
+Methods that mutate scheduler / dispatch state are *loop-affine*: they
+are only correct when run on their daemon's event-loop thread (the
+reference posts everything through one io_context per daemon;
+``node_manager.cc`` handlers never run concurrently with the tick).  In
+Python nothing stops a test — or a refactor — from calling them
+directly from an arbitrary thread, which is exactly how tick-state races
+slip in.
+
+:func:`loop_only` marks such a method.  The static analyzer verifies
+every call site is either another ``@loop_only`` function or a
+``loop.post``/``schedule_*`` registration; the runtime assert (armed via
+``RAY_TPU_LOOP_AFFINITY=1``, on by default in tests through the tier-1
+conftest) enforces it on every call.
+
+Loops register by *kind*: an :class:`~ray_tpu._private.event_loop.EventLoop`
+named ``raylet-a1b2c3`` registers its thread under kind ``raylet``.  The
+check is kind-level, not instance-level — it catches "ran on a worker /
+main / pump thread" (the real bug class), while two in-process raylets
+ticking each other's managers would pass; instance-level identity would
+need the loop handle plumbed through every callee for marginal extra
+coverage.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+from typing import Dict, Optional
+
+
+class LoopAffinityError(AssertionError):
+    """A ``@loop_only`` method ran on a thread outside its loop kind."""
+
+
+_lock = threading.Lock()
+#: thread ident -> loop kind (e.g. "raylet", "gcs").
+_loop_threads: Dict[int, str] = {}
+
+
+def _armed() -> bool:
+    return os.environ.get("RAY_TPU_LOOP_AFFINITY", "") == "1"
+
+
+def register_current(loop_name: str) -> None:
+    """Register the calling thread as the loop thread for ``loop_name``.
+
+    The kind is the name up to the first ``-`` (loop names embed the
+    node id suffix: ``raylet-a1b2c3`` -> kind ``raylet``)."""
+    kind = loop_name.split("-", 1)[0]
+    with _lock:
+        _loop_threads[threading.get_ident()] = kind
+
+
+def unregister_current() -> None:
+    with _lock:
+        _loop_threads.pop(threading.get_ident(), None)
+
+
+def current_loop_kind() -> Optional[str]:
+    return _loop_threads.get(threading.get_ident())
+
+
+def loop_only(kind: str):
+    """Decorator: assert the wrapped method runs on a ``kind`` loop thread.
+
+    The marker attribute ``__loop_only__`` is what graftcheck's R4 keys
+    on statically; the wrapper is the runtime enforcement."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if _armed():
+                got = _loop_threads.get(threading.get_ident())
+                if got != kind:
+                    raise LoopAffinityError(
+                        f"{fn.__qualname__} is @loop_only({kind!r}) but "
+                        f"ran on thread "
+                        f"{threading.current_thread().name!r} "
+                        f"(registered loop kind: {got!r}) — post it to "
+                        f"the {kind} event loop instead of calling it "
+                        f"directly")
+            return fn(*args, **kwargs)
+
+        wrapper.__loop_only__ = kind
+        return wrapper
+
+    return deco

@@ -18,6 +18,7 @@ from ray_tpu import exceptions
 from ray_tpu._private.ids import ActorID
 from ray_tpu._private.task_spec import TaskSpec
 from ray_tpu.gcs.actor_manager import ActorState
+from ray_tpu._private.debug import diag_rlock
 
 
 class _ActorQueue:
@@ -33,7 +34,7 @@ class _ActorQueue:
 class DirectActorTaskSubmitter:
     def __init__(self, core_worker):
         self._core = core_worker
-        self._lock = threading.RLock()
+        self._lock = diag_rlock("DirectActorSubmitter._lock")
         self._queues: Dict[ActorID, _ActorQueue] = {}
 
     def _queue_for(self, actor_id: ActorID) -> _ActorQueue:

@@ -20,6 +20,7 @@ from typing import Dict, List, Optional, Tuple
 from ray_tpu import exceptions
 from ray_tpu._private.config import get_config
 from ray_tpu._private.task_spec import TaskSpec
+from ray_tpu._private.debug import diag_rlock
 
 # Re-lease cadence/window for leases bounced off a not-yet-declared-dead
 # node: 0.2s x 150 = 30s, comfortably past any heartbeat-timeout
@@ -46,7 +47,7 @@ class _SchedulingKeyState:
 class DirectTaskSubmitter:
     def __init__(self, core_worker):
         self._core = core_worker
-        self._lock = threading.RLock()
+        self._lock = diag_rlock("DirectTaskSubmitter._lock")
         self._keys: Dict[int, _SchedulingKeyState] = defaultdict(
             _SchedulingKeyState)
         self._lease_bounces: Dict = {}   # task_id -> transient rejects

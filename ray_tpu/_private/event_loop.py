@@ -14,11 +14,13 @@ import time
 import traceback
 from typing import Callable, Dict, Optional
 
+from ray_tpu._private.debug import diag_condition, thread_registry
+
 
 class EventLoop:
     def __init__(self, name: str = "loop"):
         self.name = name
-        self._cond = threading.Condition()
+        self._cond = diag_condition(name="EventLoop._cond")
         self._queue = []            # immediate handlers
         self._timers = []           # (deadline, seq, period, name, fn)
         self._seq = 0
@@ -71,6 +73,18 @@ class EventLoop:
         st["max_s"] = max(st["max_s"], elapsed)
 
     def _run(self):
+        # Loop-affinity identity (@loop_only runtime checks): this thread
+        # IS the "<kind>" loop for kind = name up to the node-id suffix.
+        # Unregistered on exit — thread idents are reused by the OS, and
+        # a stale entry would let a later unrelated thread impersonate
+        # a dead loop.
+        thread_registry.register_current(self.name)
+        try:
+            self._run_inner()
+        finally:
+            thread_registry.unregister_current()
+
+    def _run_inner(self):
         while True:
             fn = None
             name = None

@@ -28,6 +28,7 @@ from ray_tpu._private.ids import NodeID, ObjectID, WorkerID
 from ray_tpu._private.serialization import SerializedObject
 from ray_tpu.rpc import RpcClient, RpcServer
 from ray_tpu.scheduler.resources import NodeResources
+from ray_tpu._private.debug import diag_lock
 
 
 def _ignore(_result, _err):
@@ -171,7 +172,7 @@ class RemoteNodeProxy:
         # head sends its held set and the node releases the rest
         # (reference ReleaseUnusedWorkers, node_manager.proto:312).
         self._held_tokens: set = set()
-        self._tokens_lock = threading.Lock()
+        self._tokens_lock = diag_lock("RemoteNodeProxy._tokens_lock")
         self.client.on_reconnect = self._reconcile_leases
 
     # ---- GCS-facing (register / resource sync) -------------------------
@@ -301,7 +302,7 @@ class HeadService:
 
     def __init__(self, cluster, port: int = 0):
         self._cluster = cluster
-        self._lock = threading.Lock()
+        self._lock = diag_lock("HeadService._lock")
         self._proxies: Dict[NodeID, RemoteNodeProxy] = {}
         self._reg_tokens: Dict[str, NodeID] = {}
         # Object bytes relayed head-through for a peer that could have

@@ -17,6 +17,7 @@ from typing import Callable, Dict, List
 from ray_tpu import exceptions
 from ray_tpu._private.task_spec import TaskSpec
 from ray_tpu.scheduler.resources import ResourceRequest
+from ray_tpu._private.debug import diag_lock, diag_rlock
 
 
 class _Waiting:
@@ -34,7 +35,7 @@ class DependencyManager:
 
     def __init__(self, raylet):
         self._raylet = raylet
-        self._lock = threading.Lock()
+        self._lock = diag_lock("DependencyManager._lock")
         self._waiting: Dict = {}  # task_id -> _Waiting
 
     def wait_for_args(self, spec: TaskSpec, ready_cb: Callable[[], None]):
@@ -103,7 +104,7 @@ class DependencyManager:
 class LocalTaskManager:
     def __init__(self, raylet):
         self._raylet = raylet
-        self._lock = threading.RLock()
+        self._lock = diag_rlock("LocalTaskManager._lock")
         self._dispatch_queue: deque = deque()
         # Resources held by leased workers: worker_id -> ResourceRequest.
         self._allocated: Dict = {}

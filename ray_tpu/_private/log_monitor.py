@@ -66,11 +66,12 @@ class LogMonitor:
         self._thread.start()
 
     def _loop(self):
+        from ray_tpu._private.debug import swallow
         while not self._stop.is_set():
             try:
                 self.scan_once()
-            except Exception:
-                pass
+            except Exception as e:
+                swallow.noted("log_monitor.scan", e)
             self._stop.wait(self._poll)
 
     def scan_once(self):

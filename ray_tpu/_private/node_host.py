@@ -26,11 +26,13 @@ import threading
 from typing import Callable, Dict, Optional
 
 from ray_tpu import exceptions
+from ray_tpu._private import fault_injection
 from ray_tpu._private.ids import NodeID, ObjectID
 from ray_tpu._private.object_store import MemoryStore
 from ray_tpu._private.serialization import (
     SerializedObject, loads_function, serialize)
 from ray_tpu.rpc import RpcClient, RpcServer
+from ray_tpu._private.debug import diag_lock
 
 
 class _RemoteHeartbeats:
@@ -199,7 +201,7 @@ class PeerPool:
 
     def __init__(self, host: "NodeHost"):
         self._host = host
-        self._lock = threading.Lock()
+        self._lock = diag_lock("PeerPool._lock")
         self._addrs: Dict[NodeID, tuple] = {}
         self._clients: Dict[NodeID, RpcClient] = {}
 
@@ -528,7 +530,7 @@ class NodeHost:
         self.adapter.core_worker = self.core_shim
         self._workers: Dict[bytes, object] = {}   # lease token -> Worker
         self._grant_times: Dict[bytes, float] = {}
-        self._workers_lock = threading.Lock()
+        self._workers_lock = diag_lock("NodeHost._workers_lock")
 
         self.server = RpcServer(
             name=f"nodehost-{self.raylet.node_id.hex()[:6]}")
@@ -548,6 +550,12 @@ class NodeHost:
         s.register("commit_bundle", self._handle_commit_bundle)
         s.register("cancel_bundle", self._handle_cancel_bundle)
         s.register("ping", lambda _p: "pong")
+        # Debug surface: how often a named fault point fired IN THIS
+        # PROCESS — chaos tests armed via RAY_TPU_FAULT_POINTS prove
+        # their fault actually triggered across the process boundary
+        # (a chaos test whose fault never fired proves nothing).
+        s.register("fault_fired",
+                   lambda p: fault_injection.fired(p["point"]))
         s.register("stop", self._handle_stop)
         from ray_tpu._private.object_store import segment_chunk_source
         from ray_tpu.rpc.chunked import serve_chunks

@@ -20,6 +20,7 @@ from ray_tpu._private import fault_injection
 from ray_tpu._private.config import get_config
 from ray_tpu._private.ids import NodeID, ObjectID
 from ray_tpu._private.object_store import NodeObjectStore, _NativeHandle
+from ray_tpu._private.debug import diag_lock
 
 
 def fetch_object_into(client, object_id: ObjectID, local_store,
@@ -90,7 +91,7 @@ class ObjectDirectory:
     is its queryable index)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = diag_lock("ObjectDirectory._lock")
         self._locations: Dict[ObjectID, Set[NodeID]] = {}
         self._subscribers: Dict[ObjectID, List[Callable]] = {}
 
@@ -165,7 +166,7 @@ class NodeObjectManager:
     def __init__(self, raylet, directory: ObjectDirectory):
         self._raylet = raylet
         self._directory = directory
-        self._lock = threading.Lock()
+        self._lock = diag_lock("NodeObjectManager._lock")
         self._inflight_pulls: Dict[ObjectID, List[Callable]] = {}
         # Transfers run on their own IO pool — a multi-GiB pull on the
         # raylet's event loop would stall its heartbeats and scheduling

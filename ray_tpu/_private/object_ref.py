@@ -71,11 +71,20 @@ class ObjectRef:
 
     # -- refcounting hooks ------------------------------------------------
     def __del__(self):
+        # ENQUEUE-only (release_local_ref_async): a destructor fires
+        # from GC at whatever allocation point interrupted the thread —
+        # possibly inside a store-lock or task-manager-lock region.
+        # Running the out-of-scope cascade inline there nests runtime
+        # locks in arbitrary orders (the lock-order witness caught a
+        # MemoryStore<->TaskManager ABBA formed exactly this way); the
+        # reference counter's drain applies the release from a clean
+        # context, and its query APIs settle the queue synchronously.
         if self._registered:
             try:
                 wk = _current_worker()
                 if wk is not None and wk.core_worker is not None:
-                    wk.core_worker.reference_counter.remove_local_ref(self._id)
+                    wk.core_worker.reference_counter \
+                        .release_local_ref_async(self._id)
             except Exception:
                 pass  # interpreter teardown: module globals may be gone
 

@@ -26,6 +26,7 @@ from ray_tpu._private import fault_injection
 from ray_tpu._private.config import get_config
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.serialization import SerializedObject, deserialize
+from ray_tpu._private.debug import diag_condition
 
 try:
     from ray_tpu.native import shm_store as _shm
@@ -111,7 +112,7 @@ class MemoryStore:
     """
 
     def __init__(self):
-        self._lock = threading.Condition()
+        self._lock = diag_condition(name="MemoryStore._lock")
         self._entries: Dict[ObjectID, _Entry] = {}
         self._get_callbacks: Dict[ObjectID, list] = {}
 
@@ -222,7 +223,7 @@ class NodeObjectStore:
         self.spill_threshold = spill_threshold
         self.spill_dir = spill_dir
         os.makedirs(spill_dir, exist_ok=True)
-        self._lock = threading.Condition()
+        self._lock = diag_condition(name="NodeObjectStore._lock")
         self._entries: Dict[ObjectID, _Entry] = {}
         self._used = 0
         # Bytes reserved by in-flight transfer writers (charged before

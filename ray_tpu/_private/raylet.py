@@ -187,11 +187,17 @@ class Raylet:
 
     def _heartbeat_loop(self, period_s: float):
         import time as time_mod
+
+        from ray_tpu._private.debug import swallow
         while not self._dead:
             try:
                 self._heartbeat()
-            except Exception:
-                pass
+            except Exception as e:
+                # The sender must survive a flapping GCS link, but a
+                # silently-failing heartbeat loop looks exactly like a
+                # healthy one until the node is declared dead —
+                # count/log it (graftcheck R7).
+                swallow.noted("raylet.heartbeat", e)
             time_mod.sleep(period_s)
 
     # ---- lease protocol (NodeManagerService) ----------------------------

@@ -16,10 +16,12 @@ all zero) are identical and tested identically.
 
 from __future__ import annotations
 
+import collections
 import threading
 from typing import Callable, Dict, List, Optional, Set
 
 from ray_tpu._private.ids import ObjectID, TaskID
+from ray_tpu._private.debug import diag_condition, diag_rlock, swallow
 
 
 class Reference:
@@ -50,9 +52,31 @@ class Reference:
 
 class ReferenceCounter:
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = diag_rlock("ReferenceCounter._lock")
         self._refs: Dict[ObjectID, Reference] = {}
         self._delete_subscribers: List[Callable[[ObjectID], None]] = []
+        # Destructor-context releases (release_local_ref_async): an
+        # ObjectRef.__del__ can fire from GC at ANY allocation point —
+        # including inside a store-lock or task-manager-lock region of
+        # the interrupted thread.  Running the out-of-scope cascade
+        # (store delete, lineage eviction) inline there nests those
+        # locks in arbitrary orders; the lock-order witness caught a
+        # real MemoryStore<->TaskManager ABBA formed exactly this way.
+        # Instead, __del__ only enqueues; a dedicated drain thread (or
+        # a query API needing the settled state) runs the release from
+        # a clean, no-locks-held context.  (Reference parity: Ray's
+        # dtor hands RemoveLocalReference to the core worker's
+        # io_service rather than running deletion in the GC context.)
+        self._release_queue: "collections.deque[ObjectID]" = \
+            collections.deque()
+        self._release_cv = diag_condition(
+            name="ReferenceCounter._release_cv")
+        self._release_thread: Optional[threading.Thread] = None
+        #: Releases the drain thread has popped but not yet applied —
+        #: flush must wait these out or a query could read stale state
+        #: (queue empty != queue settled).
+        self._release_inflight = 0
+        self._closed = False
 
     # ---- registration ---------------------------------------------------
     def add_owned_object(self, object_id: ObjectID,
@@ -96,6 +120,75 @@ class ReferenceCounter:
             ref.local_refs = max(0, ref.local_refs - 1)
             self._maybe_delete(object_id)
 
+    def release_local_ref_async(self, object_id: ObjectID):
+        """Destructor-safe local-ref release: enqueue only, never run
+        the out-of-scope cascade in the caller's (GC-interrupted) lock
+        context.  The drain thread — or the next settled-state query —
+        performs the actual :meth:`remove_local_ref`.
+
+        After :meth:`close` (shutdown teardown, nothing left to race)
+        the release applies inline — a dead drain thread must not turn
+        late destructors into silent leaks."""
+        with self._release_cv:
+            if not self._closed:
+                self._release_queue.append(object_id)
+                if self._release_thread is None:
+                    self._release_thread = threading.Thread(
+                        target=self._release_loop, daemon=True,
+                        name="ray_tpu::ref_release")
+                    self._release_thread.start()
+                self._release_cv.notify()
+                return
+        self.remove_local_ref(object_id)
+
+    def flush_pending_releases(self):
+        """Apply queued destructor releases NOW, in the calling thread
+        (which, unlike a ``__del__`` context, holds no runtime locks),
+        and wait out any release the drain thread has in flight.  Query
+        APIs call this so ``del ref; gc.collect()`` is observably
+        synchronous, exactly as the inline destructor was."""
+        while True:
+            with self._release_cv:
+                if not self._release_queue:
+                    # Queue empty is not settled: the drain may have
+                    # popped an oid it hasn't applied yet.
+                    while self._release_inflight:
+                        self._release_cv.wait(timeout=0.1)
+                    return
+                oid = self._release_queue.popleft()
+            self.remove_local_ref(oid)
+
+    def _release_loop(self):
+        while True:
+            with self._release_cv:
+                while not self._release_queue and not self._closed:
+                    self._release_cv.wait(timeout=0.5)
+                if not self._release_queue:
+                    if self._closed:
+                        return
+                    continue
+                oid = self._release_queue.popleft()
+                self._release_inflight += 1
+            try:
+                self.remove_local_ref(oid)
+            except Exception as e:
+                swallow.noted("reference_counter.release", e)
+            finally:
+                with self._release_cv:
+                    self._release_inflight -= 1
+                    self._release_cv.notify_all()
+
+    def close(self):
+        """Stop the drain thread (cluster shutdown); pending releases
+        are applied inline first so nothing leaks silently."""
+        self.flush_pending_releases()
+        with self._release_cv:
+            self._closed = True
+            self._release_cv.notify_all()
+        t = self._release_thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2)
+
     # ---- task-arg refs --------------------------------------------------
     def add_submitted_task_refs(self, object_ids: List[ObjectID]):
         with self._lock:
@@ -112,12 +205,18 @@ class ReferenceCounter:
                 self._maybe_delete(oid)
 
     # ---- queries --------------------------------------------------------
+    # Queries settle pending destructor releases first: a test's
+    # `del ref; gc.collect(); assert not has_reference(...)` must see
+    # the release applied, and the flushing thread is a clean (no
+    # runtime locks held) context to run the cascade from.
     def has_reference(self, object_id: ObjectID) -> bool:
+        self.flush_pending_releases()
         with self._lock:
             ref = self._refs.get(object_id)
             return ref is not None and not ref.out_of_scope
 
     def ref_count(self, object_id: ObjectID) -> int:
+        self.flush_pending_releases()
         with self._lock:
             ref = self._refs.get(object_id)
             return 0 if ref is None or ref.out_of_scope else ref.total()

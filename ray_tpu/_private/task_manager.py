@@ -16,6 +16,7 @@ from ray_tpu import exceptions
 from ray_tpu._private.config import get_config
 from ray_tpu._private.ids import ObjectID, TaskID
 from ray_tpu._private.task_spec import TaskSpec
+from ray_tpu._private.debug import diag_condition, diag_rlock
 
 
 class _PendingTask:
@@ -30,12 +31,12 @@ class _PendingTask:
 class TaskManager:
     def __init__(self, core_worker):
         self._core = core_worker
-        self._lock = threading.RLock()
+        self._lock = diag_rlock("TaskManager._lock")
         self._pending: Dict[TaskID, _PendingTask] = {}
         # Lineage: task specs pinned while their return objects may need
         # reconstruction (reference: TaskManager lineage map).
         self._lineage: Dict[TaskID, TaskSpec] = {}
-        self._completion_cv = threading.Condition(self._lock)
+        self._completion_cv = diag_condition(self._lock, name="TaskManager._lock")
 
     # ---- submission lifecycle ------------------------------------------
     def add_pending_task(self, spec: TaskSpec) -> None:

@@ -20,6 +20,7 @@ from ray_tpu._private.config import get_config, initialize_config
 from ray_tpu._private.core_worker import CoreWorker
 from ray_tpu._private.ids import JobID
 from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.debug import diag_rlock
 
 
 class Worker:
@@ -36,7 +37,7 @@ class Worker:
 
 
 _global_worker: Optional[Worker] = None
-_init_lock = threading.RLock()
+_init_lock = diag_rlock("worker._init_lock")
 
 
 def global_worker() -> Worker:
@@ -160,6 +161,10 @@ def shutdown():
                 w.cluster.gcs.job_manager.mark_job_finished(w.job_id)
             except Exception:
                 pass
+        try:
+            w.core_worker.reference_counter.close()
+        except Exception:
+            pass
         try:
             w.cluster.shutdown()
         except Exception:

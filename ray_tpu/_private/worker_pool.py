@@ -37,6 +37,7 @@ from ray_tpu import exceptions
 from ray_tpu._private import worker_context
 from ray_tpu._private.config import get_config
 from ray_tpu._private.ids import ObjectID, WorkerID
+from ray_tpu._private.debug import diag_lock, diag_rlock
 
 
 class WorkerState:
@@ -203,7 +204,7 @@ class WorkerHostService:
     def __init__(self, node):
         from ray_tpu.rpc import RpcServer
         self._node = node
-        self._lock = threading.Lock()
+        self._lock = diag_lock("WorkerHostService._lock")
         self._ports: Dict[str, int] = {}
         self._events: Dict[str, threading.Event] = {}
         self._worker_pins: Dict[str, list] = {}
@@ -212,7 +213,7 @@ class WorkerHostService:
         # thread, and abort's locate-then-delete must not interleave
         # with a concurrent seal of the same key (the sealed-object
         # guard would read stale state and delete a live object).
-        self._shm_seal_lock = threading.Lock()
+        self._shm_seal_lock = diag_lock("WorkerHostService._shm_seal_lock")
         self.shm_locate_count = 0    # observability/tests
         self.server = RpcServer(
             name=f"workerhost-{node.node_id.hex()[:6]}")
@@ -774,7 +775,7 @@ class WorkerPool:
         self._node = node
         # RLock: pop_worker holds it while constructing a ProcessWorker,
         # whose __init__ re-enters via host_service().
-        self._lock = threading.RLock()
+        self._lock = diag_rlock("WorkerPool._lock")
         self._idle: List[Worker] = []
         self._leased: Dict[WorkerID, Worker] = {}
         self._actors: Dict[WorkerID, Worker] = {}

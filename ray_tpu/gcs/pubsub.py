@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import threading
 from typing import Any, Callable, Dict, Optional, Tuple
+from ray_tpu._private.debug import diag_rlock
 
 # Channel names (pubsub.proto ChannelType parity).
 ACTOR_CHANNEL = "ACTOR"
@@ -25,7 +26,7 @@ TASK_EVENT_CHANNEL = "TASK_EVENT"
 
 class Publisher:
     def __init__(self, event_loop=None):
-        self._lock = threading.RLock()
+        self._lock = diag_rlock("Publisher._lock")
         # (channel, key or None) -> {subscriber_id: callback}
         self._subs: Dict[Tuple[str, Optional[bytes]], Dict[int, Callable]] = {}
         self._next_id = 0

@@ -26,6 +26,7 @@ from ray_tpu.gcs.pubsub import Publisher
 from ray_tpu.gcs.storage import (
     FileStoreClient, GcsTableStorage, InMemoryStoreClient)
 from ray_tpu.scheduler.resources import ClusterResourceView, NodeResources
+from ray_tpu._private.debug import diag_lock, diag_rlock, loop_only
 
 
 class GcsNodeManager:
@@ -34,7 +35,7 @@ class GcsNodeManager:
     def __init__(self, storage: GcsTableStorage, publisher: Publisher):
         self._storage = storage
         self._publisher = publisher
-        self._lock = threading.RLock()
+        self._lock = diag_rlock("GcsNodeManager._lock")
         self.alive_nodes: Dict[NodeID, dict] = {}
         self.dead_nodes: Dict[NodeID, dict] = {}
 
@@ -100,7 +101,7 @@ class GcsHeartbeatManager:
         cfg = get_config()
         self._period_s = cfg.raylet_heartbeat_period_milliseconds / 1000.0
         self._timeout = cfg.num_heartbeats_timeout
-        self._lock = threading.Lock()
+        self._lock = diag_lock("GcsHeartbeatManager._lock")
         self._missed: Dict[NodeID, int] = {}
         self._on_death = on_node_death
         self._paused = False
@@ -122,6 +123,7 @@ class GcsHeartbeatManager:
     def pause(self, paused: bool = True):
         self._paused = paused
 
+    @loop_only("gcs")
     def _tick(self):
         if self._paused:
             return
@@ -278,7 +280,7 @@ class GcsJobManager:
     def __init__(self, storage: GcsTableStorage, publisher: Publisher):
         self._storage = storage
         self._publisher = publisher
-        self._lock = threading.Lock()
+        self._lock = diag_lock("GcsJobManager._lock")
         self.jobs: Dict[JobID, dict] = {}
 
     def add_job(self, job_id: JobID, config: Optional[dict] = None) -> dict:
@@ -339,7 +341,7 @@ class GcsInternalKV:
 class GcsWorkerManager:
     def __init__(self, publisher: Publisher):
         self._publisher = publisher
-        self._lock = threading.Lock()
+        self._lock = diag_lock("GcsWorkerManager._lock")
         self.workers: Dict[WorkerID, dict] = {}
 
     def register_worker(self, worker_id: WorkerID, info: dict):

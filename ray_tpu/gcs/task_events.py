@@ -34,6 +34,7 @@ from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu.gcs.pubsub import TASK_EVENT_CHANNEL
+from ray_tpu._private.debug import diag_lock
 
 # Task lifecycle states (reference TaskStatus enum subset).
 PENDING_ARGS_AVAIL = "PENDING_ARGS_AVAIL"
@@ -77,12 +78,12 @@ class TaskEventBuffer:
         self._max_buffer = max_buffer
         self._batch_size = batch_size
         self._flush_interval = flush_interval
-        self._lock = threading.Lock()
+        self._lock = diag_lock("TaskEventBuffer._lock")
         # Serializes pop+publish so concurrent flushes from different
         # emitting threads cannot deliver batches out of emission order
         # (a FINISHED overtaking its own PENDING would seed the
         # manager's record with the wrong start_time).
-        self._flush_lock = threading.Lock()
+        self._flush_lock = diag_lock("TaskEventBuffer._flush_lock")
         self._events: List[dict] = []
         self._last_flush = time.monotonic()
         self.dropped = 0          # cumulative, rides every batch
@@ -152,7 +153,7 @@ class TaskEventManager:
     worker placement, ordered transition history)."""
 
     def __init__(self, publisher, max_tasks: int = 10_000):
-        self._lock = threading.Lock()
+        self._lock = diag_lock("TaskEventManager._lock")
         self._max_tasks = max_tasks
         self._records: "OrderedDict[str, dict]" = OrderedDict()
         # Terminal-record index (insertion order): O(1) eviction even

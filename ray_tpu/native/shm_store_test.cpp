@@ -11,6 +11,8 @@
 // delete (incl. deferred free), create/seal, choose_victims — from
 // several threads against one store.
 
+#include <fcntl.h>
+#include <sys/mman.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -161,6 +163,64 @@ void OomWorker(void* store, int id, int iters) {
   }
 }
 
+#ifdef GRAFT_SPILL_CALLBACKS
+// Spill-callback OOM/evict path (graftcheck PR satellite): the Python
+// LocalObjectManager reacts to OOM by COPYING the victim's bytes out
+// through its own segment mapping ("spill write") while the victim is
+// pinned, and only then deleting it.  Simulated here natively so TSan
+// sweeps the contract the Python side relies on: a pinned victim's
+// payload bytes must stay readable (no allocator reuse racing the
+// read) until unpin, even while OOM-pressed peers churn create/seal/
+// evict against the same segment.
+void SpillOomWorker(void* store, const uint8_t* seg_base, int id,
+                    int iters) {
+  const uint64_t big = 192 * 1024;
+  std::vector<uint8_t> spill_buf(big);
+  for (int i = 0; i < iters; i++) {
+    std::string key = "spill-" + Key(id, i);
+    const uint8_t* kb = reinterpret_cast<const uint8_t*>(key.data());
+    uint32_t kl = static_cast<uint32_t>(key.size());
+    int64_t off = store_create(store, kb, kl, big);
+    int attempts = 0;
+    while (off == -1 && attempts++ < 64) {
+      uint8_t buf[1 << 14];
+      uint64_t covered = 0;
+      int n = store_choose_victims(store, big * 2, buf, sizeof(buf),
+                                   &covered);
+      uint32_t pos = 0;
+      for (int v = 0; v < n; v++) {
+        uint32_t len;
+        std::memcpy(&len, buf + pos, 4);
+        const uint8_t* vkey = buf + pos + 4;
+        // Spill callback: pin, locate, copy the payload OUT of the
+        // segment, then delete (deferred free) and unpin (real free).
+        if (store_pin(store, vkey, len) == 0) {
+          uint64_t vo = 0, vs = 0;
+          if (store_get(store, vkey, len, &vo, &vs) == 0) {
+            uint64_t take = vs < spill_buf.size() ? vs : spill_buf.size();
+            std::memcpy(spill_buf.data(), seg_base + vo, take);
+          }
+          store_delete(store, vkey, len);
+          store_unpin(store, vkey, len);
+        } else {
+          store_delete(store, vkey, len);
+        }
+        pos += 4 + len;
+      }
+      off = store_create(store, kb, kl, big);
+    }
+    if (off >= 0) {
+      CHECK(store_pin(store, kb, kl) == 0);
+      CHECK(store_seal(store, kb, kl) == 0);
+      uint64_t o = 0, sz = 0;
+      CHECK(store_get(store, kb, kl, &o, &sz) == 0);
+      CHECK(sz == big);
+      CHECK(store_unpin(store, kb, kl) == 0);
+    }
+  }
+}
+#endif  // GRAFT_SPILL_CALLBACKS
+
 }  // namespace
 
 int main() {
@@ -180,6 +240,28 @@ int main() {
   for (int t = 0; t < 4; t++) {
     threads.emplace_back(OomWorker, store, kThreads + t, 64);
   }
+#ifdef GRAFT_SPILL_CALLBACKS
+  // Spill-simulating evictors read victim payloads through their own
+  // mapping of the segment (exactly how the Python spill path reads;
+  // the file is `capacity` bytes, offsets absolute).
+  int seg_fd = shm_open(name.c_str(), O_RDWR, 0600);
+  if (seg_fd < 0) {
+    std::fprintf(stderr, "shm_open for spill mapping failed\n");
+    return 2;
+  }
+  const uint8_t* seg_base = static_cast<const uint8_t*>(
+      mmap(nullptr, store_capacity(store), PROT_READ, MAP_SHARED,
+           seg_fd, 0));
+  close(seg_fd);
+  if (seg_base == MAP_FAILED) {
+    std::fprintf(stderr, "mmap for spill mapping failed\n");
+    return 2;
+  }
+  for (int t = 0; t < 4; t++) {
+    threads.emplace_back(SpillOomWorker, store, seg_base,
+                         kThreads + 4 + t, 48);
+  }
+#endif
   for (auto& th : threads) th.join();
   std::fprintf(stderr, "objects=%llu used=%llu failures=%d\n",
                static_cast<unsigned long long>(store_num_objects(store)),

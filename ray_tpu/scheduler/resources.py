@@ -18,6 +18,7 @@ import threading
 from typing import Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
+from ray_tpu._private.debug import diag_rlock
 
 # Fixed-point granularity, matching reference fixed_point.h (1/10000).
 FP_SCALE = 10_000
@@ -150,7 +151,7 @@ class ClusterResourceView:
     """
 
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = diag_rlock("ClusterResourceView._lock")
         self._node_ids: List = []
         self._node_index: Dict = {}
         self._nodes: Dict = {}          # node_id -> NodeResources

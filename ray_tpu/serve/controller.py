@@ -477,11 +477,12 @@ class ServeController:
                 pass
 
     def _reconcile_loop(self):
+        from ray_tpu._private.debug import swallow
         while not self._shutdown:
             try:
                 self._reconcile_once()
-            except Exception:
-                pass
+            except Exception as e:
+                swallow.noted("serve.reconcile", e)
             time.sleep(_RECONCILE_PERIOD_S)
 
     def shutdown(self) -> bool:

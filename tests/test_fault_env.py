@@ -1,0 +1,123 @@
+"""fault_injection env-inheritance across real process boundaries.
+
+Chaos tests arm faults in spawned daemons via the ``RAY_TPU_FAULT_POINTS``
+env var (parsed at import in every daemon).  Until now that path was only
+exercised implicitly by test_chaos; these tests pin the contract directly:
+
+* the env var survives ``Cluster.add_remote_node`` into the node-host OS
+  process (spawn env is inherited from the driver's environ);
+* ``fired()`` reports accurately ACROSS the boundary — counts are
+  per-process, the driver reads the remote count over the node's
+  ``fault_fired`` RPC verb, and the driver's own in-process counter for
+  the same point stays untouched;
+* count-based arming is exact: ``count=3`` fires exactly three times no
+  matter how many more hits arrive.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import fault_injection
+from ray_tpu._private.worker import global_worker
+
+_CONFIG = {
+    "scheduler_backend": "native",
+    "raylet_heartbeat_period_milliseconds": 50,
+    "num_heartbeats_timeout": 40,
+    "gcs_resource_broadcast_period_milliseconds": 50,
+}
+
+
+@pytest.fixture
+def fault_env_cluster():
+    """A wire cluster whose spawned node hosts inherit a fault arming:
+    the first three GCS heartbeats from the remote raylet are delayed
+    by 1 ms (harmless — 40-beat death timeout) so the point provably
+    fires in the child without perturbing the test."""
+    os.environ["RAY_TPU_FAULT_POINTS"] = "node.heartbeat:delay:3:0.001"
+    try:
+        ray_tpu.init(num_cpus=2, _system_config=dict(_CONFIG))
+        cluster = global_worker().cluster
+        yield cluster
+    finally:
+        ray_tpu.shutdown()
+        del os.environ["RAY_TPU_FAULT_POINTS"]
+        fault_injection.reset()
+
+
+def _remote_fired(handle, point, timeout=30.0):
+    proxy = handle.proxy
+    assert proxy is not None, "remote node has no head proxy"
+    return proxy.client.call("fault_fired", {"point": point},
+                             timeout=timeout)
+
+
+class TestFaultEnvInheritance:
+    def test_env_survives_into_spawned_node_host(self, fault_env_cluster):
+        handle = fault_env_cluster.add_remote_node(
+            num_cpus=1, resources={"spoke": 2.0})
+        # The child heartbeats every 50 ms; the armed point fires on the
+        # first three.  Poll the child's counter over the wire.
+        deadline = time.monotonic() + 20
+        fired = 0
+        while time.monotonic() < deadline:
+            fired = _remote_fired(handle, "node.heartbeat")
+            if fired >= 3:
+                break
+            time.sleep(0.05)
+        assert fired == 3, (
+            f"expected the inherited arming to fire exactly 3 times in "
+            f"the node-host process, saw {fired}")
+
+    def test_counts_are_per_process(self, fault_env_cluster):
+        """The driver parsed the same env var at its own (earlier)
+        import — but the driver raylet's heartbeats run in-process and
+        its arming was reset by the previous test run / fixture, so the
+        two counters must be independent: the remote count moves, the
+        remote count for a never-armed point stays zero."""
+        handle = fault_env_cluster.add_remote_node(
+            num_cpus=1, resources={"spoke": 2.0})
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if _remote_fired(handle, "node.heartbeat") >= 3:
+                break
+            time.sleep(0.05)
+        assert _remote_fired(handle, "spill.write") == 0
+        assert _remote_fired(handle, "transfer.chunk") == 0
+
+    def test_exact_count_stops_firing(self, fault_env_cluster):
+        """count=3 is exact: after the third hit the child's heartbeats
+        keep flowing un-delayed and the counter stays at 3."""
+        handle = fault_env_cluster.add_remote_node(
+            num_cpus=1, resources={"spoke": 2.0})
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if _remote_fired(handle, "node.heartbeat") >= 3:
+                break
+            time.sleep(0.05)
+        # ≥10 more heartbeat periods: the count must not advance.
+        time.sleep(0.6)
+        assert _remote_fired(handle, "node.heartbeat") == 3
+        # The node is alive and schedulable after its armed beats.
+        assert fault_env_cluster.wait_for_nodes(2, timeout=10)
+
+    def test_driver_side_fired_is_isolated(self, fault_env_cluster):
+        """In-process accuracy of the same API: the driver's counter for
+        the remote-armed point reflects only DRIVER-process hits."""
+        before = fault_injection.fired("node.heartbeat")
+        handle = fault_env_cluster.add_remote_node(
+            num_cpus=1, resources={"spoke": 2.0})
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if _remote_fired(handle, "node.heartbeat") >= 3:
+                break
+            time.sleep(0.05)
+        # The driver imported fault_injection long before the fixture
+        # wrote the env var, so the driver-process arming table is
+        # empty: its own raylet heartbeats hit the hook but never fire.
+        # The child's three fires must not leak into this process.
+        assert fault_injection.fired("node.heartbeat") == before
+        assert _remote_fired(handle, "node.heartbeat") == 3

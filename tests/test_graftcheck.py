@@ -1,0 +1,400 @@
+"""graftcheck: the analyzer gates the tree, the rules catch the shipped
+bug shapes, and the runtime witnesses actually witness.
+
+Three layers:
+
+* tier-1 gate — running the analyzer over ``ray_tpu/`` with the
+  committed baseline yields zero new findings AND zero stale entries
+  (the ratchet: fixes must also shrink the baseline);
+* rule unit tests — each committed bad-fixture snippet
+  (``tools/graftcheck/fixtures/``) trips exactly its rule, mirroring
+  the acceptance criterion that ``python -m graftcheck <fixture>``
+  exits non-zero;
+* witness unit tests — the diag_lock acquisition graph raises on ABBA
+  formation (without stranding the inner lock), Condition.wait keeps
+  the held-set exact, @loop_only blocks foreign threads, and
+  swallow.noted counts what pump loops eat.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from graftcheck import analyzer, baseline as baseline_mod, rules
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tools", "graftcheck", "fixtures")
+
+
+def _run_on(paths, select=None):
+    prog, errs = analyzer.load_program(paths, REPO)
+    return errs + rules.run_all(prog, paths, REPO, rules=select)
+
+
+class TestTreeGate:
+    def test_tree_is_clean_against_committed_baseline(self):
+        """The tier-1 gate: no new findings, no stale baseline entries."""
+        paths = [os.path.join(REPO, "ray_tpu")]
+        findings = _run_on(paths)
+        base = baseline_mod.load(baseline_mod.DEFAULT_BASELINE)
+        new, stale = baseline_mod.split(findings, base)
+        assert not new, "new graftcheck findings:\n" + "\n".join(
+            f.render() for f in new)
+        assert not stale, (
+            "stale baseline entries (finding fixed/moved — remove them, "
+            "the ratchet only tightens):\n" + "\n".join(
+                f"  {e['fingerprint']} [{e['rule']}] {e['path']}"
+                for e in stale))
+
+    def test_baseline_entries_are_justified(self):
+        base = baseline_mod.load(baseline_mod.DEFAULT_BASELINE)
+        for entry in base.values():
+            assert entry.get("why") and "TODO" not in entry["why"], \
+                f"baseline entry {entry['fingerprint']} lacks a why"
+
+    def test_cli_exits_zero_on_tree(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "graftcheck", "--fail-stale",
+             os.path.join(REPO, "ray_tpu")],
+            cwd=REPO, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+class TestRules:
+    """Each committed bad fixture trips its own rule (and the CLI exits
+    non-zero on it — the acceptance criterion)."""
+
+    @pytest.mark.parametrize("fixture,rule", [
+        ("r1_lock_order.py", "R1"),
+        ("r2_blocking.py", "R2"),
+        ("r3_aliasing.py", "R3"),
+        ("r4_loop_affinity.py", "R4"),
+        ("r5_refcount.py", "R5"),
+        ("r7_swallow.py", "R7"),
+    ])
+    def test_fixture_trips_rule(self, fixture, rule):
+        path = os.path.join(FIXTURES, fixture)
+        findings = _run_on([path])
+        assert any(f.rule == rule for f in findings), \
+            f"{fixture} produced no {rule} finding: {findings}"
+
+    @pytest.mark.parametrize("fixture", [
+        "r1_lock_order.py", "r2_blocking.py", "r3_aliasing.py",
+        "r4_loop_affinity.py", "r5_refcount.py",
+    ])
+    def test_cli_exits_nonzero_on_fixture(self, fixture):
+        proc = subprocess.run(
+            [sys.executable, "-m", "graftcheck",
+             os.path.join(FIXTURES, fixture)],
+            cwd=REPO, capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 1, \
+            f"{fixture}: rc={proc.returncode}\n{proc.stdout}{proc.stderr}"
+
+    def test_r1_reports_the_cycle_participants(self):
+        findings = _run_on([os.path.join(FIXTURES, "r1_lock_order.py")],
+                           select={"R1"})
+        assert len(findings) == 1
+        msg = findings[0].message
+        assert "Store._lock" in msg and "Counter._lock" in msg
+
+    def test_r5_accepts_compare_guarded_decrement(self, tmp_path):
+        good = tmp_path / "guarded.py"
+        good.write_text(
+            "class E:\n"
+            "    def unpin(self, e):\n"
+            "        if e.pin_count > 0:\n"
+            "            e.pin_count -= 1\n")
+        findings = _run_on([str(good)], select={"R5"})
+        assert not findings, findings
+
+    def test_r6_flags_pyc_without_source(self, tmp_path):
+        pkg = tmp_path / "ghost"
+        cache = pkg / "__pycache__"
+        cache.mkdir(parents=True)
+        (cache / "phantom.cpython-310.pyc").write_bytes(b"\x00magic")
+        findings = rules.check_pyc_orphans([str(tmp_path)], str(tmp_path))
+        assert len(findings) == 1 and findings[0].rule == "R6"
+        # A pyc WITH its source next door is fine.
+        (pkg / "phantom.py").write_text("x = 1\n")
+        assert not rules.check_pyc_orphans([str(tmp_path)], str(tmp_path))
+
+    def test_r2_resolves_time_import_alias(self, tmp_path):
+        bad = tmp_path / "aliased_sleep.py"
+        bad.write_text(
+            "import threading\n"
+            "import time as t\n"
+            "class P:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def tick(self):\n"
+            "        with self._lock:\n"
+            "            t.sleep(1)\n")
+        findings = _run_on([str(bad)], select={"R2"})
+        assert findings and "time.sleep" in findings[0].message
+
+    def test_r4_accepts_lambda_posted_to_loop(self, tmp_path):
+        ok = tmp_path / "lambda_post.py"
+        ok.write_text(
+            "def loop_only(kind):\n"
+            "    def deco(fn):\n"
+            "        return fn\n"
+            "    return deco\n"
+            "class M:\n"
+            "    def __init__(self, loop):\n"
+            "        self._loop = loop\n"
+            "    @loop_only('raylet')\n"
+            "    def tick(self):\n"
+            "        pass\n"
+            "    def kick(self):\n"
+            "        self._loop.post(lambda: self.tick(), 'tick')\n")
+        findings = _run_on([str(ok)], select={"R4"})
+        assert not findings, findings
+
+    def test_duplicate_identical_findings_get_distinct_fingerprints(
+            self, tmp_path):
+        """Two identical defects in one function must not collapse into
+        one baseline entry (fixing one would silently grandfather the
+        other)."""
+        bad = tmp_path / "twice.py"
+        bad.write_text(
+            "class R:\n"
+            "    def dec(self):\n"
+            "        self.local_refs -= 1\n"
+            "        self.local_refs -= 1\n")
+        findings = _run_on([str(bad)], select={"R5"})
+        assert len(findings) == 2
+        assert findings[0].fingerprint != findings[1].fingerprint
+
+    def test_fingerprints_survive_line_shifts(self, tmp_path):
+        src = ("import threading, time\n"
+               "class P:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "    def tick(self):\n"
+               "        with self._lock:\n"
+               "            time.sleep(1)\n")
+        a = tmp_path / "a.py"
+        a.write_text(src)
+        fp1 = _run_on([str(a)], select={"R2"})[0].fingerprint
+        a.write_text("# shifted\n# down\n" + src)
+        fp2 = _run_on([str(a)], select={"R2"})[0].fingerprint
+        assert fp1 == fp2
+
+
+@pytest.fixture
+def clean_graph():
+    """Deliberate-cycle tests must not leave edges/reports behind for
+    the rest of the armed suite."""
+    from ray_tpu._private.debug import lock_order
+    state = lock_order.snapshot()
+    yield lock_order
+    lock_order.restore(state)
+
+
+class TestLockWitness:
+    def test_abba_raises_and_does_not_strand_the_lock(self, clean_graph):
+        from ray_tpu._private.debug import (LockOrderViolation, diag_lock,
+                                            diag_rlock)
+        a = diag_lock("t_wit_A")
+        b = diag_rlock("t_wit_B")
+        with a:
+            with b:
+                pass
+        with pytest.raises(LockOrderViolation) as ei:
+            with b:
+                with a:
+                    pass
+        assert "t_wit_A" in str(ei.value) and "t_wit_B" in str(ei.value)
+        # The failed acquire must have released the inner lock.
+        assert a.acquire(timeout=1), "lock stranded after violation"
+        a.release()
+        assert clean_graph.violations(), "cycle not recorded"
+
+    def test_reentrant_rlock_adds_no_self_edge(self, clean_graph):
+        from ray_tpu._private.debug import diag_rlock
+        r = diag_rlock("t_wit_R")
+        with r:
+            with r:
+                pass
+        assert ("t_wit_R", "t_wit_R") not in clean_graph.graph_edges()
+
+    def test_cross_instance_same_name_nesting_is_observed_not_raised(
+            self, clean_graph):
+        """Two INSTANCES sharing a name (two stores of the same class)
+        nested in one thread: not reentrancy — it must be visible in
+        same_name_nestings() (the place to look for same-class
+        deadlocks) without failing the suite, since a name-level graph
+        cannot validate the instance order that makes it safe."""
+        from ray_tpu._private.debug import diag_lock
+        before = clean_graph.same_name_nestings().get("t_wit_twin", 0)
+        a = diag_lock("t_wit_twin")
+        b = diag_lock("t_wit_twin")
+        with a:
+            with b:
+                pass
+        assert clean_graph.same_name_nestings()["t_wit_twin"] == before + 1
+        assert not clean_graph.violations()
+
+    def test_condition_wait_releases_bookkeeping(self, clean_graph):
+        """A thread blocked in cv.wait() does NOT hold the lock: another
+        thread acquiring cv-then-other must create cv->other edges, and
+        the waiter must re-book on wakeup (no stale hold-time, no
+        phantom edges from the waiting period)."""
+        from ray_tpu._private.debug import diag_condition, diag_lock
+        cv = diag_condition(name="t_wit_CV")
+        other = diag_lock("t_wit_O")
+        woke = threading.Event()
+
+        def waiter():
+            with cv:
+                cv.wait(timeout=5)
+            woke.set()
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        time.sleep(0.2)
+        with cv:          # acquirable because the waiter released it
+            with other:   # edge cv->other, no cycle
+                pass
+            cv.notify_all()
+        assert woke.wait(timeout=5)
+        t.join(timeout=5)
+        edges = clean_graph.graph_edges()
+        assert ("t_wit_CV", "t_wit_O") in edges
+        assert ("t_wit_O", "t_wit_CV") not in edges
+
+    def test_hold_budget(self, clean_graph, monkeypatch):
+        from ray_tpu._private.debug import (LockHoldBudgetExceeded,
+                                            diag_lock)
+        monkeypatch.setenv("RAY_TPU_LOCK_HOLD_BUDGET_S", "0.05")
+        slow = diag_lock("t_wit_slow")
+        with pytest.raises(LockHoldBudgetExceeded):
+            with slow:
+                time.sleep(0.2)
+        # Budget raise happens on release: the lock itself is free.
+        assert slow.acquire(timeout=1)
+        slow.release()
+
+    def test_unarmed_factories_return_plain_primitives(self, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_LOCK_DIAG", "0")
+        from ray_tpu._private.debug import lock_order
+        lk = lock_order.diag_lock("t_plain")
+        assert type(lk).__module__ == "_thread", type(lk)
+
+
+class TestLoopAffinity:
+    def test_loop_only_blocks_foreign_thread_and_allows_loop(self):
+        from ray_tpu._private.debug import LoopAffinityError, loop_only
+        from ray_tpu._private.event_loop import EventLoop
+
+        calls = []
+
+        class Mgr:
+            @loop_only("t_wit_loop")
+            def tick(self):
+                calls.append(threading.get_ident())
+                return "ok"
+
+        m = Mgr()
+        with pytest.raises(LoopAffinityError):
+            m.tick()
+
+        loop = EventLoop("t_wit_loop-0001")
+        done = threading.Event()
+        loop.post(lambda: (m.tick(), done.set()), "tick")
+        assert done.wait(timeout=5)
+        loop.stop()
+        assert calls, "tick never ran on the loop"
+
+    def test_scheduler_tick_is_loop_only(self):
+        from ray_tpu._private.cluster_task_manager import ClusterTaskManager
+        assert getattr(ClusterTaskManager.schedule_and_dispatch,
+                       "__loop_only__", None) == "raylet"
+
+
+class TestDestructorContextRelease:
+    def test_del_under_store_lock_defers_the_cascade(self, ray_start_regular,
+                                                     clean_graph):
+        """Regression for the witness-caught MemoryStore<->TaskManager
+        ABBA: an ObjectRef.__del__ firing while the interrupted thread
+        holds a store lock must NOT run the out-of-scope cascade inline
+        (store delete, lineage eviction — foreign locks nested under
+        the store lock).  It enqueues; queries settle it synchronously
+        from a clean context."""
+        import gc
+
+        import numpy as np
+
+        import ray_tpu
+        from ray_tpu._private.worker import global_worker
+
+        core = global_worker().core_worker
+        ref = ray_tpu.put(np.zeros(256 * 1024, dtype=np.uint8))
+        oid = ref.object_id()
+        with core.memory_store._lock:   # simulate GC inside a lock region
+            del ref
+            gc.collect()
+        edges = clean_graph.graph_edges()
+        assert ("MemoryStore._lock", "TaskManager._lock") not in edges, \
+            "deletion cascade ran inline under the store lock"
+        assert ("MemoryStore._lock", "NodeObjectStore._lock") not in edges, \
+            "store eviction ran inline under the memory-store lock"
+        # Synchronously observable at the next query, like the old
+        # inline destructor was.
+        assert not core.reference_counter.has_reference(oid)
+        raylet = global_worker().cluster.head_node
+        assert not raylet.object_store.contains(oid)
+
+
+class TestSwallow:
+    def test_noted_counts_and_logs_once(self, capsys):
+        from ray_tpu._private.debug import swallow
+        site = "t_wit_site"
+        start = swallow.count(site)
+        for i in range(3):
+            try:
+                raise ValueError(f"boom{i}")
+            except ValueError as e:
+                swallow.noted(site, e)
+        assert swallow.count(site) == start + 3
+        err = capsys.readouterr().err
+        assert err.count("t_wit_site") == 1, "must log once per site"
+        assert "boom0" in err
+
+    def test_daemon_pool_pump_survives_and_accounts(self):
+        from ray_tpu._private.daemon_pool import DaemonPool
+        from ray_tpu._private.debug import swallow
+        before = swallow.count("daemon_pool.dispatch")
+        pool = DaemonPool(1, name="t_wit_pool")
+        done = threading.Event()
+        pool.submit(lambda: (_ for _ in ()).throw(RuntimeError("eat me")))
+        pool.submit(done.set)
+        assert done.wait(timeout=5), "pump died on a bad callback"
+        pool.stop()
+        assert swallow.count("daemon_pool.dispatch") == before + 1
+
+    def test_heartbeat_loop_accounts_swallowed_errors(self, ray_start_regular):
+        """Regression for the raylet._heartbeat_loop silent swallow: a
+        heartbeat that raises is now visible in swallow counts."""
+        from ray_tpu._private import fault_injection
+        from ray_tpu._private.debug import swallow
+        from ray_tpu._private.worker import global_worker
+        before = swallow.count("raylet.heartbeat")
+        fault_injection.arm("node.heartbeat", "error", count=2)
+        try:
+            deadline = time.monotonic() + 10
+            while (swallow.count("raylet.heartbeat") < before + 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+        finally:
+            fault_injection.disarm("node.heartbeat")
+        assert swallow.count("raylet.heartbeat") >= before + 2
+        assert fault_injection.fired("node.heartbeat") >= 2
+        # And the node must NOT have been declared dead by two missed
+        # beats (num_heartbeats_timeout default is far higher).
+        gcs = global_worker().cluster.gcs
+        assert gcs.node_manager.alive_nodes, "node wrongly declared dead"

@@ -304,6 +304,12 @@ class TestSanitizers:
     @pytest.mark.parametrize("flags,tag", [
         ("-fsanitize=address,undefined", "asan"),
         ("-fsanitize=thread", "tsan"),
+        # Spill-callback variant (graftcheck PR): evictors copy victim
+        # payloads out through their own mapping while pinned — the
+        # exact read the Python LocalObjectManager performs — so TSan
+        # sweeps payload reads racing allocator reuse on the OOM/evict
+        # path, not just the metadata tables.
+        ("-fsanitize=thread -DGRAFT_SPILL_CALLBACKS", "tsan-spill"),
     ])
     def test_concurrent_store_under_sanitizer(self, flags, tag,
                                               tmp_path):

@@ -1,0 +1,13 @@
+"""graftcheck — project-specific concurrency-invariant static analysis.
+
+Rules R1-R7 (see :mod:`graftcheck.rules`) encode the invariants this
+repo has repeatedly paid for at runtime; the dynamic counterpart is the
+lock-order witness in ``ray_tpu/_private/debug``.  Run as
+``python -m graftcheck`` from the repo root; findings ratchet against
+``baseline.json`` (:mod:`graftcheck.baseline`).
+"""
+
+from graftcheck.analyzer import Finding, Program, load_program  # noqa: F401
+from graftcheck.rules import ALL_RULES, RULE_TITLES, run_all  # noqa: F401
+
+__version__ = "1.0"

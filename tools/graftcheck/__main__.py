@@ -1,0 +1,106 @@
+"""CLI: ``python -m graftcheck [paths...]``.
+
+Exit codes: 0 clean (all findings baselined), 1 findings outside the
+baseline, 2 usage / parse failure.  Run from the repo root (a
+``graftcheck`` symlink at the root points at ``tools/graftcheck`` so
+``-m`` resolves without installing anything).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from graftcheck import analyzer, baseline as baseline_mod, rules
+
+
+def _repo_root() -> str:
+    # tools/graftcheck/__main__.py -> repo root is two levels up from the
+    # package dir (symlinked or not, __file__ resolves inside tools/).
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.realpath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftcheck",
+        description="concurrency-invariant static analysis for ray_tpu")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to analyze (default: ray_tpu/)")
+    ap.add_argument("--baseline", default=baseline_mod.DEFAULT_BASELINE,
+                    help="baseline file (default: committed baseline)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignore the baseline")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from current findings")
+    ap.add_argument("--fail-stale", action="store_true",
+                    help="also exit non-zero on stale baseline entries "
+                         "(the ratchet check used by tests)")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated subset, e.g. R1,R2")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid in rules.ALL_RULES:
+            print(f"{rid}: {rules.RULE_TITLES[rid]}")
+        return 0
+
+    root = _repo_root()
+    paths = args.paths or [os.path.join(root, "ray_tpu")]
+    paths = [os.path.abspath(p) for p in paths]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"graftcheck: no such path: {p}", file=sys.stderr)
+            return 2
+
+    selected = {r.strip().upper() for r in args.rules.split(",")
+                if r.strip()} or None
+    prog, parse_errors = analyzer.load_program(paths, root)
+    findings = parse_errors + rules.run_all(prog, paths, root,
+                                            rules=selected)
+
+    if args.update_baseline:
+        prev = baseline_mod.load(args.baseline)
+        baseline_mod.save(args.baseline, findings, prev)
+        print(f"graftcheck: wrote {len(findings)} baselined finding(s) "
+              f"to {args.baseline}")
+        return 0
+
+    base = {} if args.no_baseline else baseline_mod.load(args.baseline)
+    new, stale = baseline_mod.split(findings, base)
+
+    if args.as_json:
+        print(json.dumps({
+            "new": [vars(f) | {"fingerprint": f.fingerprint} for f in new],
+            "baselined": len(findings) - len(new),
+            "stale": stale,
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        if stale:
+            print(f"graftcheck: {len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'} (fixed or moved — "
+                  f"remove from {os.path.basename(args.baseline)}):",
+                  file=sys.stderr)
+            for e in stale:
+                print(f"  {e['fingerprint']}  [{e['rule']}] {e['path']} "
+                      f"{e['symbol']}", file=sys.stderr)
+        print(f"graftcheck: {len(new)} new finding(s), "
+              f"{len(findings) - len(new)} baselined, {len(stale)} stale",
+              file=sys.stderr)
+
+    if new:
+        return 1
+    if stale and args.fail_stale:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

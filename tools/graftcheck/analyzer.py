@@ -1,0 +1,424 @@
+"""graftcheck's whole-program model: parse the tree, resolve locks,
+attribute types and call edges, and hand rule passes a queryable index.
+
+Every rule in :mod:`graftcheck.rules` was paid for at runtime first
+(ISSUE 7): the PR-6 store-lock -> refcount-lock ABBA deadlock (R1), the
+GCS view aliasing a raylet's live ``NodeResources`` ledger (R3), the
+duplicate terminal transition driving refcounts negative (R5).  The
+analyzer is deliberately *project-shaped*: it understands this repo's
+idioms (``self._lock = diag_rlock(...)``, ``loop.post(self.tick, ...)``,
+``with self._lock:``) rather than aiming for soundness on arbitrary
+Python.  Over-approximation is expected and absorbed by the committed
+baseline (see :mod:`graftcheck.baseline`).
+
+Resolution rules, in order of trust:
+
+* lock attributes — ``self.X = threading.Lock()/RLock()/Condition()`` or
+  the ``diag_*`` factories; ``Condition(self._lock)`` aliases the
+  condition to the wrapped lock's node;
+* attribute types — ``self.X = ClassName(...)`` against the global class
+  registry, plus a snake_case->CamelCase naming heuristic for
+  constructor parameters (``raylet`` -> ``Raylet``), which is how the
+  cross-component edges (task manager -> store -> refcounter) resolve;
+* call edges — ``self.m()``, ``self.attr.m()``, ``mod.f()``, ``f()``;
+  anything dynamic (stored callbacks, ``reply()``) is out of scope by
+  design.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+LOCK_FACTORIES = {
+    "Lock": "lock",
+    "RLock": "rlock",
+    "Condition": "condition",
+    "diag_lock": "lock",
+    "diag_rlock": "rlock",
+    "diag_condition": "condition",
+}
+
+# Registrations that hand a closure to an EVENT LOOP thread (legitimate
+# @loop_only call sites).  Deliberately excludes DaemonPool.submit —
+# pool callbacks run on arbitrary pump threads, which is exactly the
+# off-loop shape R4 exists to catch.
+LOOP_POST_METHODS = {"post", "schedule_every", "schedule_after"}
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str          # repo-relative
+    line: int
+    symbol: str        # enclosing qualname (Class.method / module scope)
+    message: str
+    detail: str = ""   # stable, line-number-free content for fingerprints
+
+    @property
+    def fingerprint(self) -> str:
+        raw = f"{self.rule}|{self.path}|{self.symbol}|{self.detail or self.message}"
+        return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.rule}] {self.symbol}: "
+                f"{self.message}  (fingerprint {self.fingerprint})")
+
+
+@dataclass
+class FunctionModel:
+    qualname: str                  # "Class.method" or "function"
+    node: ast.AST                  # FunctionDef / AsyncFunctionDef
+    cls: Optional["ClassModel"]
+    module: "ModuleModel"
+    loop_only_kind: Optional[str] = None
+    #: names of nested defs handed to loop.post/schedule_* in this body
+    loop_entry_closures: Set[str] = field(default_factory=set)
+
+
+@dataclass
+class ClassModel:
+    name: str
+    module: "ModuleModel"
+    node: ast.ClassDef
+    methods: Dict[str, FunctionModel] = field(default_factory=dict)
+    #: attr -> (lock_id, kind)
+    lock_attrs: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    #: attr -> class name (for cross-component call resolution)
+    attr_types: Dict[str, str] = field(default_factory=dict)
+    #: attrs with "pending" in the name assigned anywhere in the class
+    pending_attrs: Set[str] = field(default_factory=set)
+
+
+@dataclass
+class ModuleModel:
+    path: str                      # repo-relative
+    modname: str                   # dotted-ish short name
+    tree: ast.Module
+    classes: Dict[str, ClassModel] = field(default_factory=dict)
+    functions: Dict[str, FunctionModel] = field(default_factory=dict)
+    #: module-global var -> (lock_id, kind)
+    module_locks: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    #: local alias -> imported module short name ("time", "fault_injection")
+    import_aliases: Dict[str, str] = field(default_factory=dict)
+
+
+_SNAKE_RE = re.compile(r"_+")
+
+
+def _camel(snake: str) -> str:
+    return "".join(p.capitalize() for p in _SNAKE_RE.split(snake.strip("_"))
+                   if p)
+
+
+def _call_tail(func: ast.AST) -> Optional[str]:
+    """Terminal name of a call target: threading.Lock -> 'Lock'."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _is_self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class Program:
+    """The analyzed tree: modules, a global class registry, lock ids and
+    an interprocedural may-acquire cache."""
+
+    def __init__(self):
+        self.modules: List[ModuleModel] = []
+        self.class_registry: Dict[str, ClassModel] = {}
+        #: lock_id -> kind ("lock" | "rlock" | "condition")
+        self.lock_kinds: Dict[str, str] = {}
+        self._may_acquire_cache: Dict[int, Set[str]] = {}
+        self._loop_only_by_name: Dict[str, List[FunctionModel]] = {}
+
+    # -- construction ----------------------------------------------------
+    def add_source(self, path: str, rel: str, source: str) -> None:
+        tree = ast.parse(source, filename=path)
+        modname = os.path.splitext(os.path.basename(rel))[0]
+        mod = ModuleModel(path=rel, modname=modname, tree=tree)
+        self._collect_imports(mod)
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                cm = ClassModel(name=node.name, module=mod, node=node)
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        fm = FunctionModel(
+                            qualname=f"{node.name}.{item.name}",
+                            node=item, cls=cm, module=mod)
+                        fm.loop_only_kind = self._loop_only_kind(item)
+                        cm.methods[item.name] = fm
+                mod.classes[node.name] = cm
+                # Last definition wins on name collisions across modules;
+                # names in this tree are unique in practice.
+                self.class_registry[node.name] = cm
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fm = FunctionModel(qualname=node.name, node=node, cls=None,
+                                   module=mod)
+                fm.loop_only_kind = self._loop_only_kind(node)
+                mod.functions[node.name] = fm
+            elif isinstance(node, ast.Assign):
+                self._maybe_module_lock(mod, node)
+        self.modules.append(mod)
+
+    def finalize(self) -> None:
+        """Second pass: lock attrs, attr types, condition aliasing, loop
+        entry closures.  Needs the full class registry, hence separate
+        from :meth:`add_source`."""
+        for mod in self.modules:
+            for cm in mod.classes.values():
+                self._collect_class_state(cm)
+        for mod in self.modules:
+            for fm in self._functions(mod):
+                self._collect_loop_entries(fm)
+                if fm.loop_only_kind:
+                    self._loop_only_by_name.setdefault(
+                        fm.node.name, []).append(fm)
+
+    def _functions(self, mod: ModuleModel) -> Iterable[FunctionModel]:
+        yield from mod.functions.values()
+        for cm in mod.classes.values():
+            yield from cm.methods.values()
+
+    def all_functions(self) -> Iterable[FunctionModel]:
+        for mod in self.modules:
+            yield from self._functions(mod)
+
+    def _collect_imports(self, mod: ModuleModel) -> None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    short = alias.name.split(".")[-1]
+                    mod.import_aliases[alias.asname or short] = short
+            elif isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    mod.import_aliases[alias.asname or alias.name] = \
+                        alias.name
+
+    def _loop_only_kind(self, fn: ast.AST) -> Optional[str]:
+        for dec in getattr(fn, "decorator_list", []):
+            if (isinstance(dec, ast.Call)
+                    and _call_tail(dec.func) == "loop_only" and dec.args):
+                arg = dec.args[0]
+                if isinstance(arg, ast.Constant) and isinstance(
+                        arg.value, str):
+                    return arg.value
+                return "?"
+        return None
+
+    def _lock_factory_kind(self, call: ast.AST) -> Optional[str]:
+        if not isinstance(call, ast.Call):
+            return None
+        tail = _call_tail(call.func)
+        if tail not in LOCK_FACTORIES:
+            return None
+        # `threading.Condition` / bare `Condition` / `diag_condition` all
+        # count; anything else named Lock (e.g. a local class) is not a
+        # pattern this tree uses.
+        return LOCK_FACTORIES[tail]
+
+    def _maybe_module_lock(self, mod: ModuleModel, node: ast.Assign) -> None:
+        kind = self._lock_factory_kind(node.value)
+        if kind is None:
+            return
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                lock_id = f"{mod.modname}.{tgt.id}"
+                mod.module_locks[tgt.id] = (lock_id, kind)
+                self.lock_kinds[lock_id] = kind
+
+    def _collect_class_state(self, cm: ClassModel) -> None:
+        # Pass A: direct lock creations + attr types + pending attrs.
+        cond_wraps: List[Tuple[str, ast.Call]] = []
+        for fm in cm.methods.values():
+            for node in ast.walk(fm.node):
+                if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                    continue
+                attr = _is_self_attr(node.targets[0])
+                if attr is None:
+                    continue
+                if "pending" in attr:
+                    cm.pending_attrs.add(attr)
+                kind = self._lock_factory_kind(node.value)
+                if kind is not None:
+                    call = node.value
+                    wraps_lock = (
+                        kind == "condition" and call.args
+                        and _is_self_attr(call.args[0]) is not None)
+                    if wraps_lock:
+                        cond_wraps.append((attr, call))
+                    else:
+                        lock_id = f"{cm.name}.{attr}"
+                        cm.lock_attrs[attr] = (lock_id, kind)
+                        self.lock_kinds[lock_id] = kind
+                    continue
+                if isinstance(node.value, ast.Call):
+                    tail = _call_tail(node.value.func)
+                    if tail in self.class_registry:
+                        cm.attr_types[attr] = tail
+                elif isinstance(node.value, ast.Name):
+                    # self._raylet = raylet  (ctor param, by naming)
+                    guess = _camel(node.value.id)
+                    if guess in self.class_registry:
+                        cm.attr_types[attr] = guess
+        # Pass B: Condition(self._lock) aliases to the wrapped lock.
+        for attr, call in cond_wraps:
+            wrapped = _is_self_attr(call.args[0])
+            if wrapped in cm.lock_attrs:
+                cm.lock_attrs[attr] = cm.lock_attrs[wrapped]
+            else:
+                lock_id = f"{cm.name}.{attr}"
+                cm.lock_attrs[attr] = (lock_id, "condition")
+                self.lock_kinds[lock_id] = "condition"
+
+    def _collect_loop_entries(self, fm: FunctionModel) -> None:
+        """Nested functions handed to ``loop.post(fn, ...)`` (or
+        ``schedule_*`` / pool ``submit``) run on the loop thread: calls
+        they make to @loop_only methods are legitimate."""
+        for node in ast.walk(fm.node):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = _call_tail(node.func)
+            if tail not in LOOP_POST_METHODS:
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    fm.loop_entry_closures.add(arg.id)
+
+    # -- resolution ------------------------------------------------------
+    def resolve_lock(self, fm: FunctionModel, expr: ast.AST) -> Optional[str]:
+        """Lock id for a `with EXPR:` context item, or None."""
+        attr = _is_self_attr(expr)
+        if attr is not None and fm.cls is not None:
+            hit = fm.cls.lock_attrs.get(attr)
+            return hit[0] if hit else None
+        if isinstance(expr, ast.Name):
+            hit = fm.module.module_locks.get(expr.id)
+            return hit[0] if hit else None
+        # self.attr._lock — another component's lock taken directly.
+        if (isinstance(expr, ast.Attribute)
+                and (inner := _is_self_attr(expr.value)) is not None
+                and fm.cls is not None):
+            tcls = self.class_registry.get(
+                fm.cls.attr_types.get(inner, ""))
+            if tcls is not None:
+                hit = tcls.lock_attrs.get(expr.attr)
+                return hit[0] if hit else None
+        return None
+
+    def resolve_call(self, fm: FunctionModel,
+                     call: ast.Call) -> Optional[FunctionModel]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            target = fm.module.functions.get(func.id)
+            if target is not None:
+                return target
+            cls = fm.module.classes.get(func.id) or (
+                self.class_registry.get(func.id)
+                if func.id in fm.module.import_aliases else None)
+            if cls is not None:
+                return cls.methods.get("__init__")
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        base = func.value
+        if isinstance(base, ast.Name):
+            if base.id == "self" and fm.cls is not None:
+                return fm.cls.methods.get(func.attr)
+            alias = fm.module.import_aliases.get(base.id)
+            if alias is not None:
+                for mod in self.modules:
+                    if mod.modname == alias:
+                        return mod.functions.get(func.attr)
+            guess = _camel(base.id)
+            tcls = self.class_registry.get(guess)
+            if tcls is not None and base.id not in ("self",):
+                return tcls.methods.get(func.attr)
+            return None
+        inner = _is_self_attr(base)
+        if inner is not None and fm.cls is not None:
+            tname = fm.cls.attr_types.get(inner)
+            if tname is None:
+                return None
+            tcls = self.class_registry.get(tname)
+            if tcls is not None:
+                return tcls.methods.get(func.attr)
+        return None
+
+    # -- interprocedural may-acquire -------------------------------------
+    def may_acquire(self, fm: FunctionModel,
+                    _stack: Optional[Set[int]] = None) -> Set[str]:
+        """Locks ``fm`` may take anywhere in itself or its (resolvable)
+        callees.  Over-approximate by construction; recursion-safe."""
+        key = id(fm.node)
+        cached = self._may_acquire_cache.get(key)
+        if cached is not None:
+            return cached
+        stack = _stack if _stack is not None else set()
+        if key in stack:
+            return set()
+        stack.add(key)
+        acquired: Set[str] = set()
+        for node in ast.walk(fm.node):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    lid = self.resolve_lock(fm, item.context_expr)
+                    if lid is not None:
+                        acquired.add(lid)
+            elif isinstance(node, ast.Call):
+                callee = self.resolve_call(fm, node)
+                if callee is not None:
+                    acquired |= self.may_acquire(callee, stack)
+        stack.discard(key)
+        if _stack is None or not stack:
+            self._may_acquire_cache[key] = acquired
+        return acquired
+
+    def loop_only_candidates(self, name: str) -> List[FunctionModel]:
+        return self._loop_only_by_name.get(name, [])
+
+
+def load_program(paths: List[str], repo_root: str) -> Tuple[Program, List[Finding]]:
+    """Parse every .py under ``paths`` into one Program.  Unparseable
+    files become findings rather than crashes."""
+    prog = Program()
+    errors: List[Finding] = []
+    for path in sorted(_iter_py(paths)):
+        rel = os.path.relpath(path, repo_root)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                src = f.read()
+            prog.add_source(path, rel, src)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            errors.append(Finding(
+                rule="parse", path=rel,
+                line=getattr(e, "lineno", 0) or 0, symbol="<module>",
+                message=f"unparseable: {e}", detail="unparseable"))
+    prog.finalize()
+    return prog, errors
+
+
+def _iter_py(paths: List[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in filenames:
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)

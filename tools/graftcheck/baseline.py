@@ -1,0 +1,67 @@
+"""Baseline ratchet: land green, never regress, shrink over time.
+
+The first full run over a living tree surfaces findings that are either
+deliberate (a sanctioned blocking call the baseline documents with a
+one-line ``why``) or not worth a risky refactor today.  Those are
+grandfathered into ``baseline.json`` BY FINGERPRINT (rule + path +
+symbol + stable detail — no line numbers, so unrelated edits don't churn
+it).  The contract, enforced by ``tests/test_graftcheck.py``:
+
+* a finding NOT in the baseline fails the run (new violations fail);
+* a baseline entry with no matching finding is STALE and must be
+  removed (removals shrink the baseline — the ratchet only tightens).
+
+``python -m graftcheck --update-baseline`` rewrites the file from the
+current findings, preserving existing ``why`` annotations.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Tuple
+
+from graftcheck.analyzer import Finding
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def load(path: str) -> Dict[str, dict]:
+    """fingerprint -> entry dict.  Missing file = empty baseline."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return {e["fingerprint"]: e for e in data.get("findings", [])}
+
+
+def save(path: str, findings: List[Finding],
+         previous: Dict[str, dict]) -> None:
+    entries = []
+    for f in findings:
+        prev = previous.get(f.fingerprint, {})
+        entries.append({
+            "fingerprint": f.fingerprint,
+            "rule": f.rule,
+            "path": f.path,
+            "symbol": f.symbol,
+            "why": prev.get("why", "TODO: justify or fix"),
+        })
+    entries.sort(key=lambda e: (e["rule"], e["path"], e["symbol"]))
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1, "findings": entries}, f, indent=2)
+        f.write("\n")
+
+
+def split(findings: List[Finding], baseline: Dict[str, dict]
+          ) -> Tuple[List[Finding], List[dict]]:
+    """(new_findings_not_in_baseline, stale_baseline_entries)."""
+    seen = set()
+    new = []
+    for f in findings:
+        if f.fingerprint in baseline:
+            seen.add(f.fingerprint)
+        else:
+            new.append(f)
+    stale = [e for fp, e in sorted(baseline.items()) if fp not in seen]
+    return new, stale

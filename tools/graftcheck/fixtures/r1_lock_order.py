@@ -1,0 +1,38 @@
+"""R1 fixture: ABBA lock-order cycle across a call edge.
+
+The shape of the PR-6 deadlock: component A holds its lock and calls
+into B (which takes B's lock); B's other path holds B's lock and calls
+back into A.  Never imported — parsed only by graftcheck.
+"""
+
+import threading
+
+
+class Store:
+    def __init__(self, counter):
+        self._lock = threading.Lock()
+        self._counter = counter
+
+    def spill_publish(self, oid, url):
+        with self._lock:
+            # store lock held -> refcount lock taken inside
+            self._counter.set_spilled_url(oid, url)
+
+    def delete(self, oid):
+        with self._lock:
+            pass
+
+
+class Counter:
+    def __init__(self, store: "Store"):
+        self._lock = threading.Lock()
+        self._store = store
+
+    def set_spilled_url(self, oid, url):
+        with self._lock:
+            pass
+
+    def on_last_ref_dropped(self, oid):
+        with self._lock:
+            # refcount lock held -> store lock taken inside: ABBA
+            self._store.delete(oid)

@@ -1,0 +1,21 @@
+"""R2 fixture: blocking calls inside a held-lock region.
+
+Never imported — parsed only by graftcheck.
+"""
+
+import threading
+import time
+
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition()
+
+    def tick(self):
+        with self._lock:
+            time.sleep(0.5)          # R2: sleep under lock
+
+    def drain(self):
+        with self._cond:
+            self._cond.wait()        # R2: wait() without timeout

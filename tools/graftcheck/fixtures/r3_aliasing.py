@@ -1,0 +1,19 @@
+"""R3 fixture: a registration path aliasing another object's mutable
+state — the r6 lost-dispatch root cause (the GCS merge view stored the
+raylet's live NodeResources instead of a copy, so a stale usage-poll
+write-back erased racing allocate/release calls).
+
+Never imported — parsed only by graftcheck.
+"""
+
+
+class ResourceManager:
+    def __init__(self):
+        self._views = {}
+        self._last = None
+
+    def register_raylet(self, raylet):
+        # R3: stores raylet.local_resources itself; any later mutation
+        # through self._views writes into the raylet's live ledger.
+        self._views[raylet.node_id] = raylet.local_resources
+        self._last = raylet.local_resources

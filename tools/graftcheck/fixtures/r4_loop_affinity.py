@@ -1,0 +1,27 @@
+"""R4 fixture: a @loop_only method invoked directly from a thread that
+is not the event loop (here: an RPC handler), instead of being posted.
+
+Never imported — parsed only by graftcheck.
+"""
+
+
+def loop_only(kind):           # stand-in so the fixture parses stand-alone
+    def deco(fn):
+        return fn
+    return deco
+
+
+class TaskManager:
+    def __init__(self, loop):
+        self._loop = loop
+        self._queue = []
+
+    @loop_only("raylet")
+    def schedule_and_dispatch(self):
+        while self._queue:
+            self._queue.pop()
+
+    def on_lease_request(self, spec):
+        self._queue.append(spec)
+        # R4: must be self._loop.post(self.schedule_and_dispatch, ...)
+        self.schedule_and_dispatch()

@@ -1,0 +1,29 @@
+"""R5 fixture: a terminal-transition handler that mutates refcounts
+before popping its pending entry (so a duplicate/stale completion
+double-removes refs), plus an unfloored decrement (so the double-remove
+goes negative and total()==0 frees the object under a live ref).
+
+Never imported — parsed only by graftcheck.
+"""
+
+
+class TaskManager:
+    def __init__(self):
+        self._pending_tasks = {}
+        self._counter = None
+
+    def complete_task(self, task_id, returns):
+        # R5: refcount mutation precedes the pending pop — the pop is
+        # the idempotency gate; a stale second completion re-runs this.
+        self._counter.remove_submitted_task_refs(returns)
+        entry = self._pending_tasks.pop(task_id, None)
+        if entry is None:
+            return
+
+
+class Reference:
+    def __init__(self):
+        self.local_refs = 1
+
+    def dec(self):
+        self.local_refs -= 1     # R5: unfloored decrement

@@ -1,0 +1,18 @@
+"""R7 fixture: a daemon pump loop that eats every exception silently.
+
+Never imported — parsed only by graftcheck.
+"""
+
+
+class Pump:
+    def __init__(self, queue):
+        self._queue = queue
+        self._stopped = False
+
+    def _loop(self):
+        while not self._stopped:
+            fn = self._queue.get()
+            try:
+                fn()
+            except Exception:
+                pass               # R7: evidence destroyed

@@ -1,0 +1,615 @@
+"""graftcheck rule passes R1-R7.
+
+Each rule encodes an invariant this repo has already paid for at runtime
+(see ISSUE 7 / CHANGES.md):
+
+========  ==============================================================
+R1        lock-order graph over `with <lock>:` regions and call edges is
+          acyclic (PR-6 ABBA: store lock -> refcount lock vs the spill
+          publish path taking them in reverse)
+R2        no blocking call while a lock is held: sleeps, waits without a
+          timeout, joins, socket/subprocess/file IO, RPC client sends
+R3        registration/merge paths must not alias another object's
+          mutable containers (the r6 lost-dispatch root cause: the GCS
+          stored a raylet's live NodeResources dict)
+R4        @loop_only methods are only reached from loop threads: other
+          @loop_only code or closures handed to loop.post/schedule_*
+R5        terminal-transition idempotency: pop the pending entry before
+          mutating refcounts; refcount decrements are floored at zero
+R6        no compiled-only code: a .pyc under __pycache__ whose source
+          .py is gone is an orphan (this PR replaced two such packages)
+R7        no silent exception swallowing in daemon pump loops — use
+          ray_tpu._private.debug.swallow.noted(site, exc)
+========  ==============================================================
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from graftcheck.analyzer import (LOOP_POST_METHODS, Finding, FunctionModel,
+                                 Program, _call_tail, _is_self_attr)
+
+ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7")
+
+RULE_TITLES = {
+    "R1": "lock-order graph must be acyclic",
+    "R2": "no blocking calls under a held lock",
+    "R3": "no aliased mutable state across components",
+    "R4": "@loop_only methods only reached from their event loop",
+    "R5": "terminal-transition idempotency / refcount floor hygiene",
+    "R6": "no pyc-without-source orphan packages",
+    "R7": "no silent exception swallowing in pump loops",
+}
+
+
+# ---------------------------------------------------------------------------
+# Shared region walker: statements executed while a given lock is held.
+
+
+def _walk_lock_regions(prog: Program, fm: FunctionModel, visit):
+    """Call ``visit(lock_id, with_node)`` for every `with <lock>` region
+    in ``fm``; nested regions are visited with their own id."""
+
+    def rec(node: ast.AST):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if isinstance(child, ast.With):
+                lid = None
+                for item in child.items:
+                    lid = prog.resolve_lock(fm, item.context_expr) or lid
+                if lid is not None:
+                    visit(lid, child)
+            rec(child)
+
+    rec(fm.node)
+
+
+# ---------------------------------------------------------------------------
+# R1 — lock-order graph.
+
+
+def check_lock_order(prog: Program) -> List[Finding]:
+    # edge -> (site_path, site_line, via) provenance of first sighting
+    edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+
+    def add_edge(a: str, b: str, fm: FunctionModel, line: int, via: str):
+        if a != b and (a, b) not in edges:
+            edges[(a, b)] = (fm.module.path, line, via)
+
+    self_edges: Dict[str, Tuple[str, int, str]] = {}
+
+    for fm in prog.all_functions():
+
+        def visit(lid: str, with_node: ast.With, fm=fm):
+            def scan(node: ast.AST):
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef, ast.Lambda)):
+                        continue
+                    if isinstance(child, ast.With):
+                        inner = None
+                        for item in child.items:
+                            inner = prog.resolve_lock(fm, item.context_expr) \
+                                or inner
+                        if inner is not None:
+                            if inner == lid and \
+                                    prog.lock_kinds.get(lid) == "lock":
+                                self_edges.setdefault(
+                                    lid, (fm.module.path, child.lineno,
+                                          fm.qualname))
+                            add_edge(lid, inner, fm, child.lineno,
+                                     f"nested with in {fm.qualname}")
+                            # inner region handled by its own visit()
+                    elif isinstance(child, ast.Call):
+                        callee = prog.resolve_call(fm, child)
+                        if callee is not None:
+                            for m in prog.may_acquire(callee):
+                                if m == lid and \
+                                        prog.lock_kinds.get(lid) == "lock":
+                                    self_edges.setdefault(
+                                        lid, (fm.module.path, child.lineno,
+                                              f"{fm.qualname} -> "
+                                              f"{callee.qualname}"))
+                                add_edge(lid, m, fm, child.lineno,
+                                         f"{fm.qualname} -> "
+                                         f"{callee.qualname}")
+                    scan(child)
+
+            scan(with_node)
+
+        _walk_lock_regions(prog, fm, visit)
+
+    findings: List[Finding] = []
+    for comp in _sccs(edges):
+        if len(comp) < 2:
+            continue
+        nodes = sorted(comp)
+        legs = []
+        for (a, b), (path, line, via) in sorted(edges.items()):
+            if a in comp and b in comp:
+                legs.append(f"{a} -> {b} at {path}:{line} ({via})")
+        path, line, _ = edges[next(
+            (a, b) for (a, b) in edges if a in comp and b in comp)]
+        findings.append(Finding(
+            rule="R1", path=path, line=line, symbol="lock-graph",
+            message=("lock-order cycle: " + " <-> ".join(nodes)
+                     + "; edges: " + "; ".join(legs[:6])),
+            detail="cycle:" + ",".join(nodes)))
+    for lid, (path, line, via) in sorted(self_edges.items()):
+        findings.append(Finding(
+            rule="R1", path=path, line=line, symbol=via,
+            message=(f"non-reentrant lock {lid} may be re-acquired while "
+                     f"held (via {via}) — self-deadlock"),
+            detail=f"self:{lid}:{via}"))
+    return findings
+
+
+def _sccs(edges: Dict[Tuple[str, str], object]) -> List[Set[str]]:
+    succ: Dict[str, List[str]] = {}
+    nodes: Set[str] = set()
+    for a, b in edges:
+        succ.setdefault(a, []).append(b)
+        nodes.add(a)
+        nodes.add(b)
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    out: List[Set[str]] = []
+    counter = [0]
+
+    def strong(v: str):
+        work = [(v, iter(succ.get(v, ())))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(succ.get(w, ()))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                low[work[-1][0]] = min(low[work[-1][0]], low[node])
+            if low[node] == index[node]:
+                comp = set()
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.add(w)
+                    if w == node:
+                        break
+                out.append(comp)
+
+    for v in sorted(nodes):
+        if v not in index:
+            strong(v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# R2 — blocking calls under a held lock.
+
+_SOCKET_BLOCKERS = {"recv", "recv_into", "accept", "sendall", "connect"}
+_SUBPROCESS_BLOCKERS = {"run", "call", "check_call", "check_output", "Popen"}
+
+
+def _blocking_reason(fm: FunctionModel, call: ast.Call) -> Optional[str]:
+    func = call.func
+    tail = _call_tail(func)
+    if tail == "sleep" and isinstance(func, ast.Attribute) \
+            and isinstance(func.value, ast.Name) \
+            and fm.module.import_aliases.get(
+                func.value.id, func.value.id) == "time":
+        return "time.sleep"
+    if tail == "wait" and not call.args and not any(
+            kw.arg == "timeout" for kw in call.keywords):
+        return "wait() without timeout"
+    if tail == "join" and not call.args and not any(
+            kw.arg == "timeout" for kw in call.keywords):
+        return "join() without timeout"
+    if tail in _SOCKET_BLOCKERS and isinstance(func, ast.Attribute):
+        return f"socket .{tail}()"
+    if tail in _SUBPROCESS_BLOCKERS and isinstance(func, ast.Attribute) \
+            and isinstance(func.value, ast.Name) \
+            and func.value.id == "subprocess":
+        return f"subprocess.{tail}"
+    if tail == "open" and isinstance(func, ast.Name):
+        return "file open()"
+    if tail == "call" and isinstance(func, ast.Attribute):
+        recv = func.value
+        name = recv.attr if isinstance(recv, ast.Attribute) else (
+            recv.id if isinstance(recv, ast.Name) else "")
+        if "client" in name or "rpc" in name:
+            return f"RPC send via {name}.call()"
+    return None
+
+
+def check_blocking_under_lock(prog: Program) -> List[Finding]:
+    findings: List[Finding] = []
+
+    for fm in prog.all_functions():
+
+        def visit(lid: str, with_node: ast.With, fm=fm):
+            def scan(node: ast.AST):
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef, ast.Lambda)):
+                        continue
+                    if isinstance(child, ast.Call):
+                        reason = _blocking_reason(fm, child)
+                        # A cv.wait on the *held* lock's own condition is
+                        # the one sanctioned block — but only with a
+                        # timeout, which the reason already requires.
+                        if reason is not None:
+                            findings.append(Finding(
+                                rule="R2", path=fm.module.path,
+                                line=child.lineno, symbol=fm.qualname,
+                                message=(f"blocking call ({reason}) while "
+                                         f"holding {lid}"),
+                                detail=f"{lid}:{reason}"))
+                    scan(child)
+
+            scan(with_node)
+
+        _walk_lock_regions(prog, fm, visit)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# R3 — aliased mutable state across components.
+
+_R3_METHOD_RE = re.compile(
+    r"^(register|merge|update|attach|add_|on_|__init__)")
+_R3_MUTABLE_ATTR_RE = re.compile(
+    r"(resources|available|total|entries|refs|queue|table|buffers?"
+    r"|labels|cache|state|stats|view|dict|map)")
+
+
+def check_aliased_state(prog: Program) -> List[Finding]:
+    findings: List[Finding] = []
+    for fm in prog.all_functions():
+        if not _R3_METHOD_RE.search(fm.node.name):
+            continue
+        params = {a.arg for a in fm.node.args.args} - {"self"}
+        if not params:
+            continue
+        for node in ast.walk(fm.node):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            tgt = node.targets[0]
+            stores_on_self = (
+                _is_self_attr(tgt) is not None
+                or (isinstance(tgt, ast.Subscript)
+                    and isinstance(tgt.value, ast.Attribute)
+                    and _is_self_attr(tgt.value) is not None))
+            if not stores_on_self:
+                continue
+            rhs = node.value
+            if not isinstance(rhs, ast.Attribute):
+                continue          # calls (.copy(), dict(...)) are fine
+            root = rhs
+            while isinstance(root.value, ast.Attribute):
+                root = root.value
+            if not (isinstance(root.value, ast.Name)
+                    and root.value.id in params):
+                continue
+            if not _R3_MUTABLE_ATTR_RE.search(rhs.attr):
+                continue
+            findings.append(Finding(
+                rule="R3", path=fm.module.path, line=node.lineno,
+                symbol=fm.qualname,
+                message=(f"stores a reference to "
+                         f"{root.value.id}.{rhs.attr} — another "
+                         f"object's mutable state; take a .copy() "
+                         f"(the r6 lost-dispatch bug was exactly this "
+                         f"aliasing)"),
+                detail=f"alias:{root.value.id}.{rhs.attr}"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# R4 — event-loop affinity.
+
+
+def check_loop_affinity(prog: Program) -> List[Finding]:
+    findings: List[Finding] = []
+    decorated: Dict[str, List[FunctionModel]] = {}
+    for fm in prog.all_functions():
+        if fm.loop_only_kind:
+            decorated.setdefault(fm.node.name, []).append(fm)
+    if not decorated:
+        return findings
+    for fm in prog.all_functions():
+        entries = _loop_entry_defs(fm)
+        # Lambdas handed directly to loop.post/schedule_* run on the
+        # loop thread too: calls inside them are legitimate.
+        posted_lambda_calls = set()
+        for node in ast.walk(fm.node):
+            if isinstance(node, ast.Call) \
+                    and _call_tail(node.func) in LOOP_POST_METHODS:
+                for arg in node.args:
+                    if isinstance(arg, ast.Lambda):
+                        for sub in ast.walk(arg):
+                            posted_lambda_calls.add(id(sub))
+        for node in ast.walk(fm.node):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = _call_tail(node.func)
+            if tail not in decorated:
+                continue
+            target = prog.resolve_call(fm, node)
+            if target is not None and not target.loop_only_kind:
+                continue  # resolved to an undecorated same-name method
+            if target is None and not isinstance(node.func, ast.Attribute):
+                continue  # bare name that didn't resolve: not a method call
+            if fm.loop_only_kind:
+                continue
+            if id(node) in posted_lambda_calls:
+                continue  # inside a lambda handed to loop.post(...)
+            encl = _enclosing_def(fm.node, node)
+            if encl is not None and encl.name in entries:
+                continue  # inside a closure handed to loop.post(...)
+            findings.append(Finding(
+                rule="R4", path=fm.module.path, line=node.lineno,
+                symbol=fm.qualname,
+                message=(f"calls @loop_only method {tail}() directly; "
+                         f"post it to the loop (loop.post/schedule_*) or "
+                         f"mark the caller @loop_only"),
+                detail=f"direct-call:{tail}"))
+    return findings
+
+
+def _loop_entry_defs(fm: FunctionModel) -> Set[str]:
+    names = set(fm.loop_entry_closures)
+    return names
+
+
+def _enclosing_def(root: ast.AST, needle: ast.AST):
+    """Innermost nested FunctionDef containing ``needle`` (None if the
+    needle sits directly in ``root``'s own body)."""
+    hit = [None]
+
+    def rec(node, current):
+        for child in ast.iter_child_nodes(node):
+            nxt = current
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and child is not root:
+                nxt = child
+            if child is needle:
+                hit[0] = nxt
+                return True
+            if rec(child, nxt):
+                return True
+        return False
+
+    rec(root, None)
+    return hit[0]
+
+
+# ---------------------------------------------------------------------------
+# R5 — terminal-transition idempotency + refcount floors.
+
+_R5_TERMINAL_RE = re.compile(r"^(complete_task|fail_task)$")
+_R5_REF_MUTATORS = {"remove_submitted_task_refs", "remove_local_ref"}
+_R5_COUNT_ATTR_RE = re.compile(
+    r"(^|_)(refs|ref_count|refcount|pin_count|borrowers)($|_)")
+
+
+def _is_guarded_decrement(fm: FunctionModel, aug: ast.AugAssign) -> bool:
+    """True if the decrement sits under an ``if x.attr > 0`` (or ``>=
+    1``/``!= 0``) guard on the same attribute — an explicit floor, just
+    spelled as a branch instead of ``max(0, ...)``."""
+    attr = aug.target.attr
+
+    def guards(test: ast.AST) -> bool:
+        for n in ast.walk(test):
+            if (isinstance(n, ast.Compare)
+                    and isinstance(n.left, ast.Attribute)
+                    and n.left.attr == attr):
+                return True
+        return False
+
+    hit = [False]
+
+    def rec(node, under_guard):
+        if node is aug:
+            hit[0] = hit[0] or under_guard
+            return
+        for child in ast.iter_child_nodes(node):
+            ug = under_guard or (isinstance(node, ast.If)
+                                 and guards(node.test)
+                                 and child in node.body)
+            rec(child, ug)
+
+    rec(fm.node, False)
+    return hit[0]
+
+
+def check_refcount_hygiene(prog: Program) -> List[Finding]:
+    findings: List[Finding] = []
+    for fm in prog.all_functions():
+        # (b) floor hygiene — anywhere.
+        for node in ast.walk(fm.node):
+            if (isinstance(node, ast.AugAssign)
+                    and isinstance(node.op, ast.Sub)
+                    and isinstance(node.target, ast.Attribute)
+                    and _R5_COUNT_ATTR_RE.search(node.target.attr)
+                    and not _is_guarded_decrement(fm, node)):
+                findings.append(Finding(
+                    rule="R5", path=fm.module.path, line=node.lineno,
+                    symbol=fm.qualname,
+                    message=(f"unfloored refcount decrement of "
+                             f".{node.target.attr} — a duplicate "
+                             f"decrement goes negative and frees the "
+                             f"object under a live ref; use "
+                             f"max(0, x - 1)"),
+                    detail=f"floor:{node.target.attr}"))
+        # (a) terminal handlers pop pending before touching refcounts.
+        if not _R5_TERMINAL_RE.match(fm.node.name):
+            continue
+        mutations: List[ast.Call] = []
+        first_pop_line: Optional[int] = None
+        for node in ast.walk(fm.node):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = _call_tail(node.func)
+            if tail in _R5_REF_MUTATORS:
+                mutations.append(node)
+            elif tail == "pop" and isinstance(node.func, ast.Attribute):
+                recv = node.func.value
+                text = recv.attr if isinstance(recv, ast.Attribute) else (
+                    recv.id if isinstance(recv, ast.Name) else "")
+                if "pending" in text:
+                    line = node.lineno
+                    if first_pop_line is None or line < first_pop_line:
+                        first_pop_line = line
+        for call in mutations:
+            if first_pop_line is None:
+                findings.append(Finding(
+                    rule="R5", path=fm.module.path, line=call.lineno,
+                    symbol=fm.qualname,
+                    message=("terminal handler mutates refcounts but never "
+                             "pops its pending entry — a duplicate "
+                             "terminal transition will double-remove refs"),
+                    detail="no-pending-pop"))
+            elif call.lineno < first_pop_line:
+                findings.append(Finding(
+                    rule="R5", path=fm.module.path, line=call.lineno,
+                    symbol=fm.qualname,
+                    message=(f"refcount mutation at line {call.lineno} "
+                             f"precedes the pending-entry pop — the pop "
+                             f"is the idempotency gate and must come "
+                             f"first"),
+                    detail="mutation-before-pop"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# R6 — pyc without source.
+
+
+def check_pyc_orphans(paths: List[str], repo_root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for p in paths:
+        if not os.path.isdir(p):
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames if d != ".git"]
+            if os.path.basename(dirpath) != "__pycache__":
+                continue
+            parent = os.path.dirname(dirpath)
+            for fn in sorted(filenames):
+                if not fn.endswith(".pyc"):
+                    continue
+                src = fn.split(".", 1)[0] + ".py"
+                if not os.path.exists(os.path.join(parent, src)):
+                    rel = os.path.relpath(os.path.join(dirpath, fn),
+                                          repo_root)
+                    findings.append(Finding(
+                        rule="R6", path=rel, line=0, symbol=src,
+                        message=(f"orphaned bytecode: {fn} has no "
+                                 f"source {src} next to its __pycache__ "
+                                 f"— delete it (a pyc-only package is "
+                                 f"unreviewable and untestable)"),
+                        detail=f"orphan:{src}"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# R7 — silent swallow in pump loops.
+
+
+def check_silent_swallow(prog: Program) -> List[Finding]:
+    findings: List[Finding] = []
+    for fm in prog.all_functions():
+        loops = [n for n in ast.walk(fm.node) if isinstance(n, ast.While)]
+        if not loops:
+            continue
+        for loop in loops:
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Try):
+                    continue
+                for handler in node.handlers:
+                    if not _is_broad_handler(handler):
+                        continue
+                    if _is_silent_body(handler.body):
+                        findings.append(Finding(
+                            rule="R7", path=fm.module.path,
+                            line=handler.lineno, symbol=fm.qualname,
+                            message=("pump loop swallows exceptions "
+                                     "silently; route through "
+                                     "debug.swallow.noted(site, exc) so "
+                                     "the count and first traceback "
+                                     "survive"),
+                            detail="silent-swallow"))
+    return findings
+
+
+def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    return isinstance(handler.type, ast.Name) and \
+        handler.type.id in ("Exception", "BaseException")
+
+
+def _is_silent_body(body: List[ast.stmt]) -> bool:
+    return all(isinstance(s, (ast.Pass, ast.Continue)) for s in body)
+
+
+# ---------------------------------------------------------------------------
+
+
+def run_all(prog: Program, paths: List[str], repo_root: str,
+            rules: Optional[Set[str]] = None) -> List[Finding]:
+    selected = rules or set(ALL_RULES)
+    findings: List[Finding] = []
+    if "R1" in selected:
+        findings += check_lock_order(prog)
+    if "R2" in selected:
+        findings += check_blocking_under_lock(prog)
+    if "R3" in selected:
+        findings += check_aliased_state(prog)
+    if "R4" in selected:
+        findings += check_loop_affinity(prog)
+    if "R5" in selected:
+        findings += check_refcount_hygiene(prog)
+    if "R6" in selected:
+        # Orphan scan covers the WHOLE repo, not just the analyzed
+        # paths: both shipped pyc-only packages lived under tools/ and
+        # _private/debug/, which a ray_tpu/-scoped scan would miss.
+        findings += check_pyc_orphans([repo_root], repo_root)
+    if "R7" in selected:
+        findings += check_silent_swallow(prog)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    # Two identical defects in one function (e.g. two unfloored
+    # decrements of the same attr) must not collapse to one
+    # fingerprint — baselining one would silently grandfather both.
+    # Suffix repeats with an occurrence index (line order is stable
+    # within a function, so the suffix survives unrelated line shifts).
+    seen: Dict[str, int] = {}
+    for f in findings:
+        fp = f.fingerprint
+        n = seen.get(fp, 0)
+        seen[fp] = n + 1
+        if n:
+            f.detail = f"{f.detail or f.message}#{n + 1}"
+    return findings
