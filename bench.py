@@ -153,6 +153,35 @@ def _model_bench_row(on_cpu: bool):
         return {"skipped": True, "reason": "unparseable bench_model output"}
 
 
+def _dispatch_latency_row():
+    """Run bench_runtime.py --dispatch-only in a subprocess (its own
+    CPU-side runtime, never touches the chip) and return the parsed
+    task_dispatch_latency_p99 row, or a structured skip dict — the
+    bench trajectory records the north-star p99 from every bench.py
+    invocation."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench_runtime.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, path, "--dispatch-only", "--quick"],
+            env=env, capture_output=True, text=True, timeout=600)
+    except subprocess.TimeoutExpired:
+        return {"skipped": True, "reason": "dispatch bench timed out"}
+    if proc.returncode != 0:
+        return {"skipped": True,
+                "reason": f"dispatch bench rc={proc.returncode}: "
+                          f"{(proc.stderr or '')[-400:]}"}
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if row.get("metric") == "task_dispatch_latency_p99":
+            return row
+    return {"skipped": True, "reason": "no dispatch-latency row in output"}
+
+
 def main():
     probe = _probe()
     probed_cpu = not probe.get("ok") or probe.get("backend") != "tpu"
@@ -171,6 +200,8 @@ def main():
                       "and no CPU fallback)",
             "mfu": None,
             "mfu_skip_reason": "no jax backend initialized",
+            "dispatch_p99_ms": None,
+            "dispatch_skip_reason": "no jax backend initialized",
         }))
         return 0
 
@@ -262,6 +293,18 @@ def main():
         res["mfu_backend"] = model.get("backend")
         if model.get("backend") != "tpu":
             res["mfu_scaled_down_for_cpu"] = True
+    # North-star runtime axis: p99 task-dispatch latency, decomposed by
+    # stage — measured end-to-end through ray_tpu.remote by a CPU-side
+    # subprocess (the chip is untouched), folded into the headline row.
+    dispatch = _dispatch_latency_row()
+    if dispatch.get("skipped"):
+        res["dispatch_p99_ms"] = None
+        res["dispatch_skip_reason"] = dispatch.get("reason")
+    else:
+        print(json.dumps(dispatch))
+        res["dispatch_p99_ms"] = dispatch.get("value")
+        res["dispatch_p50_ms"] = dispatch.get("p50_ms")
+        res["dispatch_stages"] = dispatch.get("stages")
     print(json.dumps(res))
 
 

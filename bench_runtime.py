@@ -78,6 +78,33 @@ def bench_queued(n, num_blockers):
                 drain_rate=round(n / drain_dt, 2))
 
 
+def bench_dispatch_latency(n):
+    """Task-dispatch latency decomposed by lifecycle stage — the
+    BASELINE.json north-star metric (p99 task-dispatch latency),
+    derived from the task-event pipeline: queue_wait (submit ->
+    scheduled), dispatch (scheduled -> handed to worker), startup
+    (handoff -> running), total (submit -> running)."""
+    import ray_tpu
+    from ray_tpu.experimental.state.api import summarize_tasks
+
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    ray_tpu.get([noop.remote() for _ in range(200)])      # warm
+    ray_tpu.get([noop.remote() for _ in range(n)])
+    stages = summarize_tasks().get("dispatch_latency", {})
+    total = stages.get("total", {})
+    return emit("task_dispatch_latency_p99",
+                total.get("p99_s", 0.0) * 1000.0, "ms", n=n,
+                p50_ms=round(total.get("p50_s", 0.0) * 1000.0, 4),
+                stages={
+                    stage: {"p50_ms": round(row["p50_s"] * 1000.0, 4),
+                            "p99_ms": round(row["p99_s"] * 1000.0, 4),
+                            "count": row["count"]}
+                    for stage, row in stages.items()})
+
+
 def bench_actors(n):
     import ray_tpu
 
@@ -357,6 +384,9 @@ def main():
                         help="~10x smaller counts")
     parser.add_argument("--queued", type=int, default=None,
                         help="queued-task count (default 1M; quick 20k)")
+    parser.add_argument("--dispatch-only", action="store_true",
+                        help="run only the dispatch-latency row "
+                             "(bench.py folds this into its JSON)")
     args = parser.parse_args()
 
     import jax
@@ -369,8 +399,13 @@ def main():
     })
 
     quick = args.quick
+    if args.dispatch_only:
+        bench_dispatch_latency(500 if quick else 2_000)
+        ray_tpu.shutdown()
+        return 0
     rows = []
     rows.append(bench_tasks(1_000 if quick else 10_000))
+    rows.append(bench_dispatch_latency(500 if quick else 2_000))
     rows.append(bench_actors(100 if quick else 1_000))
     rows.append(bench_pgs(20 if quick else 100))
     rows.append(bench_args(1_000 if quick else 10_000))

@@ -26,7 +26,8 @@ from ray_tpu._private.ids import (
     ActorID, JobID, ObjectID, PlacementGroupID, TaskID, WorkerID)
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.object_store import (
-    DeviceObject, InPlasmaMarker, MemoryStore, entry_value)
+    DeviceObject, InPlasmaMarker, MemoryStore, ObjectVanishedError,
+    entry_value)
 from ray_tpu._private.reference_counter import ReferenceCounter
 from ray_tpu._private.serialization import SerializedObject, serialize
 from ray_tpu._private.task_manager import TaskManager
@@ -249,8 +250,33 @@ class CoreWorker:
         if raylet is not None:
             e = raylet.object_store.get(object_id)
             if e is not None:
-                return entry_value(e), True
+                try:
+                    return entry_value(e), True
+                except ObjectVanishedError:
+                    # Concurrent free won the race: a miss, not a crash
+                    # — heal the poisoned entry (else `contains` keeps
+                    # short-circuiting pulls "local" forever) and let
+                    # the outer loop re-resolve from a real location.
+                    self._heal_vanished(object_id)
+                    return None, False
         return None, False
+
+    def _heal_vanished(self, object_id: ObjectID, raylet=None) -> None:
+        """Drop a local entry whose native backing vanished, and its
+        stale directory row for this node, so pulls re-fetch from a
+        genuine copy."""
+        raylet = raylet or self.local_raylet
+        if raylet is None:
+            return
+        try:
+            if raylet.object_store.drop_vanished(object_id):
+                self.cluster.object_directory.remove_location(
+                    object_id, raylet.node_id)
+        except Exception as e:
+            # A failed heal leaves the livelock in place — it must be
+            # visible, not silent (graftcheck R7 discipline).
+            from ray_tpu._private.debug import swallow
+            swallow.noted("core_worker.heal_vanished", e)
 
     def _entry_to_value(self, object_id: ObjectID, entry):
         if entry.error is not None:
@@ -263,7 +289,11 @@ class CoreWorker:
             raylet = self.local_raylet
             e = raylet.object_store.get(object_id)
             if e is not None:
-                return entry_value(e)
+                try:
+                    return entry_value(e)
+                except ObjectVanishedError:
+                    self._heal_vanished(object_id)
+                    raise _Retry()
             raise _Retry()
         return entry_value(entry)
 
@@ -285,7 +315,15 @@ class CoreWorker:
         while True:
             entry = node.object_store.get(object_id)
             if entry is not None:
-                return entry_value(entry)
+                try:
+                    return entry_value(entry)
+                except ObjectVanishedError:
+                    # Concurrent free: heal the poisoned entry (and its
+                    # stale directory row) so the pull below re-fetches
+                    # instead of spinning on a store that claims the
+                    # object is local.
+                    self._heal_vanished(object_id, raylet=node)
+                    entry = None
             entry = self.memory_store.get_entry(object_id)
             if entry is not None and entry.sealed and \
                     not isinstance(entry.data, InPlasmaMarker):

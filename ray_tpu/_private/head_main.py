@@ -91,8 +91,14 @@ def register_operator_handlers(cluster, job_manager):
         return out
 
     def handle_timeline(_payload):
-        from ray_tpu.util import tracing
-        return tracing.chrome_tracing_dump()
+        from ray_tpu.gcs.timeline import merged_timeline
+        return merged_timeline(cluster)
+
+    def handle_latency(_payload):
+        """Dispatch-latency decomposition (`ray-tpu latency`)."""
+        from ray_tpu.gcs.task_events import flushed_manager
+        mgr = flushed_manager(cluster.gcs)
+        return mgr.latency_summary() if mgr is not None else {}
 
     def handle_state_list(payload):
         """State API over the wire (`ray-tpu list <resource>`)."""
@@ -116,6 +122,7 @@ def register_operator_handlers(cluster, job_manager):
 
     server.register("memory_summary", handle_memory_summary)
     server.register("timeline_dump", handle_timeline)
+    server.register("latency_summary", handle_latency)
     server.register("state_list", handle_state_list)
     server.register("state_summary", handle_state_summary)
 

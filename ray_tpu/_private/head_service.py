@@ -35,6 +35,12 @@ def _ignore(_result, _err):
     pass
 
 
+def _head_clock(_payload) -> float:
+    """The cluster's reference wall-clock (clock_probe RPC)."""
+    import time
+    return time.time()
+
+
 class _RemoteWorkerHandle:
     """Head-side stand-in for a leased worker living in a NodeHost
     process.  Duck-types the thread ``Worker`` surface the submitters and
@@ -301,6 +307,7 @@ class HeadService:
     fetches."""
 
     def __init__(self, cluster, port: int = 0):
+        from ray_tpu._private.metrics_agent import MetricsFederation
         self._cluster = cluster
         self._lock = diag_lock("HeadService._lock")
         self._proxies: Dict[NodeID, RemoteNodeProxy] = {}
@@ -309,17 +316,26 @@ class HeadService:
         # pulled directly.  The peer-to-peer plane keeps this at zero in
         # steady state; tests assert on it.
         self.relay_fetches = 0
+        # Cluster-wide /metrics: every node_host's shipped registry
+        # delta merges here under a node_id label; a dead node's series
+        # are pruned with its federation entry.
+        self.metrics_federation = MetricsFederation()
         self.server = RpcServer(port=port, name="head")
         s = self.server
         s.register("register_node", self._handle_register_node)
         s.register("unregister_node", self._handle_unregister_node)
         s.register("heartbeat", self._handle_heartbeat)
+        s.register("metrics_report", self._handle_metrics_report)
+        # Clock-sync anchor: nodes probe this to estimate their offset
+        # to the head clock (timeline normalization, stage durations).
+        s.register("clock_probe", _head_clock)
         s.register("actor_worker_died", self._handle_actor_worker_died)
         s.register("kv_get", self._handle_kv_get)
         s.register("fetch_object", self._handle_fetch_object)
         s.register("fetch_value", self._handle_fetch_value)
         s.register("put_inline", self._handle_put_inline)
         s.register("add_location", self._handle_add_location)
+        s.register("remove_location", self._handle_remove_location)
         s.register("get_locations", self._handle_get_locations)
         s.register("get_node_address", self._handle_get_node_address)
         s.register_async("wait_object", self._handle_wait_object)
@@ -407,6 +423,22 @@ class HeadService:
             NodeID(payload["node_id"]))
         return True
 
+    def _handle_metrics_report(self, payload) -> bool:
+        """Federation ingest: merge one node's registry delta under its
+        node_id label (reporter.py precedent — per-node samples riding
+        an existing channel up to the head).  Reports from nodes this
+        head no longer mirrors are REJECTED: a straggling report from a
+        declared-dead (or wedged-but-alive) node would resurrect its
+        federation entry after the death-prune, leaving stale gauges at
+        /metrics forever."""
+        node_id = NodeID(payload["node_id"])
+        if self._proxy_for(node_id) is None:
+            return False
+        self.metrics_federation.ingest(node_id.hex()[:12],
+                                       payload.get("snapshot"),
+                                       full=payload.get("full", False))
+        return True
+
     def _handle_actor_worker_died(self, payload) -> bool:
         self._cluster.gcs.actor_manager.on_actor_worker_died(
             payload["actor_id"], payload["reason"])
@@ -420,6 +452,10 @@ class HeadService:
             proxy = self._proxies.pop(node_id, None)
         if proxy is not None:
             proxy.client.close()
+        # A dead node's federated series must vanish from /metrics now
+        # (collector-ownership pruning, made prompt): stale gauges from
+        # a dead node read as live signal.
+        self.metrics_federation.drop(node_id.hex()[:12])
 
     # ---- KV ------------------------------------------------------------
     def _handle_kv_get(self, key: bytes) -> Optional[bytes]:
@@ -532,6 +568,13 @@ class HeadService:
             ObjectID(payload["object_id"]), NodeID(payload["node_id"]))
         return True
 
+    def _handle_remove_location(self, payload) -> bool:
+        """A node healed a vanished/stale copy: drop its directory row
+        so fetch_value/get_locations stop redirecting pulls to it."""
+        self._cluster.object_directory.remove_location(
+            ObjectID(payload["object_id"]), NodeID(payload["node_id"]))
+        return True
+
     def _handle_get_locations(self, payload):
         """Locations WITH dialable addresses: peers use these to pull
         node-to-node directly (OwnershipBasedObjectDirectory parity —
@@ -623,4 +666,11 @@ class HeadService:
             self._proxies.clear()
         for p in proxies:
             p.client.close()
+        # Stop the server FIRST: a metrics_report still in flight could
+        # otherwise re-create a federation entry after the purge.  Then
+        # drop the entries — the registry is process-global, so a
+        # stopped cluster's federated series must not linger until GC
+        # happens to collect the owners.
         self.server.stop()
+        for node_id in self.metrics_federation.node_ids():
+            self.metrics_federation.drop(node_id)

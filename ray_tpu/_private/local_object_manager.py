@@ -102,9 +102,12 @@ class LocalObjectManager:
                             f"batch-{uuid.uuid4().hex[:12]}")
         results = []
         offset = 0
+        from ray_tpu.util import tracing
         try:
             fault_injection.hook("spill.write")
-            with open(path, "wb") as f:
+            with tracing.span("object.spill", category="spill",
+                              objects=len(batch)), \
+                    open(path, "wb") as f:
                 for object_id, entry, source in batch:
                     if isinstance(source, memoryview):
                         nbytes = source.nbytes

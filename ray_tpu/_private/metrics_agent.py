@@ -12,8 +12,8 @@ route renders it in Prometheus text format.
 from __future__ import annotations
 
 import threading
-from collections import defaultdict
-from typing import Dict, List, Tuple
+from collections import OrderedDict, defaultdict
+from typing import Dict, List, Optional, Tuple
 
 _LabelKey = Tuple[Tuple[str, str], ...]
 
@@ -137,13 +137,42 @@ class MetricsRegistry:
         self._note_write(name, labels)
         with self._lock:
             rec = self._metrics[name]
-            if rec.buckets:
-                h = rec.series.get(labels)
-                if h is None:
-                    h = rec.series[labels] = _Hist(len(rec.buckets))
-                h.observe(value, rec.buckets)
-            else:
-                rec.series.setdefault(labels, []).append(value)
+            if not rec.buckets:
+                # A bucketless histogram used to fall back to a raw
+                # observation list — unbounded memory on any hot path
+                # that observes forever.  Force the default
+                # latency-shaped buckets instead: O(buckets) however
+                # many observations land.
+                rec.buckets = list(_DEFAULT_BUCKETS)
+            h = rec.series.get(labels)
+            if not isinstance(h, _Hist):
+                h = rec.series[labels] = _Hist(len(rec.buckets))
+            h.observe(value, rec.buckets)
+
+    def drop_collector(self, owner) -> None:
+        """Remove ``owner``'s collector entry NOW and prune every series
+        it ever wrote — the prompt version of the weakref path, for
+        owners whose death is an event (node death) rather than a GC."""
+        with self._lock:
+            doomed = [c for c in self._collectors
+                      if c[0]() is owner or c[0]() is None]
+            self._collectors = [c for c in self._collectors
+                                if c not in doomed]
+            for _ref, _fn, written in doomed:
+                for name, labels in written:
+                    rec = self._metrics.get(name)
+                    if rec is not None:
+                        rec.series.pop(labels, None)
+
+    def put_series(self, name: str, labels: _LabelKey, value) -> None:
+        """Raw series write (float for counter/gauge, :class:`_Hist` for
+        histograms) with collector-ownership tracking — the federation
+        ingest path writes remote nodes' pre-aggregated series here."""
+        self._note_write(name, labels)
+        with self._lock:
+            rec = self._metrics.get(name)
+            if rec is not None:
+                rec.series[labels] = value
 
     def get_value(self, name: str, labels: _LabelKey = ()):
         with self._lock:
@@ -174,7 +203,10 @@ class MetricsRegistry:
                     if isinstance(val, _Hist):
                         acc = 0
                         for i, b in enumerate(rec.buckets):
-                            acc += val.counts[i]
+                            # Federated accumulators may carry fewer
+                            # bucket slots than this record declares.
+                            acc += val.counts[i] if i < len(val.counts) \
+                                else 0
                             blab = (lstr + "," if lstr else "") \
                                 + f'le="{b}"'
                             out.append(f"{pname}_bucket{{{blab}}} {acc}")
@@ -229,3 +261,196 @@ def observe_internal(name: str, value: float, buckets=None,
     _registry.register(name, "histogram",
                        buckets=buckets or _DEFAULT_BUCKETS)
     _registry.observe(name, value, tuple(sorted(labels.items())))
+
+
+# ---------------------------------------------------------------------------
+# Cluster-wide federation: each node_host ships its registry to the head
+# (delta snapshots riding the heartbeat channel); the head merges every
+# node's series under a node_id label into ONE exposition at /metrics.
+# Parity: the reference's per-node metrics agents all scraped by one
+# Prometheus — collapsed here into head-side aggregation because the
+# head is the only addressable scrape target in this deployment.
+# ---------------------------------------------------------------------------
+
+def _export_value(val) -> object:
+    """Wire form of one series value: float, or a plain dict for
+    histogram accumulators (no class crosses the wire)."""
+    if isinstance(val, _Hist):
+        return {"counts": list(val.counts), "sum": val.sum,
+                "count": val.count}
+    if isinstance(val, list):          # legacy raw-observation list
+        return {"counts": [], "sum": float(sum(val)), "count": len(val)}
+    return float(val)
+
+
+class MetricsDeltaShipper:
+    """Node-side: snapshot the local registry and diff against the last
+    shipped state, returning only series whose value changed — the
+    steady-state report for an idle node is empty (``None``).
+
+    Merge semantics head-side are upsert (values are cumulative
+    counters / current gauges / cumulative histogram accumulators), so
+    a lost report self-heals on the next changed value and a duplicated
+    report is idempotent.  Every ``full_every``-th non-empty report is a
+    FULL snapshot (resource-broadcaster precedent): the head replaces
+    the node's whole entry, so series this registry pruned locally
+    (worker churn) stop accumulating head-side — and the ``_last`` diff
+    base resets with it, bounding shipper memory the same way."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 full_every: int = 20):
+        self._registry = registry or get_metrics_registry()
+        self._last: Dict[Tuple[str, _LabelKey], object] = {}
+        self._full_every = max(1, full_every)
+        self._reports = 0
+
+    def collect_delta(self) -> Tuple[Optional[Dict], bool]:
+        """Returns ``(snapshot_or_None, is_full)``."""
+        reg = self._registry
+        reg.run_collectors()   # fold hot-path counters into the registry
+        full = self._reports % self._full_every == 0
+        out: Dict[str, dict] = {}
+        fresh: Dict[Tuple[str, _LabelKey], object] = {}
+        for name, rec in reg.snapshot().items():
+            with reg._lock:
+                # Series already carrying a node_id label are FEDERATED
+                # copies of some other node's data — shipping them again
+                # would echo them around the cluster.
+                series = {k: _export_value(v)
+                          for k, v in rec.series.items()
+                          if not any(lk == "node_id" for lk, _ in k)}
+                meta = (rec.type, rec.description, list(rec.buckets))
+            if full:
+                ship = series
+                for k, v in series.items():
+                    fresh[(name, k)] = v
+            else:
+                ship = {k: v for k, v in series.items()
+                        if self._last.get((name, k)) != v}
+                for k, v in ship.items():
+                    self._last[(name, k)] = v
+            if not ship:
+                continue
+            out[name] = {"type": meta[0], "description": meta[1],
+                         "buckets": meta[2],
+                         "series": [[list(k), v]
+                                    for k, v in ship.items()]}
+        if full:
+            self._last = fresh       # drop diff entries for pruned series
+        if not out:
+            return None, False
+        self._reports += 1
+        return out, full
+
+    def force_full(self) -> None:
+        """A delta's delivery failed (connection bounce, head rejected
+        it): the diff base already recorded it as shipped, so a series
+        that never changes again would stay stale at the head.  Make
+        the NEXT report a full resync instead of waiting out the
+        ``full_every`` cycle."""
+        self._reports = 0
+
+
+class _FederatedNode:
+    """One remote node's latest shipped series — the OWNER object whose
+    lifetime ties the node's series to the registry's collector-pruning
+    machinery: while it lives, a scrape-time collector re-writes its
+    series (node_id-labelled); dropped on node death, every series it
+    wrote is pruned with it."""
+
+    __slots__ = ("node_id", "metrics", "lock", "__weakref__")
+
+    def __init__(self, node_id: str):
+        self.node_id = node_id
+        # name -> (type, description, buckets, {labels: value})
+        self.metrics: Dict[str, tuple] = {}
+        self.lock = threading.Lock()
+
+
+class MetricsFederation:
+    """Head-side aggregation: ``ingest`` upserts a node's delta
+    snapshot; a per-node collector renders the merged state into the
+    head registry at every scrape; ``drop`` prunes a dead node's series
+    immediately (and the weakref path covers silent owner loss)."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self._registry = registry or get_metrics_registry()
+        self._lock = threading.Lock()
+        self._nodes: Dict[str, _FederatedNode] = {}
+        # Tombstones: a dropped node_id is dead forever (restarted
+        # daemons mint fresh node ids), so an in-flight report racing
+        # the death-prune must not resurrect the entry into permanent
+        # stale gauges.  Bounded ring of recent drops.
+        self._dropped: "OrderedDict[str, None]" = OrderedDict()
+        self.reports_ingested = 0
+
+    def ingest(self, node_id: str, snapshot: Optional[Dict],
+               full: bool = False) -> None:
+        if not snapshot:
+            return
+        stale = None
+        with self._lock:
+            if node_id in self._dropped:
+                return
+            entry = self._nodes.get(node_id)
+            if full and entry is not None:
+                # Full resync REPLACES the node's entry: series the node
+                # pruned locally (worker churn) must stop rendering —
+                # dropping the old owner prunes everything it ever wrote.
+                stale, entry = entry, None
+                del self._nodes[node_id]
+            if entry is None:
+                entry = self._nodes[node_id] = _FederatedNode(node_id)
+                self._registry.register_collector(
+                    entry,
+                    lambda e, _reg=self._registry: _render_node(_reg, e))
+            self.reports_ingested += 1
+        if stale is not None:
+            self._registry.drop_collector(stale)
+        with entry.lock:
+            for name, rec in snapshot.items():
+                cur = entry.metrics.get(name)
+                series = dict(cur[3]) if cur is not None else {}
+                for labels, value in rec.get("series", ()):
+                    series[tuple(tuple(kv) for kv in labels)] = value
+                entry.metrics[name] = (rec.get("type", "gauge"),
+                                       rec.get("description", ""),
+                                       rec.get("buckets") or [],
+                                       series)
+
+    def drop(self, node_id: str) -> None:
+        with self._lock:
+            entry = self._nodes.pop(node_id, None)
+            self._dropped[node_id] = None
+            while len(self._dropped) > 1024:
+                self._dropped.popitem(last=False)
+        if entry is not None:
+            self._registry.drop_collector(entry)
+
+    def node_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._nodes)
+
+
+def _render_node(reg: MetricsRegistry, entry: _FederatedNode) -> None:
+    """Scrape-time collector body for one federated node: write every
+    shipped series into the head registry with the ``node_id`` label
+    appended — run inside ``run_collectors`` so each write is tracked
+    for pruning."""
+    with entry.lock:
+        metrics = {name: (m[0], m[1], m[2], dict(m[3]))
+                   for name, m in entry.metrics.items()}
+    for name, (mtype, desc, buckets, series) in metrics.items():
+        reg.register(name, mtype, desc, buckets=buckets or None)
+        for labels, value in series.items():
+            labeled = tuple(sorted(
+                dict(labels, node_id=entry.node_id).items()))
+            if isinstance(value, dict):       # histogram accumulator
+                h = _Hist(max(len(buckets), len(value.get("counts", ()))))
+                h.counts[:len(value.get("counts", ()))] = \
+                    value.get("counts", ())
+                h.sum = value.get("sum", 0.0)
+                h.count = value.get("count", 0)
+                reg.put_series(name, labeled, h)
+            else:
+                reg.put_series(name, labeled, value)

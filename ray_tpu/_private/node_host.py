@@ -50,6 +50,63 @@ class _RemoteHeartbeats:
         buf = getattr(self._host.adapter.gcs, "task_events", None)
         if buf is not None and buf.num_buffered():
             buf.flush()
+        # Observability plane rides the same channel (all async — the
+        # heartbeat thread must never block on a slow head): clock-sync
+        # probes, metrics delta snapshots, buffered tracing spans.
+        self._host.clock_sync.maybe_probe()
+        self._host.maybe_ship_observability()
+
+
+class _ClockSync:
+    """Per-node clock-offset estimation to the head (RTT-anchored on
+    the heartbeat channel): ``offset_s`` added to a local wall-clock
+    timestamp yields head-clock time.  NTP-style midpoint estimate,
+    keeping the tightest (lowest-RTT) sample — the estimate's error is
+    bounded by rtt/2, so the best sample wins; the bound decays slowly
+    so genuine drift is re-tracked.  All probes are async: a wedged
+    head degrades the estimate, never the heartbeat loop."""
+
+    _PROBE_INTERVAL_S = 5.0
+
+    def __init__(self, client: RpcClient):
+        self._client = client
+        self._last_probe = 0.0
+        self._best_rtt = float("inf")
+        self._inflight = False
+        self.offset_s = 0.0
+        self.samples = 0
+
+    def maybe_probe(self, now: Optional[float] = None):
+        import time
+        now = time.monotonic() if now is None else now
+        # First probe fires immediately; then one per interval.
+        if self._inflight or (
+                self.samples and
+                now - self._last_probe < self._PROBE_INTERVAL_S):
+            return
+        self._last_probe = now
+        self._inflight = True
+        t0_wall = time.time()
+        t0_mono = time.monotonic()
+
+        def on_reply(result, err):
+            self._inflight = False
+            if err is not None or result is None:
+                return
+            rtt = time.monotonic() - t0_mono
+            t1_wall = time.time()
+            # Loosen the accept bound slowly so drift re-tracks even if
+            # the network never again matches the historic best RTT.
+            self._best_rtt = min(self._best_rtt * 1.25 + 1e-4, 10.0)
+            if rtt <= self._best_rtt:
+                self._best_rtt = rtt
+                self.offset_s = float(result) - (t0_wall + t1_wall) / 2.0
+                self.samples += 1
+
+        try:
+            self._client.call_async("clock_probe", None, on_reply)
+        except Exception:
+            self._inflight = False
 
 
 class _RemoteActorManager:
@@ -96,8 +153,11 @@ class _RemoteGcs:
         # lifecycle detail as the head's own raylet.  buffer_id must be
         # unique per incarnation (pids collide across machines and
         # restarts): the manager keys per-source drop counters on it.
+        # Timestamps are normalized to the head clock at emit so the
+        # manager's cross-buffer stage durations compare like clocks.
         self.task_events = TaskEventBuffer(
-            self.publisher, buffer_id=f"node-{uuid.uuid4().hex[:12]}")
+            self.publisher, buffer_id=f"node-{uuid.uuid4().hex[:12]}",
+            ts_offset=lambda: host.clock_sync.offset_s)
 
     def raylet(self, node_id: NodeID):
         """Peer lookup for object pulls: every peer is reachable through
@@ -287,7 +347,14 @@ class _RemoteDirectory:
             lambda _r, _e: None)
 
     def remove_location(self, object_id, node_id):
-        pass
+        # Must be real, not a no-op: the vanished-entry heal removes
+        # this node's stale row so the head stops redirecting pulls to
+        # a copy-less node (the row never "ages out" for a live node).
+        self._host.client.call_async(
+            "remove_location",
+            {"object_id": object_id.binary(),
+             "node_id": node_id.binary()},
+            lambda _r, _e: None)
 
     def remove_object(self, object_id):
         pass
@@ -373,7 +440,8 @@ class _RemoteCoreWorker:
         import pickle
         import time
 
-        from ray_tpu._private.object_store import entry_value
+        from ray_tpu._private.object_store import (ObjectVanishedError,
+                                                   entry_value)
         from ray_tpu._private.serialization import deserialize
 
         deadline = time.monotonic() + 60.0
@@ -381,7 +449,16 @@ class _RemoteCoreWorker:
         while True:
             entry = node.object_store.get(object_id)
             if entry is not None:
-                return entry_value(entry)
+                try:
+                    return entry_value(entry)
+                except ObjectVanishedError:
+                    # Concurrent free: heal the poisoned entry AND this
+                    # node's stale directory row at the head (or every
+                    # pull keeps getting redirected here), then fall
+                    # through to re-fetch from a real location.
+                    if node.object_store.drop_vanished(object_id):
+                        self._host.adapter.object_directory \
+                            .remove_location(object_id, node.node_id)
             result = self._host.client.call(
                 "fetch_value", {"object_id": object_id.binary()},
                 timeout=60.0)
@@ -520,6 +597,13 @@ class NodeHost:
         self.stopped = False
         self.client = RpcClient(tuple(head_address))
         self.peers = PeerPool(self)
+        # Observability plane (before the adapter: the task-event
+        # buffer's ts normalization closes over clock_sync).
+        from ray_tpu._private.metrics_agent import MetricsDeltaShipper
+        self.clock_sync = _ClockSync(self.client)
+        self._metrics_shipper = MetricsDeltaShipper()
+        self._last_metrics_ship = 0.0
+        self._last_timeline_ship = 0.0
         self.adapter = _RemoteClusterAdapter(self)
         store_bytes = resources.get("object_store_memory")
         self.raylet = Raylet(
@@ -719,6 +803,60 @@ class NodeHost:
             payload["pg_id"], payload["index"])
         return True
 
+    # ---- observability shipping ----------------------------------------
+    def maybe_ship_observability(self):
+        """Ship this daemon's metrics delta and buffered tracing spans
+        to the head (piggybacked on the heartbeat loop, throttled, all
+        async).  Metrics ride a direct RPC into the head's federation;
+        spans ride the batched wire publisher into the GCS timeline
+        store — the same path task events take."""
+        import time
+
+        from ray_tpu._private.config import get_config
+        from ray_tpu._private.debug import swallow
+        now = time.monotonic()
+        interval = get_config().metrics_report_interval_ms / 1000.0
+        if now - self._last_metrics_ship >= interval:
+            self._last_metrics_ship = now
+            try:
+                delta, full = self._metrics_shipper.collect_delta()
+            except Exception as e:
+                # A collector bug must degrade metrics, not heartbeats.
+                swallow.noted("node_host.metrics_delta", e)
+                delta, full = None, False
+            if delta:
+                def on_report(result, err):
+                    # Lost or rejected report: the diff base already
+                    # counts it as shipped — resync fully next time so
+                    # settled series can't stay stale at the head.
+                    if err is not None or result is False:
+                        self._metrics_shipper.force_full()
+
+                self.client.call_async(
+                    "metrics_report",
+                    {"node_id": self.raylet.node_id.binary(),
+                     "snapshot": delta, "full": full},
+                    on_report)
+        if now - self._last_timeline_ship >= 0.5:
+            self._last_timeline_ship = now
+            from ray_tpu.util import tracing
+            if tracing.num_buffered():
+                events = tracing.drain()
+                if events:
+                    from ray_tpu.gcs.pubsub import TIMELINE_CHANNEL
+                    self.adapter.gcs.publisher.publish(
+                        TIMELINE_CHANNEL, b"",
+                        {"source": self._timeline_source,
+                         "node_id": self.raylet.node_id.hex()[:12],
+                         "clock_offset_us":
+                             self.clock_sync.offset_s * 1e6,
+                         "dropped": tracing.dropped_count(),
+                         "events": events})
+
+    @property
+    def _timeline_source(self) -> str:
+        return f"node-{self.raylet.node_id.hex()[:12]}"
+
     # ---- lifecycle -----------------------------------------------------
     def _handle_stop(self, _payload) -> bool:
         self._stop_event.set()
@@ -762,6 +900,12 @@ def main(argv=None):
     if args.system_config:
         from ray_tpu._private.config import initialize_config
         initialize_config(json.loads(args.system_config))
+    from ray_tpu._private.config import get_config
+    if get_config().tracing_enabled:
+        # A traced head traces its daemons too: tick/spill/transfer
+        # spans recorded here ship to the GCS timeline store.
+        from ray_tpu.util import tracing
+        tracing.enable()
     host, _, port = args.head.rpartition(":")
     node = NodeHost((host, int(port)), json.loads(args.resources),
                     node_name=args.name, reg_token=args.reg_token)

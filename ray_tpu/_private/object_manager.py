@@ -269,7 +269,19 @@ class NodeObjectManager:
     def stop(self):
         self._pull_pool.stop()
 
-    def _fetch_from(self, object_id: ObjectID, node_id: NodeID) -> bool:
+    def _retry_other_location(self, object_id: ObjectID,
+                              tried: set) -> bool:
+        """A source was unusable (dead, stale, failed copy): try the
+        remaining known locations before declaring the pull failed —
+        one bad directory row must not fail a pull the other rows could
+        have served."""
+        for other in self._directory.get_locations(object_id):
+            if other not in tried:
+                return self._fetch_from(object_id, other, tried)
+        return False
+
+    def _fetch_from(self, object_id: ObjectID, node_id: NodeID,
+                    _tried: Optional[set] = None) -> bool:
         """Streamed transfer of the serialized object from a remote node
         store into the local store (ObjectBufferPool chunk assembly
         parity) — single-copy end to end:
@@ -284,13 +296,30 @@ class NodeObjectManager:
 
         Per-transfer throughput and the in-flight window peak are
         exported through the metrics agent."""
+        tried = set() if _tried is None else _tried
+        tried.add(node_id)
+        local_id = self._raylet.node_id
+        if node_id == local_id:
+            if self._raylet.object_store.contains(object_id):
+                # The object landed locally since the caller's check
+                # (concurrent put/restore): the pull's goal is met.
+                return True
+            # A stale SELF-location (the local copy was dropped after
+            # the directory row was written — e.g. a vanished-entry
+            # heal): "pulling from ourselves" can never succeed.  Drop
+            # the lying row and pull from a genuine remote copy.
+            self._directory.remove_location(object_id, local_id)
+            return self._retry_other_location(object_id, tried)
         source = self._raylet.cluster.gcs.raylet(node_id)
         if source is None:
             # Source died; try another location or give up.
-            for other in self._directory.get_locations(object_id):
-                if other != node_id:
-                    return self._fetch_from(object_id, other)
-            return False
+            return self._retry_other_location(object_id, tried)
+        from ray_tpu.util import tracing
+        transfer_span = tracing.span(
+            "object.transfer", category="transfer",
+            node=self._raylet.node_id.hex()[:12],
+            source=node_id.hex()[:12])
+        transfer_span.__enter__()
         t0 = time.monotonic()
         reader = source.object_store
         window_peak = [0]
@@ -303,21 +332,28 @@ class NodeObjectManager:
             if inflight > window_peak[0]:
                 window_peak[0] = inflight
 
-        if hasattr(reader, "fetch_into"):
-            # Cross-process peer: pipelined chunk stream into the local
-            # segment (PullManager admission + ack flow).
-            nbytes = reader.fetch_into(
-                object_id, self._raylet.object_store,
-                pipeline=get_config().object_transfer_pipeline_depth,
-                on_chunk=on_chunk)
-        elif isinstance(reader, NodeObjectStore):
-            nbytes = self._copy_local(object_id, reader, on_chunk)
-        else:
-            nbytes = self._copy_via_serialized(object_id, reader,
-                                               on_chunk)
+        try:
+            if hasattr(reader, "fetch_into"):
+                # Cross-process peer: pipelined chunk stream into the
+                # local segment (PullManager admission + ack flow).
+                nbytes = reader.fetch_into(
+                    object_id, self._raylet.object_store,
+                    pipeline=get_config().object_transfer_pipeline_depth,
+                    on_chunk=on_chunk)
+            elif isinstance(reader, NodeObjectStore):
+                nbytes = self._copy_local(object_id, reader, on_chunk)
+            else:
+                nbytes = self._copy_via_serialized(object_id, reader,
+                                                   on_chunk)
+        except BaseException:
+            transfer_span.meta["ok"] = False
+            transfer_span.__exit__(None, None, None)
+            raise
         if nbytes is None:
             self.stats["failed_pulls"] += 1
-            return False
+            transfer_span.meta["ok"] = False
+            transfer_span.__exit__(None, None, None)
+            return self._retry_other_location(object_id, tried)
         self._directory.add_location(object_id, self._raylet.node_id)
         self.stats["pulled_objects"] += 1
         self.stats["pulled_bytes"] += nbytes
@@ -333,6 +369,8 @@ class NodeObjectManager:
                         node=self._raylet.node_id.hex()[:12])
         observe_internal("ray_tpu.object_manager.transfer_seconds",
                          elapsed)
+        transfer_span.meta["bytes"] = nbytes
+        transfer_span.__exit__(None, None, None)
         return True
 
     def _copy_local(self, object_id: ObjectID, src: "NodeObjectStore",

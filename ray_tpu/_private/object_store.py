@@ -553,7 +553,11 @@ class NodeObjectStore:
             return None
         data = e.data
         if isinstance(data, _NativeHandle):
-            return SerializedObject.from_bytes(data.read())
+            blob = data.read()
+            if blob is None:        # backing vanished under the entry
+                self.drop_vanished(object_id)
+                return None
+            return SerializedObject.from_bytes(blob)
         if isinstance(data, DeviceObject):
             return data.to_serialized()
         return data
@@ -572,6 +576,33 @@ class NodeObjectStore:
             e = self._entries.get(object_id)
             if e is not None and e.pin_count > 0:
                 e.pin_count -= 1
+
+    def drop_vanished(self, object_id: ObjectID) -> bool:
+        """Self-heal a poisoned entry: a SEALED native-handle entry
+        whose native key no longer exists (every seal path had the key
+        sealed natively at registration, so ``locate`` returning None
+        means the block was deleted underneath — a lost race some free
+        path won).  The entry is unrecoverable local state, and worse,
+        it makes ``contains`` lie: pulls short-circuit "local" forever
+        while reads miss forever.  Drop it so the pull path can
+        re-fetch from a genuine location.  Returns True if dropped."""
+        with self._lock:
+            e = self._entries.get(object_id)
+            if e is None or not e.sealed or \
+                    not isinstance(e.data, _NativeHandle):
+                return False
+            if self._native is not None and \
+                    self._native.locate(e.data.key) is not None:
+                return False        # readable after all; nothing to heal
+            del self._entries[object_id]
+            self._used -= e.size
+            self.stats["vanished_objects"] = \
+                self.stats.get("vanished_objects", 0) + 1
+            if e.spilled_path:
+                self._release_spill_region_locked(object_id,
+                                                  e.spilled_path)
+            self._lock.notify_all()
+        return True
 
     def delete(self, object_id: ObjectID):
         with self._lock:
@@ -774,6 +805,11 @@ class NodeObjectStore:
             # client-pinned object's native free defers to its last
             # release; the spill copy is taken regardless.)
             view = data.read()
+            if view is None:
+                # Backing vanished under the sealed entry (the lost
+                # free race the read paths heal): nothing to spill.
+                raise ObjectVanishedError(
+                    f"native copy of {object_id} vanished before spill")
             nbytes = view.nbytes
             with open(path, "wb") as f:
                 f.write(view)
@@ -809,9 +845,11 @@ class NodeObjectStore:
                 pass
 
     def _restore(self, object_id: ObjectID, e: _Entry):
+        from ray_tpu.util import tracing
         path, offset, size = _parse_spill_url(e.spilled_path)
         fault_injection.hook("restore.read")
-        with open(path, "rb") as f:
+        with tracing.span("object.restore", category="spill",
+                          bytes=size), open(path, "rb") as f:
             f.seek(offset)
             blob = f.read(size)
         e.data = SerializedObject.from_bytes(blob)
@@ -1139,6 +1177,14 @@ def segment_chunk_source(store: "NodeObjectStore"):
     return get_source
 
 
+class ObjectVanishedError(LookupError):
+    """The entry's backing bytes were deleted between the store lookup
+    and the read (a concurrent free — e.g. the owner died and the
+    refcount cascade dropped the copy).  Callers treat it as a store
+    miss and re-resolve; the owner-death / reconstruction machinery
+    decides what the miss means."""
+
+
 def entry_value(entry: _Entry):
     """Deserialize an entry to its Python value (raising stored errors)."""
     if entry.error is not None:
@@ -1147,5 +1193,9 @@ def entry_value(entry: _Entry):
     if isinstance(data, DeviceObject):
         return data.value
     if isinstance(data, _NativeHandle):
-        return deserialize(SerializedObject.from_bytes(data.read()))
+        blob = data.read()
+        if blob is None:
+            raise ObjectVanishedError(
+                f"native copy of {entry!r} deleted mid-read")
+        return deserialize(SerializedObject.from_bytes(blob))
     return deserialize(data)

@@ -355,6 +355,9 @@ def available_resources() -> dict:
 
 
 def timeline() -> list:
+    """Merged chrome://tracing dump for the whole cluster: this
+    process's spans plus clock-normalized span batches every remote
+    daemon shipped to the GCS timeline store."""
     w = _require_connected()
-    from ray_tpu.util import tracing
-    return tracing.chrome_tracing_dump()
+    from ray_tpu.gcs.timeline import merged_timeline
+    return merged_timeline(w.cluster)

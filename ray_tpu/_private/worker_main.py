@@ -382,6 +382,9 @@ def main(argv=None) -> int:
     if cwd:
         os.chdir(cwd)
         sys.path.insert(0, cwd)
+    if os.environ.get("RAY_TPU_TRACING") == "1":
+        from ray_tpu.util import tracing
+        tracing.enable()
     runtime = _WorkerRuntime(args.host, args.port, args.worker_id)
     runtime.run()
     return 0

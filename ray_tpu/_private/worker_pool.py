@@ -501,6 +501,12 @@ class ProcessWorker:
         # Unbuffered child stdio: prints must reach the tailed log file
         # as they happen, not on 8KB block-buffer flushes at exit.
         env["PYTHONUNBUFFERED"] = "1"
+        from ray_tpu._private.config import get_config
+        if get_config().tracing_enabled:
+            # A traced run traces its process workers too: beyond the
+            # forced per-task execute span, spans recorded around it
+            # (puts, gets, nested calls) ride the task-reply drain.
+            env["RAY_TPU_TRACING"] = "1"
         # Child stdout/stderr land in per-worker session log files; the
         # pool's LogMonitor tails them and streams lines to the driver
         # (reference log_monitor.py + worker stdout redirection).

@@ -11,6 +11,7 @@ scope; this serves the same data as JSON for tools and humans:
     GET /api/tasks              task lifecycle records (task-event
                                 pipeline; ?state= ?name= ?limit= filters)
     GET /api/tasks/summary      per-function rollup + loss accounting
+    GET /api/latency            task-dispatch latency by stage (p50/p99)
     GET /api/placement_groups   PG table (state, bundles)
     GET /api/jobs               job submissions (when a JobManager runs)
     GET /metrics                Prometheus text exposition
@@ -82,6 +83,11 @@ class Dashboard:
                 summarize_tasks_from_cluster
             self._send_json(req,
                             summarize_tasks_from_cluster(self._cluster))
+        elif path == "/api/latency":
+            from ray_tpu.gcs.task_events import flushed_manager
+            mgr = flushed_manager(self._cluster.gcs)
+            self._send_json(req, mgr.latency_summary()
+                            if mgr is not None else {})
         elif path == "/api/placement_groups":
             self._send_json(req, self._cluster.gcs
                             .placement_group_manager.table())
@@ -176,7 +182,7 @@ class Dashboard:
             "<table border=1><tr><th>node</th><th>state</th>"
             "<th>resources</th></tr>" + rows + "</table>"
             "<p>endpoints: /api/cluster /api/nodes /api/actors "
-            "/api/tasks /api/tasks/summary "
+            "/api/tasks /api/tasks/summary /api/latency "
             "/api/placement_groups /api/jobs /metrics</p>"
             "</body></html>")
 

@@ -100,6 +100,11 @@ def summarize_tasks_from_cluster(cluster) -> dict:
         "dropped_at_source": (mgr.num_dropped_at_source()
                               if mgr is not None else 0),
         "evicted_records": mgr.evicted if mgr is not None else 0,
+        # Task-dispatch latency decomposed by lifecycle stage
+        # (queue_wait -> dispatch -> startup, "total" = submit->running
+        # — the BASELINE.json north-star p99).
+        "dispatch_latency": (mgr.latency_summary()
+                             if mgr is not None else {}),
     }
 
 

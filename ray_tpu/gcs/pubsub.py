@@ -22,6 +22,7 @@ JOB_CHANNEL = "JOB"
 ERROR_INFO_CHANNEL = "ERROR_INFO"
 RESOURCE_USAGE_CHANNEL = "RESOURCE_USAGE"
 TASK_EVENT_CHANNEL = "TASK_EVENT"
+TIMELINE_CHANNEL = "TIMELINE"
 
 
 class Publisher:

@@ -384,6 +384,12 @@ class GcsServer:
         from ray_tpu.gcs.task_events import TaskEventBuffer, TaskEventManager
         self.task_event_manager = TaskEventManager(self.publisher)
         self.task_events = TaskEventBuffer(self.publisher)
+        # Distributed timeline: span batches from remote daemons flush
+        # through the same pubsub plane into a bounded GCS-side store
+        # (clock-normalized at ingest); ray_tpu.timeline() merges it
+        # with the head's local tracing buffer.
+        from ray_tpu.gcs.timeline import TimelineStore
+        self.timeline_store = TimelineStore(self.publisher)
         from ray_tpu.gcs.actor_manager import GcsActorManager
         self.actor_manager = GcsActorManager(self)
         from ray_tpu.gcs.placement_group_manager import GcsPlacementGroupManager

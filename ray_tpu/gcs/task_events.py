@@ -61,6 +61,32 @@ TERMINAL_STATES = (FINISHED, FAILED)
 # record without limit.
 _MAX_HISTORY = 32
 
+# Dispatch-latency decomposition: arriving state -> (stage name, the
+# predecessor states whose timestamp anchors the stage — first present
+# wins).  Derived purely from the lifecycle the emitters already report
+# — no new emission sites.  SUBMITTED falls back to PENDING because a
+# task pushed onto a REUSED lease never traverses the raylet scheduler
+# (no SCHEDULED): its whole pre-push wait is still dispatch time.
+# "total" (submit -> running, i.e. everything but execution) is the
+# BASELINE.json north-star "task-dispatch latency".
+_STAGE_EDGES = {
+    SCHEDULED: ("queue_wait", (PENDING_ARGS_AVAIL,)),
+    SUBMITTED_TO_WORKER: ("dispatch", (SCHEDULED, PENDING_ARGS_AVAIL)),
+    RUNNING: ("startup", (SUBMITTED_TO_WORKER,)),
+    FINISHED: ("execution", (RUNNING,)),
+}
+_TOTAL_STAGE = ("total", PENDING_ARGS_AVAIL, RUNNING)
+
+# Dispatch stages are sub-millisecond in-process and tens of ms over
+# the wire: finer-grained low end than the generic latency buckets.
+_STAGE_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                  0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+# Per-stage bounded sample window for exact p50/p99 rollups (the
+# histogram at /metrics covers trend; summarize_tasks wants real
+# quantiles over the recent window).
+_STAGE_SAMPLE_CAP = 4096
+
 
 class TaskEventBuffer:
     """Emitter-side bounded buffer (core_worker/task_event_buffer.h
@@ -72,9 +98,14 @@ class TaskEventBuffer:
 
     def __init__(self, publisher, buffer_id: str = "head",
                  max_buffer: int = 8192, batch_size: int = 256,
-                 flush_interval: float = 0.2):
+                 flush_interval: float = 0.2, ts_offset=None):
         self._publisher = publisher
         self._buffer_id = buffer_id
+        # Clock normalization for remote emitters: a callable returning
+        # this process's estimated offset to the head clock (seconds).
+        # Applied at emit so cross-buffer stage durations (node-side
+        # SCHEDULED minus head-side PENDING) compare like clocks.
+        self._ts_offset = ts_offset
         self._max_buffer = max_buffer
         self._batch_size = batch_size
         self._flush_interval = flush_interval
@@ -93,7 +124,13 @@ class TaskEventBuffer:
              node_id: str = "", worker_id: str = "", attempt: int = 0,
              error: Optional[str] = None) -> None:
         tid = task_id.hex() if hasattr(task_id, "hex") else str(task_id)
-        ev = {"task_id": tid, "state": state, "ts": time.time()}
+        ts = time.time()
+        if self._ts_offset is not None:
+            try:
+                ts += float(self._ts_offset())
+            except Exception:
+                pass
+        ev = {"task_id": tid, "state": state, "ts": ts}
         if name:
             ev["name"] = name
         if job_id:
@@ -161,6 +198,13 @@ class TaskEventManager:
         self._terminal: "OrderedDict[str, None]" = OrderedDict()
         # Per-source cumulative drop counters (reported by buffers).
         self._source_dropped: Dict[str, int] = {}
+        # Dispatch-latency decomposition: bounded recent-sample window
+        # per stage (exact p50/p99 for summarize_tasks) — the
+        # stage-labelled histogram at /metrics is observed on the same
+        # ingest edge.
+        from collections import deque
+        self._stage_samples: Dict[str, "deque"] = {}
+        self._stage_deque = lambda: deque(maxlen=_STAGE_SAMPLE_CAP)
         self.evicted = 0
         publisher.subscribe(TASK_EVENT_CHANNEL, None, self._on_batch)
 
@@ -188,7 +232,8 @@ class TaskEventManager:
                    "type": "NORMAL_TASK", "state": None, "node_id": "",
                    "worker_id": "", "attempt": 0, "state_ts": {},
                    "events": [], "error": None,
-                   "start_time": ev["ts"], "end_time": None}
+                   "start_time": ev["ts"], "end_time": None,
+                   "_observed_stages": set(), "_seen_states": set()}
             self._records[tid] = rec
         state, ts = ev["state"], ev["ts"]
         # Batches from different buffers (owner-side vs node-side)
@@ -197,7 +242,19 @@ class TaskEventManager:
         # anchor the duration at submit time.
         if ts < rec["start_time"]:
             rec["start_time"] = ts
-        rec["state_ts"][state] = ts
+        if ev.get("attempt", 0) > rec["attempt"]:
+            # Retry rewind: the lifecycle reruns, so its stages must be
+            # measured again for the new attempt.
+            rec["_observed_stages"] = set()
+            rec["_seen_states"] = set()
+        # First arrival per state per attempt wins: a straggling
+        # duplicate from another buffer must not overwrite the anchor a
+        # later stage will be measured against (last-wins would poison
+        # the very durations this pipeline exists to measure).
+        if state not in rec["_seen_states"]:
+            rec["_seen_states"].add(state)
+            rec["state_ts"][state] = ts
+        self._observe_stages(rec)
         if len(rec["events"]) < _MAX_HISTORY:
             rec["events"].append((state, ts))
         for key in ("name", "job_id", "node_id", "worker_id"):
@@ -231,6 +288,74 @@ class TaskEventManager:
                 STATE_ORDER.index(state) >= STATE_ORDER.index(rec["state"])):
             rec["state"] = state
 
+    def _observe_stages(self, rec: dict) -> None:
+        """Fold the record's current state_ts into the dispatch-latency
+        decomposition (callers hold ``_lock``): a stage is measured as
+        soon as BOTH of its endpoints are known, whatever order their
+        batches arrived in — owner-side and node-side buffers interleave
+        freely, so the dependent state routinely lands before its anchor
+        and measuring only on arrival edges would silently drop exactly
+        the racy (biased) subset of tasks.  Each stage is measured once
+        per attempt.  Cross-buffer clock skew is normalized at emit
+        (buffer ts_offset); residual skew is clamped at zero rather than
+        poisoning the rollup with negative durations.  KNOWN
+        APPROXIMATION: when SUBMITTED arrives before a (late) SCHEDULED,
+        dispatch anchors to PENDING and over-attributes the queue wait —
+        bounded, and better than dropping the sample."""
+        measured = rec["_observed_stages"]
+        # Endpoints must both belong to the CURRENT attempt (_seen_states
+        # clears on retry rewind): a leftover attempt-0 timestamp in
+        # state_ts must not pair with an attempt-1 state.
+        seen = rec["_seen_states"]
+        sts = rec["state_ts"]
+        pairs = []
+        for state, (stage, anchors) in _STAGE_EDGES.items():
+            if stage in measured or state not in seen:
+                continue
+            anchor_ts = next((sts[a] for a in anchors if a in seen), None)
+            if anchor_ts is None:
+                continue
+            measured.add(stage)
+            pairs.append((stage, max(0.0, sts[state] - anchor_ts)))
+        if _TOTAL_STAGE[0] not in measured and _TOTAL_STAGE[2] in seen \
+                and _TOTAL_STAGE[1] in seen:
+            measured.add(_TOTAL_STAGE[0])
+            pairs.append((_TOTAL_STAGE[0],
+                          max(0.0, sts[_TOTAL_STAGE[2]]
+                              - sts[_TOTAL_STAGE[1]])))
+        if not pairs:
+            return
+        from ray_tpu._private.metrics_agent import observe_internal
+        for stage, dt in pairs:
+            window = self._stage_samples.get(stage)
+            if window is None:
+                window = self._stage_samples[stage] = self._stage_deque()
+            window.append(dt)
+            observe_internal("ray_tpu.task.dispatch_stage_seconds", dt,
+                             buckets=_STAGE_BUCKETS, stage=stage)
+
+    def latency_summary(self) -> Dict[str, dict]:
+        """Per-stage p50/p99 rollup over the recent sample window
+        (north-star surface: ``summarize_tasks``, ``ray-tpu latency``,
+        the bench dispatch row)."""
+        with self._lock:
+            samples = {stage: list(window)
+                       for stage, window in self._stage_samples.items()}
+        out: Dict[str, dict] = {}
+        for stage, vals in samples.items():
+            if not vals:
+                continue
+            vals.sort()
+            n = len(vals)
+            out[stage] = {
+                "count": n,
+                "mean_s": sum(vals) / n,
+                "p50_s": vals[int(0.50 * (n - 1))],
+                "p99_s": vals[int(0.99 * (n - 1))],
+                "max_s": vals[-1],
+            }
+        return out
+
     def _evict_one(self) -> None:
         # Oldest finished task first; if everything is still live, the
         # oldest record goes regardless (bounded memory beats history).
@@ -249,6 +374,8 @@ class TaskEventManager:
         is presented in wall-clock order — ingest appends in arrival
         order, and batches from different buffers interleave."""
         row = dict(rec)
+        row.pop("_observed_stages", None)   # ingest-internal bookkeeping
+        row.pop("_seen_states", None)
         row["state_ts"] = dict(rec["state_ts"])
         row["events"] = sorted(rec["events"], key=lambda e: e[1])
         start, end = row["start_time"], row["end_time"]

@@ -1,0 +1,132 @@
+"""GCS-side distributed timeline store.
+
+Parity: the reference's ``ProfileEvent`` pipeline
+(``src/ray/core_worker/profiling.h:64`` — workers batch profile events
+to the GCS profile table; ``ray.timeline()`` dumps the merged
+chrome://tracing JSON).  Here every process with spans to report —
+remote ``node_host`` daemons (raylet tick, dispatch, spill/restore,
+chunked transfers), process workers via task-reply piggyback — flushes
+span batches through the task-event pubsub path onto the
+``TIMELINE_CHANNEL``; this store folds them into one bounded buffer.
+
+Two properties the local tracing buffer cannot give a cluster:
+
+* **clock normalization** — each batch carries the publishing node's
+  estimated clock offset to the head (RTT-anchored on the heartbeat
+  channel, node_host._ClockSync); event timestamps are shifted into
+  head-clock microseconds at ingest so a parent span on the head and
+  its child on a skewed node stay monotone in the merged dump;
+* **bounded loss accounting** — the buffer is a fixed ring (task-event
+  buffer semantics): overflow drops the oldest events and counts them,
+  per-source drop counters reported by emitters are retained, and both
+  surface at /metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ray_tpu.gcs.pubsub import TIMELINE_CHANNEL
+from ray_tpu._private.debug import diag_lock
+
+
+class TimelineStore:
+    """Subscribes to ``TIMELINE_CHANNEL``; folds span batches from every
+    process into one bounded, clock-normalized event list."""
+
+    def __init__(self, publisher, max_events: int = 200_000):
+        self._lock = diag_lock("TimelineStore._lock")
+        self._max_events = max_events
+        self._events: List[dict] = []
+        self.dropped = 0                    # ring overflow, this store
+        # Per-source cumulative drop counters (emitter-side ring loss,
+        # reported on every batch).
+        self._source_dropped: Dict[str, int] = {}
+        self.batches_ingested = 0
+        publisher.subscribe(TIMELINE_CHANNEL, None, self._on_batch)
+        from ray_tpu._private.metrics_agent import (get_metrics_registry,
+                                                    record_internal)
+
+        def _collect(store):
+            with store._lock:
+                buffered = len(store._events)
+                dropped = store.dropped
+                at_source = sum(store._source_dropped.values())
+            record_internal("ray_tpu.timeline.buffered_events", buffered)
+            record_internal("ray_tpu.timeline.dropped_events", dropped)
+            record_internal("ray_tpu.timeline.dropped_at_source",
+                            at_source)
+        get_metrics_registry().register_collector(self, _collect)
+
+    # ---- ingest ---------------------------------------------------------
+    def _on_batch(self, _key, batch) -> None:
+        try:
+            events = batch["events"]
+            source = batch.get("source", "")
+            offset_us = float(batch.get("clock_offset_us", 0.0))
+            node_id = batch.get("node_id", "")
+            dropped = int(batch.get("dropped", 0))
+        except Exception:
+            return
+        normalized = []
+        for ev in events:
+            try:
+                ev = dict(ev)
+                ev["ts"] = float(ev.get("ts", 0.0)) + offset_us
+                if node_id:
+                    args = dict(ev.get("args") or {})
+                    args.setdefault("node_id", node_id)
+                    ev["args"] = args
+                normalized.append(ev)
+            except Exception:
+                continue
+        with self._lock:
+            if source:
+                self._source_dropped[source] = max(
+                    self._source_dropped.get(source, 0), dropped)
+            self.batches_ingested += 1
+            self._events.extend(normalized)
+            overflow = len(self._events) - self._max_events
+            if overflow > 0:
+                del self._events[:overflow]
+                self.dropped += overflow
+
+    # ---- query ----------------------------------------------------------
+    def events(self) -> List[dict]:
+        with self._lock:
+            out = list(self._events)
+            dropped = self.dropped
+            at_source = sum(self._source_dropped.values())
+        if dropped or at_source:
+            import os
+            import time
+            out.append({"name": "timeline.dropped", "ph": "i",
+                        "ts": time.time() * 1e6, "pid": os.getpid(),
+                        "tid": 0, "s": "g",
+                        "args": {"store_dropped": dropped,
+                                 "dropped_at_source": at_source}})
+        return out
+
+    def num_buffered(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def num_dropped_at_source(self) -> int:
+        with self._lock:
+            return sum(self._source_dropped.values())
+
+
+def merged_timeline(cluster) -> List[dict]:
+    """One chrome://tracing event list for the whole cluster: this
+    process's local tracing buffer (head clock — the reference frame)
+    merged with the GCS store's normalized remote spans, in timestamp
+    order."""
+    from ray_tpu.util import tracing
+    events = list(tracing.chrome_tracing_dump())
+    store: Optional[TimelineStore] = getattr(
+        getattr(cluster, "gcs", None), "timeline_store", None)
+    if store is not None:
+        events.extend(store.events())
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    return events

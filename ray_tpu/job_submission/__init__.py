@@ -282,5 +282,9 @@ class JobSubmissionClient:
     def summarize_tasks(self) -> dict:
         return self._client.call("state_summary", None, timeout=30.0)
 
+    def latency_summary(self) -> dict:
+        """Per-stage task-dispatch latency rollup (p50/p99)."""
+        return self._client.call("latency_summary", None, timeout=30.0)
+
     def close(self):
         self._client.close()

@@ -9,6 +9,8 @@ start/stop/status/submit/...``) + ``dashboard/modules/job/cli.py``
     python -m ray_tpu status
     python -m ray_tpu list tasks --filter state=RUNNING
     python -m ray_tpu summary tasks
+    python -m ray_tpu latency
+    python -m ray_tpu timeline -o trace.json
     python -m ray_tpu submit --working-dir . -- python script.py
     python -m ray_tpu jobs
     python -m ray_tpu logs <job-id>
@@ -289,6 +291,33 @@ def cmd_summary(args) -> int:
     return 0
 
 
+def cmd_latency(args) -> int:
+    """Task-dispatch latency decomposition (the BASELINE.json
+    north-star p99, split by lifecycle stage)."""
+    client = _client(args)
+    try:
+        stages = client.latency_summary()
+    finally:
+        client.close()
+    if args.output == "json":
+        print(json.dumps(stages, default=str, indent=2))
+        return 0
+    order = ("queue_wait", "dispatch", "startup", "total", "execution")
+    print(f"{'STAGE':12} {'COUNT':>7} {'MEAN_MS':>9} {'P50_MS':>9} "
+          f"{'P99_MS':>9} {'MAX_MS':>9}")
+    for stage in sorted(stages, key=lambda s: (order.index(s)
+                                               if s in order else 99, s)):
+        row = stages[stage]
+        print(f"{stage:12} {row['count']:>7} "
+              f"{row['mean_s'] * 1000:>9.3f} "
+              f"{row['p50_s'] * 1000:>9.3f} "
+              f"{row['p99_s'] * 1000:>9.3f} "
+              f"{row['max_s'] * 1000:>9.3f}")
+    if not stages:
+        print("\n(no finished tasks recorded yet)")
+    return 0
+
+
 def cmd_timeline(args) -> int:
     """Dump the head's tracing timeline as chrome://tracing JSON
     (reference `ray timeline`)."""
@@ -477,6 +506,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--output", choices=["table", "json"], default="table")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_summary)
+
+    p = sub.add_parser("latency", help="task-dispatch latency "
+                                       "decomposition (p50/p99 by stage)")
+    p.add_argument("--output", choices=["table", "json"], default="table")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_latency)
 
     p = sub.add_parser("timeline", help="dump chrome://tracing JSON")
     p.add_argument("--address", default=None)

@@ -56,6 +56,10 @@ class GlobalState:
         return self._gcs().resource_manager.live_available_resources()
 
     def chrome_tracing_dump(self) -> List[dict]:
+        w = worker_mod.global_worker()
+        if w.connected and w.cluster is not None:
+            from ray_tpu.gcs.timeline import merged_timeline
+            return merged_timeline(w.cluster)
         from ray_tpu.util import tracing
         return tracing.chrome_tracing_dump()
 

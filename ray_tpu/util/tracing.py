@@ -23,6 +23,14 @@ from typing import Dict, List, Optional
 
 _lock = threading.Lock()
 _events: List[dict] = []
+# Fixed-capacity ring: a long traced run must not grow memory forever
+# (task-event buffer semantics — loss is bounded, counted, and visible).
+# Oldest events are dropped first; the cumulative counter is surfaced as
+# an instant event on every drain and at /metrics.
+_MAX_EVENTS = 100_000
+_max_events = _MAX_EVENTS
+_dropped = 0
+_dropped_reported = 0       # drop count already emitted on a drain
 _enabled = False
 _tls = threading.local()
 
@@ -34,6 +42,43 @@ def enable(flag: bool = True):
 
 def is_enabled() -> bool:
     return _enabled
+
+
+def set_capacity(n: int) -> None:
+    """Resize the ring (tests); existing overflow is dropped+counted."""
+    global _max_events, _dropped
+    with _lock:
+        _max_events = max(1, int(n))
+        overflow = len(_events) - _max_events
+        if overflow > 0:
+            del _events[:overflow]
+            _dropped += overflow
+
+
+def dropped_count() -> int:
+    with _lock:
+        return _dropped
+
+
+def num_buffered() -> int:
+    with _lock:
+        return len(_events)
+
+
+def _append_locked(event: dict) -> None:
+    """Ring append (callers hold ``_lock``): over capacity, the OLDEST
+    events go — the tail of a long run is the part worth keeping.  The
+    trim drops a BATCH (1/16th of capacity), not one slot: a per-append
+    single-slot `del _events[:1]` on a full ring would memmove the
+    whole list under the lock on every span, serializing all tracing
+    threads on the hot path."""
+    global _dropped
+    if len(_events) >= _max_events:
+        overflow = len(_events) - _max_events + 1
+        trim = max(overflow, _max_events // 16)
+        del _events[:trim]
+        _dropped += trim
+    _events.append(event)
 
 
 def current_context() -> Optional[Dict]:
@@ -97,7 +142,7 @@ class span:
         args = dict(self.meta)
         args.update(self._ctx)
         with _lock:
-            _events.append({
+            _append_locked({
                 "name": self.name,
                 "cat": self.category,
                 "ph": "X",
@@ -113,15 +158,42 @@ def record_instant(name: str, **meta):
     if not _enabled:
         return
     with _lock:
-        _events.append({"name": name, "ph": "i", "ts": time.time() * 1e6,
+        _append_locked({"name": name, "ph": "i", "ts": time.time() * 1e6,
                         "pid": os.getpid(),
                         "tid": threading.get_ident() % 2**31,
                         "s": "g", "args": meta})
 
 
+def _drop_marker_locked(consume: bool) -> Optional[dict]:
+    """Instant event accounting for ring overflow (loss must be visible
+    in the trace itself, not only in a counter).  Only ``drain`` — the
+    transfer-of-ownership path — advances the reported watermark; a
+    read-only dump must keep showing the marker on every call (a second
+    ``timeline()`` of a truncated run must not look complete)."""
+    global _dropped_reported
+    if consume:
+        if _dropped <= _dropped_reported:
+            return None
+        since = _dropped - _dropped_reported
+        _dropped_reported = _dropped
+    else:
+        if _dropped <= 0:
+            return None
+        since = _dropped - _dropped_reported
+    return {"name": "tracing.dropped", "ph": "i",
+            "ts": time.time() * 1e6, "pid": os.getpid(),
+            "tid": threading.get_ident() % 2**31, "s": "g",
+            "args": {"dropped_total": _dropped,
+                     "dropped_since_last": since}}
+
+
 def chrome_tracing_dump() -> List[dict]:
     with _lock:
-        return list(_events)
+        out = list(_events)
+        marker = _drop_marker_locked(consume=False)
+    if marker is not None:
+        out.append(marker)
+    return out
 
 
 def drain() -> List[dict]:
@@ -130,6 +202,9 @@ def drain() -> List[dict]:
     with _lock:
         out = list(_events)
         _events.clear()
+        marker = _drop_marker_locked(consume=True)
+    if marker is not None:
+        out.append(marker)
     return out
 
 
@@ -138,9 +213,42 @@ def ingest(events: Optional[List[dict]]):
     if not events:
         return
     with _lock:
-        _events.extend(events)
+        for ev in events:
+            _append_locked(ev)
 
 
 def clear():
+    global _dropped, _dropped_reported
     with _lock:
         _events.clear()
+        _dropped = 0
+        _dropped_reported = 0
+
+
+# /metrics surface for the ring's loss accounting — a scrape-time
+# collector on a module-lifetime owner (the tracing buffer is process
+# state, so its series never need churn-pruning).
+class _TracingStatsOwner:
+    pass
+
+
+_stats_owner = _TracingStatsOwner()
+
+
+def _register_stats_collector():
+    try:
+        from ray_tpu._private.metrics_agent import (get_metrics_registry,
+                                                    record_internal)
+    except Exception:       # circular-import guard at bootstrap
+        return
+
+    def _collect(_owner):
+        with _lock:
+            dropped, buffered = _dropped, len(_events)
+        record_internal("ray_tpu.tracing.dropped_events", dropped)
+        record_internal("ray_tpu.tracing.buffered_events", buffered)
+
+    get_metrics_registry().register_collector(_stats_owner, _collect)
+
+
+_register_stats_collector()

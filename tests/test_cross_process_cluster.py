@@ -406,6 +406,115 @@ def _make_task_spec(_fn):
     return _make_lease_spec()
 
 
+class TestObservabilityPlane:
+    """Cluster-wide observability: a remote daemon's metrics federate
+    into the head's /metrics under a node_id label (pruned on death),
+    and its spans reach the merged, clock-normalized timeline."""
+
+    @pytest.fixture
+    def observed_cluster(self):
+        cfg = dict(_WIRE_CONFIG, metrics_report_interval_ms=100,
+                   tracing_enabled=True)
+        from ray_tpu.util import tracing
+        tracing.clear()
+        ray_tpu.init(num_cpus=2, _system_config=cfg)
+        yield global_worker().cluster
+        ray_tpu.shutdown()
+        tracing.enable(False)
+        tracing.clear()
+
+    def test_remote_counters_federated_and_pruned_on_death(
+            self, observed_cluster):
+        from ray_tpu._private.metrics_agent import get_metrics_registry
+        handle = observed_cluster.add_remote_node(
+            num_cpus=2, resources={"spoke": 4.0})
+        nid = handle.node_id.hex()[:12]
+
+        @ray_tpu.remote(resources={"spoke": 1.0})
+        def work(x):
+            return x * 2
+
+        assert ray_tpu.get([work.remote(i) for i in range(8)],
+                           timeout=30) == [2 * i for i in range(8)]
+
+        reg = get_metrics_registry()
+
+        def federated_lines():
+            return [line for line in reg.render_prometheus().splitlines()
+                    if f'node_id="{nid}"' in line]
+
+        # The daemon's scheduler tick counters and tick-latency
+        # histogram, plus its spill/transfer counters, all land
+        # node_id-labelled (deltas ship as series change — wait for
+        # each, not just the first report).
+        expected = ("ray_tpu_scheduler_tick_ticks",
+                    "ray_tpu_scheduler_tick_latency_bucket",
+                    "ray_tpu_local_object_manager_spilled_bytes",
+                    "ray_tpu_object_manager_pulled_bytes")
+        assert _wait_until(
+            lambda: all(any(m in line for line in federated_lines())
+                        for m in expected), timeout=25), \
+            f"missing federated series; have:\n" + \
+            "\n".join(federated_lines())
+
+        # Node death prunes every one of its series from the exposition
+        # (collector-ownership machinery, prompt on death).
+        handle.terminate()
+        assert _wait_until(
+            lambda: not observed_cluster.gcs.node_manager.is_alive(
+                handle.node_id), timeout=15)
+        assert _wait_until(lambda: not federated_lines(), timeout=10), \
+            "dead node's federated series were not pruned"
+
+    def test_remote_spans_in_merged_timeline(self, observed_cluster):
+        handle = observed_cluster.add_remote_node(
+            num_cpus=2, resources={"spoke": 4.0})
+
+        @ray_tpu.remote(resources={"spoke": 1.0})
+        def traced(x):
+            return x + 1
+
+        assert ray_tpu.get(traced.remote(1), timeout=30) == 2
+
+        def remote_sched_spans():
+            return [e for e in ray_tpu.timeline()
+                    if e.get("cat") == "sched" and e["pid"] != os.getpid()]
+
+        # The daemon's raylet-tick spans flush through the pubsub plane
+        # into the GCS timeline store — no task reply carries them.
+        assert _wait_until(lambda: bool(remote_sched_spans()),
+                           timeout=20), \
+            "no remote scheduler spans in the merged timeline"
+        events = ray_tpu.timeline()
+        assert len({e["pid"] for e in events}) >= 2, \
+            "merged timeline should span >=2 OS processes"
+        for e in events:
+            assert {"name", "ph", "ts", "pid", "tid"} <= set(e)
+        # Cross-process causality stays monotone after normalization:
+        # the executed task's span must not precede its submit span.
+        submits = [e for e in events if e.get("cat") == "submit"]
+        executes = [e for e in events
+                    if e.get("cat") == "execute" and
+                    e["pid"] == handle.proc.pid]
+        assert submits and executes
+        by_span = {s["args"]["span_id"]: s for s in submits}
+        for ex in executes:
+            parent = by_span.get(ex["args"].get("parent_id"))
+            if parent is not None:
+                assert ex["ts"] >= parent["ts"] - 1e3, \
+                    "child span precedes its parent by >1ms"
+
+    def test_clock_probe_served_by_head(self, observed_cluster):
+        from ray_tpu.rpc import RpcClient
+        client = RpcClient(observed_cluster.start_head_service())
+        try:
+            # The anchor the daemons' _ClockSync estimates against.
+            head_ts = client.call("clock_probe", None, timeout=10.0)
+            assert abs(head_ts - time.time()) < 5.0
+        finally:
+            client.close()
+
+
 class TestPeerToPeerObjectPlane:
     """Node↔node direct object transfer: the directory hands out peer
     addresses and spokes pull from each other, so the head never relays

@@ -191,6 +191,23 @@ class TestCliMemoryTimelineUp:
         import json as json_mod
         assert isinstance(json_mod.loads(dump.read_text()), list)
 
+    def test_latency_verb(self, head_daemon):
+        """`ray-tpu latency`: dispatch-latency decomposition served by
+        the head (table + json)."""
+        out = self._cli("latency", "--address", head_daemon["address"])
+        assert out.returncode == 0, out.stderr
+        assert "STAGE" in out.stdout and "P99_MS" in out.stdout
+        out = self._cli("latency", "--address", head_daemon["address"],
+                        "--output", "json")
+        assert out.returncode == 0, out.stderr
+        import json as json_mod
+        stages = json_mod.loads(out.stdout)
+        assert isinstance(stages, dict)
+        # Stage rows appear once any task ran through the head's GCS;
+        # rows that do exist must be shaped right.
+        for row in stages.values():
+            assert {"count", "p50_s", "p99_s"} <= set(row)
+
     def test_up_launches_local_cluster(self, tmp_path):
         """`up` from a YAML config: head + 2 worker-hosts, visible in
         `status`, stopped by `down` (reference cluster launcher shape,

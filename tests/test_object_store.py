@@ -70,3 +70,73 @@ def test_free_objects_api(ray_start_regular):
     core.free_objects([ref])
     with pytest.raises(ray_tpu.exceptions.GetTimeoutError):
         ray_tpu.get(ref, timeout=0.2)
+
+
+def test_vanished_native_entry_self_heals(ray_start_regular):
+    """A sealed entry whose native backing was deleted underneath (a
+    lost free race) must read as ObjectVanishedError, and drop_vanished
+    must remove it so `contains` stops short-circuiting pulls "local"
+    forever (the cross-node arg-fetch livelock shape)."""
+    import numpy as np
+
+    from ray_tpu._private.object_store import (ObjectVanishedError,
+                                               _NativeHandle, entry_value)
+    store = worker_mod.global_worker().cluster.head_node.object_store
+    if store._native is None:
+        pytest.skip("native store unavailable")
+    ref = ray_tpu.put(np.arange(500_000, dtype=np.float64))
+    oid = ref.object_id()
+    entry = store.get(oid)
+    assert isinstance(entry.data, _NativeHandle)
+    # Simulate the race: the native key vanishes under the sealed entry.
+    store._native.delete(entry.data.key)
+    assert store.contains(oid)                  # the lie drop_vanished fixes
+    with pytest.raises(ObjectVanishedError):
+        entry_value(store.get(oid))
+    assert store.get_serialized(oid) is None    # heals via this path too
+    assert not store.contains(oid)
+    assert store.stats.get("vanished_objects", 0) >= 1
+    # A healthy entry is NOT dropped.
+    ref2 = ray_tpu.put(np.arange(100_000, dtype=np.float64))
+    assert store.drop_vanished(ref2.object_id()) is False
+    assert store.contains(ref2.object_id())
+
+
+def test_stale_self_location_does_not_fail_pull(ray_start_cluster):
+    """A directory row claiming the puller itself holds the object
+    (stale after a local drop) must be skipped — and dropped — in favor
+    of a genuine remote copy."""
+    import threading
+    import time
+
+    import numpy as np
+    cluster = ray_start_cluster(num_cpus=1)
+    node2 = cluster.add_node(num_cpus=1, resources={"src": 1})
+    assert cluster.wait_for_nodes(2)
+    head = cluster.head_node
+
+    @ray_tpu.remote(resources={"src": 0.5}, num_cpus=0)
+    def produce():
+        return np.arange(200_000, dtype=np.float32)
+
+    ref = produce.remote()
+    oid = ref.object_id()
+    # Wait until the real copy lands on node2.
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and \
+            not node2.object_store.contains(oid):
+        time.sleep(0.01)
+    assert node2.object_store.contains(oid)
+    # Poison the directory with a stale self-location for the head.
+    cluster.object_directory.add_location(oid, head.node_id)
+    assert not head.object_store.contains(oid)
+
+    done = threading.Event()
+    ok_box = []
+    head.object_manager.pull_async(oid, lambda ok: (ok_box.append(ok),
+                                                    done.set()))
+    assert done.wait(timeout=30)
+    assert ok_box == [True]
+    assert head.object_store.contains(oid)
+    got = ray_tpu.get(ref, timeout=30)
+    assert got.shape == (200_000,)

@@ -598,3 +598,305 @@ class TestLatencyEnvelope:
         assert dt < 0.05, \
             f"wait() on sealed objects took {dt*1e3:.1f} ms — the " \
             "coarse-poll fallback is on the ready path"
+
+
+class TestDispatchLatencyDecomposition:
+    """Per-stage task-dispatch latency derived from the task-event
+    lifecycle (queue_wait -> dispatch -> startup; total = submit ->
+    running, the BASELINE.json north-star p99)."""
+
+    def _manager(self):
+        from ray_tpu.gcs.pubsub import Publisher
+        from ray_tpu.gcs.task_events import TaskEventManager
+        pub = Publisher()
+        return pub, TaskEventManager(pub)
+
+    def _feed(self, pub, events):
+        from ray_tpu.gcs.pubsub import TASK_EVENT_CHANNEL
+        pub.publish(TASK_EVENT_CHANNEL, b"",
+                    {"buffer_id": "test", "events": events, "dropped": 0})
+
+    def test_injected_stage_delays_attributed_to_right_stage(self):
+        """ACCEPTANCE: a known per-stage delay shows up in that stage's
+        rollup and nowhere else."""
+        from ray_tpu.gcs import task_events as te
+        pub, mgr = self._manager()
+        t0 = 1_000_000.0
+        delays = {"queue_wait": 0.5, "dispatch": 0.2, "startup": 0.3,
+                  "execution": 0.25}
+        self._feed(pub, [
+            {"task_id": "t1", "state": te.PENDING_ARGS_AVAIL, "ts": t0},
+            {"task_id": "t1", "state": te.SCHEDULED,
+             "ts": t0 + 0.5},
+            {"task_id": "t1", "state": te.SUBMITTED_TO_WORKER,
+             "ts": t0 + 0.7},
+            {"task_id": "t1", "state": te.RUNNING, "ts": t0 + 1.0},
+            {"task_id": "t1", "state": te.FINISHED, "ts": t0 + 1.25},
+        ])
+        summary = mgr.latency_summary()
+        for stage, expect in delays.items():
+            assert stage in summary, (stage, summary)
+            assert abs(summary[stage]["p50_s"] - expect) < 1e-6, \
+                (stage, summary[stage])
+            assert summary[stage]["count"] == 1
+        # total = submit -> running (excludes execution).
+        assert abs(summary["total"]["p50_s"] - 1.0) < 1e-6
+
+    def test_duplicate_and_straggler_events_do_not_double_count(self):
+        from ray_tpu.gcs import task_events as te
+        pub, mgr = self._manager()
+        t0 = 1_000_000.0
+        self._feed(pub, [
+            {"task_id": "t1", "state": te.PENDING_ARGS_AVAIL, "ts": t0},
+            {"task_id": "t1", "state": te.SCHEDULED, "ts": t0 + 0.1},
+            # Straggling duplicate of SCHEDULED from another buffer.
+            {"task_id": "t1", "state": te.SCHEDULED, "ts": t0 + 0.4},
+            # The straggler must NOT have overwritten the anchor:
+            # dispatch measures against the FIRST SCHEDULED (t0+0.1).
+            {"task_id": "t1", "state": te.SUBMITTED_TO_WORKER,
+             "ts": t0 + 0.15},
+        ])
+        summary = mgr.latency_summary()
+        assert summary["queue_wait"]["count"] == 1
+        assert abs(summary["dispatch"]["p50_s"] - 0.05) < 1e-6, summary
+
+    def test_out_of_order_cross_buffer_arrival_still_measures(self):
+        """The dependent state routinely lands before its anchor (owner
+        and node buffers interleave): the stage must be measured when
+        the anchor arrives, not dropped."""
+        from ray_tpu.gcs import task_events as te
+        pub, mgr = self._manager()
+        t0 = 1_000_000.0
+        self._feed(pub, [
+            # Node-side SCHEDULED reaches the manager FIRST...
+            {"task_id": "t1", "state": te.SCHEDULED, "ts": t0 + 0.5},
+            # ...then the owner's PENDING batch flushes.
+            {"task_id": "t1", "state": te.PENDING_ARGS_AVAIL, "ts": t0},
+        ])
+        summary = mgr.latency_summary()
+        assert summary["queue_wait"]["count"] == 1
+        assert abs(summary["queue_wait"]["p50_s"] - 0.5) < 1e-6
+
+    def test_retry_measures_stages_again(self):
+        from ray_tpu.gcs import task_events as te
+        pub, mgr = self._manager()
+        t0 = 1_000_000.0
+        self._feed(pub, [
+            {"task_id": "t1", "state": te.PENDING_ARGS_AVAIL, "ts": t0},
+            {"task_id": "t1", "state": te.SCHEDULED, "ts": t0 + 0.1},
+            # Retry: attempt bumps, lifecycle reruns.
+            {"task_id": "t1", "state": te.PENDING_ARGS_AVAIL,
+             "ts": t0 + 1.0, "attempt": 1},
+            {"task_id": "t1", "state": te.SCHEDULED,
+             "ts": t0 + 1.3, "attempt": 1},
+        ])
+        assert mgr.latency_summary()["queue_wait"]["count"] == 2
+
+    def test_e2e_rollup_and_metrics_surface(self, thread_cluster):
+        from ray_tpu.experimental.state.api import summarize_tasks
+
+        @ray_tpu.remote
+        def f(x):
+            return x
+
+        assert ray_tpu.get([f.remote(i) for i in range(30)],
+                           timeout=60) == list(range(30))
+        stages = summarize_tasks()["dispatch_latency"]
+        # Every task has dispatch/startup/total/execution; queue_wait
+        # only exists for tasks that traversed the raylet scheduler
+        # (lease-reuse pushes legitimately skip SCHEDULED).
+        for stage in ("dispatch", "startup", "total", "execution"):
+            assert stage in stages, stages
+            assert stages[stage]["count"] >= 30
+        assert stages.get("queue_wait", {}).get("count", 0) >= 1
+        for row in stages.values():
+            assert 0.0 <= row["p50_s"] <= row["p99_s"] <= row["max_s"]
+        from ray_tpu._private.metrics_agent import get_metrics_registry
+        text = get_metrics_registry().render_prometheus()
+        assert 'ray_tpu_task_dispatch_stage_seconds_bucket' in text
+        assert 'stage="total"' in text
+
+
+class TestMetricsRegistryBounds:
+    """Regression: a bucketless histogram must never accumulate a raw
+    observation list (unbounded memory on a hot path)."""
+
+    def test_bucketless_histogram_forced_onto_default_buckets(self):
+        from ray_tpu._private.metrics_agent import (MetricsRegistry,
+                                                    _Hist)
+        reg = MetricsRegistry()
+        reg.register("h.nobuckets", "histogram")     # no buckets given
+        for i in range(10_000):
+            reg.observe("h.nobuckets", i / 10_000.0, ())
+        val = reg.get_value("h.nobuckets", ())
+        assert isinstance(val, _Hist), type(val)     # not a list
+        assert val.count == 10_000
+        # Renders as a real histogram.
+        text = reg.render_prometheus()
+        assert "h_nobuckets_bucket" in text
+        assert "h_nobuckets_count 10000" in text
+
+
+class TestTracingRing:
+    """The tracing buffer is a fixed ring: overflow drops the OLDEST
+    events, counted and surfaced (instant event + /metrics)."""
+
+    def test_ring_bounds_and_drop_accounting(self):
+        from ray_tpu.util import tracing
+        tracing.clear()
+        tracing.enable(True)
+        old_cap = tracing._max_events
+        try:
+            tracing.set_capacity(10)
+            for i in range(50):
+                tracing.record_instant(f"ev{i}")
+            assert tracing.num_buffered() <= 10
+            assert tracing.dropped_count() == 40
+            events = tracing.drain()
+            # Ring keeps the newest events; a drop marker rides the
+            # drain so loss is visible in the trace itself.
+            names = [e["name"] for e in events]
+            assert "ev49" in names and "ev0" not in names
+            markers = [e for e in events if e["name"] == "tracing.dropped"]
+            assert markers and \
+                markers[0]["args"]["dropped_total"] == 40
+            # /metrics surface.
+            from ray_tpu._private.metrics_agent import \
+                get_metrics_registry
+            text = get_metrics_registry().render_prometheus()
+            assert "ray_tpu_tracing_dropped_events" in text
+        finally:
+            tracing.set_capacity(old_cap)
+            tracing.enable(False)
+            tracing.clear()
+
+
+class TestTimelineStoreClockSkew:
+    """GCS-side timeline store: bounded ingest + clock normalization
+    (a skewed node's spans land in head-clock microseconds)."""
+
+    def _store(self, **kw):
+        from ray_tpu.gcs.pubsub import Publisher
+        from ray_tpu.gcs.timeline import TimelineStore
+        pub = Publisher()
+        return pub, TimelineStore(pub, **kw)
+
+    def _publish(self, pub, events, offset_us=0.0, source="n1",
+                 node_id="n1", dropped=0):
+        from ray_tpu.gcs.pubsub import TIMELINE_CHANNEL
+        pub.publish(TIMELINE_CHANNEL, b"",
+                    {"source": source, "node_id": node_id,
+                     "clock_offset_us": offset_us, "dropped": dropped,
+                     "events": events})
+
+    def test_injected_skew_normalized_and_parent_child_monotone(self):
+        pub, store = self._store()
+        # Head-side parent span at t=1000s; the child ran 10ms later on
+        # a node whose clock is 2s BEHIND: its raw ts precedes the
+        # parent until the node's estimated +2s offset is applied.
+        parent_ts = 1_000.0 * 1e6
+        child_raw_ts = (1_000.0 + 0.010 - 2.0) * 1e6
+        self._publish(pub, [{"name": "child", "ph": "X",
+                             "ts": child_raw_ts, "dur": 5.0,
+                             "pid": 2, "tid": 1}],
+                      offset_us=2.0 * 1e6)
+        (child,) = store.events()
+        assert child["ts"] >= parent_ts
+        assert abs(child["ts"] - (parent_ts + 10_000)) < 1.0
+        assert child["args"]["node_id"] == "n1"
+
+    def test_bounded_ring_with_drop_counters(self):
+        pub, store = self._store(max_events=5)
+        self._publish(pub, [{"name": f"e{i}", "ph": "i", "ts": float(i),
+                             "pid": 1, "tid": 1} for i in range(12)],
+                      dropped=3)
+        assert store.num_buffered() == 5
+        assert store.dropped == 7
+        assert store.num_dropped_at_source() == 3
+        events = store.events()
+        names = [e["name"] for e in events]
+        assert "e11" in names and "e0" not in names    # oldest dropped
+        marker = [e for e in events if e["name"] == "timeline.dropped"]
+        assert marker and marker[0]["args"]["store_dropped"] == 7
+
+
+class TestMetricsFederationUnit:
+    """Delta shipper + head-side federation (same-process unit test;
+    the cross-process path is covered in test_cross_process_cluster)."""
+
+    def test_delta_upsert_and_prune(self):
+        from ray_tpu._private.metrics_agent import (
+            MetricsDeltaShipper, MetricsFederation, MetricsRegistry)
+        node_reg = MetricsRegistry()
+        head_reg = MetricsRegistry()
+        node_reg.register("n.counter", "counter")
+        node_reg.inc("n.counter", 3.0, (("k", "v"),))
+        shipper = MetricsDeltaShipper(node_reg)
+        fed = MetricsFederation(head_reg)
+        snap, full = shipper.collect_delta()
+        assert full            # first report is a full snapshot
+        fed.ingest("nodeA", snap, full=full)
+        text = head_reg.render_prometheus()
+        assert 'n_counter{k="v",node_id="nodeA"} 3.0' in text
+        # Steady state: nothing changed, nothing ships.
+        assert shipper.collect_delta() == (None, False)
+        # A change ships only the changed series, upserted at the head.
+        node_reg.inc("n.counter", 2.0, (("k", "v"),))
+        delta, full = shipper.collect_delta()
+        assert not full and list(delta) == ["n.counter"]
+        fed.ingest("nodeA", delta, full=full)
+        assert 'n_counter{k="v",node_id="nodeA"} 5.0' in \
+            head_reg.render_prometheus()
+        # Prune: every series the node ever shipped vanishes.
+        fed.drop("nodeA")
+        assert "nodeA" not in head_reg.render_prometheus()
+
+    def test_full_resync_prunes_locally_dropped_series(self):
+        """Worker churn prunes series in the node registry; a FULL
+        report must stop the head from rendering the stale copies."""
+        from ray_tpu._private.metrics_agent import (
+            MetricsDeltaShipper, MetricsFederation, MetricsRegistry)
+        node_reg = MetricsRegistry()
+        head_reg = MetricsRegistry()
+        node_reg.register("w.gauge", "gauge")
+        node_reg.set("w.gauge", 1.0, (("worker", "w1"),))
+        node_reg.set("w.gauge", 2.0, (("worker", "w2"),))
+        shipper = MetricsDeltaShipper(node_reg, full_every=2)
+        fed = MetricsFederation(head_reg)
+        snap, full = shipper.collect_delta()
+        fed.ingest("nodeA", snap, full=full)
+        assert 'worker="w1"' in head_reg.render_prometheus()
+        # w1's worker dies: the node prunes its series locally.
+        with node_reg._lock:
+            node_reg._metrics["w.gauge"].series.pop((("worker", "w1"),))
+        # Delta report in between (reports: 1 -> 2)...
+        node_reg.set("w.gauge", 2.5, (("worker", "w2"),))
+        snap, full = shipper.collect_delta()
+        assert not full
+        fed.ingest("nodeA", snap, full=full)
+        assert 'worker="w1"' in head_reg.render_prometheus()  # still stale
+        # ...then full_every=2 makes this report FULL -> head replaces.
+        node_reg.set("w.gauge", 3.0, (("worker", "w2"),))
+        snap, full = shipper.collect_delta()
+        assert full
+        fed.ingest("nodeA", snap, full=full)
+        text = head_reg.render_prometheus()
+        assert 'worker="w1"' not in text, text
+        assert 'w_gauge{node_id="nodeA",worker="w2"} 3.0' in text
+
+    def test_repeat_dump_keeps_drop_marker(self):
+        from ray_tpu.util import tracing
+        tracing.clear()
+        tracing.enable(True)
+        old_cap = tracing._max_events
+        try:
+            tracing.set_capacity(5)
+            for i in range(9):
+                tracing.record_instant(f"x{i}")
+            for _ in range(2):       # read-only dump never consumes it
+                dump = tracing.chrome_tracing_dump()
+                assert any(e["name"] == "tracing.dropped" for e in dump)
+        finally:
+            tracing.set_capacity(old_cap)
+            tracing.enable(False)
+            tracing.clear()

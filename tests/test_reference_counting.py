@@ -27,22 +27,32 @@ def test_out_of_scope_frees_object(ray_start_regular):
     assert not raylet.object_store.contains(oid)
 
 
-def test_submitted_task_ref_pins(ray_start_regular):
-    import time
+def test_submitted_task_ref_pins(ray_start_regular, tmp_path):
+    # Gate the task on a file instead of a fixed sleep: under full-suite
+    # load the assert below can run arbitrarily late, and a finished
+    # task legitimately drops its pin — the test must control when the
+    # task may complete.
+    gate = str(tmp_path / "release")
 
     @ray_tpu.remote
-    def slow_identity(x):
-        time.sleep(0.3)
+    def gated_identity(x, gate_path):
+        import os
+        import time as time_mod
+        deadline = time_mod.monotonic() + 30
+        while not os.path.exists(gate_path) and \
+                time_mod.monotonic() < deadline:
+            time_mod.sleep(0.01)
         return x
 
     ref = ray_tpu.put(123)
     oid = ref.object_id()
-    out = slow_identity.remote(ref)
+    out = gated_identity.remote(ref, gate)
     del ref
     gc.collect()
     core = _core()
     # The pending task still holds a reference.
     assert core.reference_counter.has_reference(oid)
+    open(gate, "w").close()
     assert ray_tpu.get(out) == 123
 
 
