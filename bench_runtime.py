@@ -95,8 +95,14 @@ def bench_dispatch_latency(n):
     ray_tpu.get([noop.remote() for _ in range(n)])
     stages = summarize_tasks().get("dispatch_latency", {})
     total = stages.get("total", {})
+    from ray_tpu._private.worker import global_worker
+    ticks = global_worker().cluster.head_node.cluster_task_manager \
+        .tick_stats
     return emit("task_dispatch_latency_p99",
                 total.get("p99_s", 0.0) * 1000.0, "ms", n=n,
+                spillbacks_no_capacity=ticks["spillbacks_no_capacity"],
+                spillbacks_locality_override=ticks[
+                    "spillbacks_locality_override"],
                 p50_ms=round(total.get("p50_s", 0.0) * 1000.0, 4),
                 stages={
                     stage: {"p50_ms": round(row["p50_s"] * 1000.0, 4),
@@ -301,6 +307,8 @@ def bench_broadcast(mb, n_nodes):
                 assert node.object_store.contains(oid)
             return dt
 
+        cross_before = sum(n.object_manager.stats["cross_node_fetch_bytes"]
+                           for n in nodes)
         cold_fetch_dt = broadcast_once()
         # Steady state: drop the replicas (head keeps the primary) and
         # broadcast again — the nodes' segment blocks are reused warm.
@@ -312,6 +320,8 @@ def bench_broadcast(mb, n_nodes):
         fetch_dt = broadcast_once()
         window = max(n.object_manager.stats["inflight_window_peak"]
                      for n in nodes)
+        cross_delta = sum(n.object_manager.stats["cross_node_fetch_bytes"]
+                          for n in nodes) - cross_before
         return emit("broadcast_object", mb, "MiB",
                     n_nodes=n_nodes,
                     put_gbps=round(gib / put_dt, 2),
@@ -320,6 +330,11 @@ def bench_broadcast(mb, n_nodes):
                     fetch_gbps_per_node=round(gib / fetch_dt, 2),
                     cold_fetch_gbps=round(gib * n_nodes / cold_fetch_dt,
                                           2),
+                    # Placement-quality counter: bytes the object plane
+                    # moved between nodes for these broadcasts (the
+                    # metric the arg-locality cost term shrinks on the
+                    # dispatch path).
+                    cross_node_fetch_bytes=cross_delta,
                     inflight_window_peak=window)
     finally:
         for node in nodes:
@@ -327,6 +342,150 @@ def bench_broadcast(mb, n_nodes):
                 cluster.remove_node(node)
             except Exception:
                 pass
+
+
+def _synthetic_view(n_nodes, rng):
+    """A heterogeneous ClusterResourceView without a live cluster —
+    the PG/autoscaler solves are pure functions of the view."""
+    import numpy as np
+
+    from ray_tpu.scheduler.resources import (ClusterResourceView,
+                                             NodeResources)
+    view = ClusterResourceView()
+    kinds = rng.choice(3, size=n_nodes, p=[0.6, 0.3, 0.1])
+    for i in range(n_nodes):
+        k = int(kinds[i])
+        total = {"CPU": [4, 64, 8][k], "memory": [16, 256, 64][k]}
+        if k == 2:
+            total["TPU"] = 4
+        view.add_node(f"node{i}", NodeResources(total))
+    return view
+
+
+def bench_pg_packing(n_pgs, n_nodes, kernel=True):
+    """pg_bundle_packing row: mixed-strategy placement groups solved at
+    the ``pack_bundles`` surface against one synthetic N-node view —
+    the newly-kernelized GCS solve, timed kernel arm vs greedy arm.
+    Solve-level (no 2PC) so the number is the scheduler, not RPC."""
+    import numpy as np
+
+    from ray_tpu._private.config import get_config
+    from ray_tpu.scheduler import bundle_packing
+    from ray_tpu.scheduler.resources import ResourceRequest
+
+    rng = np.random.default_rng(7)
+    view = _synthetic_view(n_nodes, rng)
+    strategies = ["PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD"]
+    groups = []
+    for i in range(n_pgs):
+        nb = int(rng.integers(1, 5))
+        bundles = [ResourceRequest(
+            {"CPU": float(rng.choice([0.5, 1, 2])),
+             "memory": float(rng.choice([1, 2, 4]))})
+            for _ in range(nb)]
+        groups.append((bundles, strategies[i % len(strategies)]))
+
+    prev_mode = get_config().pg_kernel_backend
+
+    def run_arm(mode):
+        get_config().pg_kernel_backend = mode
+        try:
+            placed = 0
+            t0 = time.monotonic()
+            for bundles, strategy in groups:
+                if bundle_packing.pack_bundles(view, bundles,
+                                               strategy) is not None:
+                    placed += 1
+            return time.monotonic() - t0, placed
+        finally:
+            get_config().pg_kernel_backend = prev_mode
+
+    # Warm the jit caches outside the timed region.
+    if kernel:
+        run_arm("force")
+    kernel_dt, kernel_placed = run_arm("force") if kernel else (None, None)
+    greedy_dt, greedy_placed = run_arm("off")
+    import jax
+    row = dict(n_nodes=n_nodes,
+               greedy_pgs_per_s=round(n_pgs / greedy_dt, 2),
+               greedy_placed=greedy_placed,
+               backend=jax.default_backend())
+    if kernel:
+        row.update(kernel_pgs_per_s=round(n_pgs / kernel_dt, 2),
+                   kernel_placed=kernel_placed,
+                   kernel_vs_greedy=round(greedy_dt / kernel_dt, 2))
+    return emit("pg_bundle_packing", n_pgs, "pgs", **row)
+
+
+def bench_autoscaler_solve(n_demands, n_nodes, kernel=True):
+    """autoscaler_solve row: ``get_nodes_to_launch`` over a big demand
+    vector + pending placement groups, kernel arm vs exact-numpy arm —
+    the newly-kernelized ResourceDemandScheduler solve."""
+    import numpy as np
+
+    from ray_tpu._private.config import get_config
+    from ray_tpu.autoscaler import resource_demand_scheduler as rds
+
+    rng = np.random.default_rng(11)
+    node_types = {
+        "head": {"resources": {"CPU": 8}, "max_workers": 1},
+        "cpu_small": {"resources": {"CPU": 4, "memory": 16},
+                      "max_workers": max(n_nodes, 64)},
+        "cpu_big": {"resources": {"CPU": 64, "memory": 256},
+                    "max_workers": max(n_nodes // 4, 16)},
+        "tpu_host": {"resources": {"CPU": 8, "TPU": 4, "memory": 64},
+                     "max_workers": max(n_nodes // 8, 8)},
+    }
+    sched = rds.ResourceDemandScheduler(node_types,
+                                        max_workers=2 * n_nodes,
+                                        head_node_type="head")
+    demands = []
+    for _ in range(n_demands):
+        d = {"CPU": float(rng.choice([0.5, 1, 2, 4]))}
+        if rng.random() < 0.3:
+            d["memory"] = float(rng.choice([1, 2, 16]))
+        if rng.random() < 0.08:
+            d["TPU"] = float(rng.choice([1, 4]))
+        demands.append(d)
+    unused = {f"n{i}": {"CPU": float(rng.integers(0, 4)),
+                        "memory": float(rng.integers(0, 16))}
+              for i in range(n_nodes)}
+    pgs = [{"strategy": ["PACK", "STRICT_SPREAD"][i % 2],
+            "bundles": [{"CPU": 2}] * 3} for i in range(16)]
+    args = dict(node_type_counts={"head": 1, "cpu_small": n_nodes},
+                launching_nodes={},
+                resource_demands=demands,
+                unused_resources_by_node=unused,
+                pending_placement_groups=pgs)
+
+    prev_mode = get_config().autoscaler_kernel_backend
+
+    def run_arm(mode):
+        get_config().autoscaler_kernel_backend = mode
+        try:
+            t0 = time.monotonic()
+            to_launch, unfulfilled = sched.get_nodes_to_launch(**args)
+            return (time.monotonic() - t0, sum(to_launch.values()),
+                    len(unfulfilled))
+        finally:
+            get_config().autoscaler_kernel_backend = prev_mode
+
+    if kernel:
+        run_arm("force")               # warm jit caches
+    import jax
+    row = {"backend": jax.default_backend(), "n_nodes": n_nodes}
+    numpy_dt, numpy_launch, numpy_unf = run_arm("off")
+    row.update(numpy_ms=round(numpy_dt * 1000.0, 2),
+               numpy_nodes_launched=numpy_launch,
+               numpy_unfulfilled=numpy_unf)
+    if kernel:
+        kernel_dt, kernel_launch, kernel_unf = run_arm("force")
+        row.update(kernel_ms=round(kernel_dt * 1000.0, 2),
+                   kernel_nodes_launched=kernel_launch,
+                   kernel_unfulfilled=kernel_unf,
+                   kernel_vs_numpy=round(numpy_dt / max(kernel_dt, 1e-9),
+                                         2))
+    return emit("autoscaler_solve", n_demands, "demands", **row)
 
 
 def bench_process_mode_objects(mb, rounds):
@@ -411,6 +570,10 @@ def main():
     rows.append(bench_args(1_000 if quick else 10_000))
     rows.append(bench_returns(300 if quick else 3_000))
     rows.append(bench_get_many(1_000 if quick else 10_000))
+    rows.append(bench_pg_packing(40 if quick else 200,
+                                 128 if quick else 512))
+    rows.append(bench_autoscaler_solve(200 if quick else 2_000,
+                                       64 if quick else 256))
     rows.append(bench_object_gb(0.25 if quick else 1.0))
     rows.append(bench_broadcast(64 if quick else 256,
                                 4 if quick else 8))

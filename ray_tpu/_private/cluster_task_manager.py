@@ -59,7 +59,18 @@ class ClusterTaskManager:
         # (bounded _Hist accumulator, one call per tick).
         self._node_label = self._raylet.node_id.hex()[:12]
         self.tick_stats = {"ticks": 0, "busy_ticks": 0,
-                           "spillbacks": 0, "jnp_fallbacks": 0,
+                           "spillbacks": 0,
+                           # Spillbacks decomposed by reason — the two
+                           # placement-quality counters the cost-matrix
+                           # terms are measured against: no_capacity =
+                           # the local node could not run the task now;
+                           # locality_override = the cost-aware solve
+                           # moved a locally-runnable task to the node
+                           # holding its argument bytes / the faster
+                           # throughput class.
+                           "spillbacks_no_capacity": 0,
+                           "spillbacks_locality_override": 0,
+                           "jnp_fallbacks": 0,
                            "last_batch_classes": 0, "last_batch_tasks": 0,
                            "dispatch_errors": 0}
         # Consecutive failed dispatch handoffs per task (cleared on
@@ -187,13 +198,32 @@ class ClusterTaskManager:
                              spec.task_id)
             return False
 
+    def _spillback_reason(self, spec: TaskSpec, cost_active: bool) -> str:
+        """Classify a spillback: ``locality_override`` when a cost-aware
+        solve moved a task the LOCAL node could run right now (the
+        locality/heterogeneity terms chose a better-placed node);
+        ``no_capacity`` otherwise (the local node simply can't take
+        it).  Only HYBRID specs ride the cost-aware solve — a policy
+        (SPREAD/affinity) spill in the same batch is never an
+        override."""
+        from ray_tpu.scheduler.policy import SchedulingType
+        if not cost_active or spec.scheduling_options.scheduling_type \
+                is not SchedulingType.HYBRID:
+            return "no_capacity"
+        node = self._raylet.cluster_view.node_resources(
+            self._raylet.node_id)
+        if node is not None and node.is_available(spec.resources):
+            return "locality_override"
+        return "no_capacity"
+
     def _reply_spillback(self, spec: TaskSpec, reply: Callable,
-                         target) -> None:
+                         target, reason: str = "no_capacity") -> None:
         """Deliver a spillback reply; an exception inside the reply
         chain is counted but NOT requeued (the submitter may already
         have acted on it — task-level retries cover the remainder)."""
         try:
             self.tick_stats["spillbacks"] += 1
+            self.tick_stats[f"spillbacks_{reason}"] += 1
             reply({"retry_at": target})
         except Exception:
             self.tick_stats["dispatch_errors"] += 1
@@ -287,6 +317,27 @@ class ClusterTaskManager:
             if not progress:
                 return
 
+    def _arg_locality_bytes(self, specs) -> Dict:
+        """Per-node argument bytes for a class's queued specs — the
+        arg-locality cost signal.  Sizes and locations come from the
+        object directory (the owner registers both when a big object
+        lands in a node store); small inlined args have no directory
+        row and correctly contribute nothing — they copy anywhere for
+        free.  Called by the device solver only for classes whose specs
+        actually carry object-ref args."""
+        directory = getattr(self._raylet.cluster, "object_directory", None)
+        if directory is None or not hasattr(directory, "size_hint"):
+            return {}
+        out: Dict = {}
+        for spec in specs:
+            for oid in spec.arg_object_ids():
+                size = directory.size_hint(oid)
+                if not size:
+                    continue
+                for nid in directory.get_locations(oid):
+                    out[nid] = out.get(nid, 0) + size
+        return out
+
     def _schedule_batched(self) -> bool:
         """Solve all queues in one device call (scheduler_backend=jax).
 
@@ -301,7 +352,8 @@ class ClusterTaskManager:
         from ray_tpu.scheduler import jax_backend
         if self._jax_solver is None:
             self._jax_solver = jax_backend.DeviceRuntimeSolver(
-                node_label=self._raylet.node_id.hex()[:12])
+                node_label=self._raylet.node_id.hex()[:12],
+                locality_provider=self._arg_locality_bytes)
         view = self._raylet.cluster_view
         with self._lock:
             work: list = []
@@ -330,7 +382,15 @@ class ClusterTaskManager:
                     self._queues[spec.scheduling_class].append((spec, reply))
             return False
         local_id = self._raylet.node_id
-        for (spec, reply), target in zip(work, assignments):
+        # LOCAL grants commit first (view.subtract), remote spills after:
+        # _spillback_reason checks "could the local node still run this
+        # task" and must see THIS tick's local reservations, or a batch
+        # where cost terms are live would mislabel ordinary
+        # capacity-competition spillbacks as locality_override.
+        ordered = sorted(
+            zip(work, assignments),
+            key=lambda wa: 0 if wa[1] == local_id else 1)
+        for (spec, reply), target in ordered:
             if target is None:
                 # The device solve yields None for can't-place-THIS-TICK,
                 # which conflates busy (no availability right now) with
@@ -363,7 +423,10 @@ class ClusterTaskManager:
                 # SURVEY.md §7.4).
                 node = view.node_resources(target)
                 if node is not None and node.is_feasible(spec.resources):
-                    self._reply_spillback(spec, reply, target)
+                    self._reply_spillback(
+                        spec, reply, target,
+                        self._spillback_reason(
+                            spec, self._jax_solver.last_cost_active))
                 else:
                     with self._lock:
                         self._queues[spec.scheduling_class].append(

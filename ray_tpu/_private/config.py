@@ -40,6 +40,29 @@ class Config:
     #: Fuse the per-class waterfill into one Mosaic (Pallas) kernel on
     #: TPU; falls back to the jnp scan path automatically on failure.
     scheduler_pallas_fill: bool = True
+    #: Heterogeneity cost weight (Gavel-style effective-rate scaling):
+    #: slower nodes (per the ray_tpu.throughput / accel_throughput node
+    #: labels) cost this much extra utilization at full rate spread.
+    #: 0 disables the term; 1/16 of weight = one fill bucket.
+    scheduler_het_weight: float = 0.25
+    #: Arg-locality cost weight: a node holding ALL of a class's queued
+    #: argument bytes gets this much utilization bonus (negative cost).
+    #: 0 disables the term.
+    scheduler_locality_weight: float = 0.5
+    #: Placement-group bundle packing backend: "auto" routes through the
+    #: TPU bundle kernel when jax is importable and the cluster has at
+    #: least pg_kernel_min_nodes nodes (greedy numpy fallback below
+    #: that, and on any kernel failure), "force" always kernels,
+    #: "off" always greedy.
+    pg_kernel_backend: str = "auto"
+    pg_kernel_min_nodes: int = 32
+    #: Autoscaler demand-solve backend: "auto" routes
+    #: get_bin_pack_residual / get_nodes_for through the batched kernel
+    #: when nodes x demand-classes >= autoscaler_kernel_min_cells
+    #: (exact numpy below, and on any kernel failure), "force" / "off"
+    #: as above.
+    autoscaler_kernel_backend: str = "auto"
+    autoscaler_kernel_min_cells: int = 2048
     #: Max lease requests in flight per scheduling class
     #: (ray_config_def.h:342).
     max_pending_lease_requests_per_scheduling_category: int = 10

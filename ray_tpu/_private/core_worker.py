@@ -115,7 +115,8 @@ class CoreWorker:
             raylet = self.local_raylet
             raylet.object_store.put(object_id, data)
             self.cluster.object_directory.add_location(object_id,
-                                                       raylet.node_id)
+                                                       raylet.node_id,
+                                                       size=data.nbytes)
             return
         serialized = serialize(value)
         contained = [r.object_id() for r in serialized.contained_refs]
@@ -126,8 +127,8 @@ class CoreWorker:
         else:
             raylet = self.local_raylet
             raylet.object_store.put(object_id, serialized)
-            self.cluster.object_directory.add_location(object_id,
-                                                       raylet.node_id)
+            self.cluster.object_directory.add_location(
+                object_id, raylet.node_id, size=serialized.total_bytes)
 
     def put_return_value(self, object_id: ObjectID, value: Any, node) -> int:
         """Store a task return (small -> owner memory store 'inline reply';
@@ -136,7 +137,8 @@ class CoreWorker:
             data = DeviceObject(value)
             node.object_store.put(object_id, data)
             self.cluster.object_directory.add_location(object_id,
-                                                       node.node_id)
+                                                       node.node_id,
+                                                       size=data.nbytes)
             return data.nbytes
         serialized = serialize(value)
         contained = [r.object_id() for r in serialized.contained_refs]
@@ -157,8 +159,8 @@ class CoreWorker:
             self.memory_store.put(object_id, serialized)
         else:
             node.object_store.put(object_id, serialized)
-            self.cluster.object_directory.add_location(object_id,
-                                                       node.node_id)
+            self.cluster.object_directory.add_location(
+                object_id, node.node_id, size=serialized.total_bytes)
             self.memory_store.put(object_id, InPlasmaMarker(node.node_id))
 
     def get(self, refs: Sequence[ObjectRef],

@@ -565,7 +565,8 @@ class HeadService:
 
     def _handle_add_location(self, payload) -> bool:
         self._cluster.object_directory.add_location(
-            ObjectID(payload["object_id"]), NodeID(payload["node_id"]))
+            ObjectID(payload["object_id"]), NodeID(payload["node_id"]),
+            size=payload.get("size") or None)
         return True
 
     def _handle_remove_location(self, payload) -> bool:

@@ -340,11 +340,20 @@ class _RemoteDirectory:
     def __init__(self, host: "NodeHost"):
         self._host = host
 
-    def add_location(self, object_id: ObjectID, node_id: NodeID):
+    def add_location(self, object_id: ObjectID, node_id: NodeID,
+                     size: Optional[int] = None):
         self._host.client.call_async(
             "add_location",
-            {"object_id": object_id.binary(), "node_id": node_id.binary()},
+            {"object_id": object_id.binary(), "node_id": node_id.binary(),
+             "size": int(size or 0)},
             lambda _r, _e: None)
+
+    # NOTE no size_hint here, deliberately: spoke-side schedulers have
+    # no local size table (the head's directory, where the batched
+    # solve runs, carries the hints), and ClusterTaskManager's
+    # hasattr(directory, "size_hint") gate must short-circuit so spoke
+    # ticks don't walk every queued spec's args for guaranteed-zero
+    # locality data.
 
     def remove_location(self, object_id, node_id):
         # Must be real, not a no-op: the vanished-entry heal removes
@@ -537,7 +546,8 @@ class _RemoteCoreWorker:
             self._host.client.call(
                 "add_location",
                 {"object_id": object_id.binary(),
-                 "node_id": node.node_id.binary()},
+                 "node_id": node.node_id.binary(),
+                 "size": int(serialized.total_bytes)},
                 timeout=30.0)
 
     def recover_object(self, object_id) -> bool:

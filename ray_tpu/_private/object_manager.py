@@ -60,9 +60,16 @@ def fetch_object_into(client, object_id: ObjectID, local_store,
         try:
             # May QUEUE behind the receiver store's create-request
             # backpressure (space freed by seals/evictions/spills); a
-            # grace-deadline miss is a failed pull, not a crash.
+            # grace-deadline miss is a failed pull, not a crash.  A
+            # None writer means a concurrent pull of the same object
+            # already delivered it (single-writer dedupe): report 0
+            # bytes — THIS pull transferred nothing, and counting the
+            # object size would double-book pulled_bytes /
+            # cross_node_fetch_bytes against the racing transfer.
             writer = local_store.create_transfer_writer(object_id,
                                                         meta["size"])
+            if writer is None:
+                return 0
         except exceptions.ObjectStoreFullError as err:
             if getattr(err, "infeasible", False):
                 # The object exceeds this store's TOTAL capacity: no
@@ -93,14 +100,28 @@ class ObjectDirectory:
     def __init__(self):
         self._lock = diag_lock("ObjectDirectory._lock")
         self._locations: Dict[ObjectID, Set[NodeID]] = {}
+        # Serialized byte size per object (recorded alongside the first
+        # location): the arg-locality cost term weighs candidate nodes
+        # by the argument bytes they already hold, so sizes must flow
+        # through the directory, not just locations.
+        self._sizes: Dict[ObjectID, int] = {}
         self._subscribers: Dict[ObjectID, List[Callable]] = {}
 
-    def add_location(self, object_id: ObjectID, node_id: NodeID):
+    def add_location(self, object_id: ObjectID, node_id: NodeID,
+                     size: Optional[int] = None):
         with self._lock:
             self._locations.setdefault(object_id, set()).add(node_id)
+            if size:
+                self._sizes[object_id] = int(size)
             subs = self._subscribers.pop(object_id, [])
         for cb in subs:
             cb(node_id)
+
+    def size_hint(self, object_id: ObjectID) -> int:
+        """Serialized bytes of the object, or 0 when unknown (small
+        inlined objects never register — they cost nothing to move)."""
+        with self._lock:
+            return self._sizes.get(object_id, 0)
 
     def remove_location(self, object_id: ObjectID, node_id: NodeID):
         with self._lock:
@@ -109,10 +130,12 @@ class ObjectDirectory:
                 locs.discard(node_id)
                 if not locs:
                     del self._locations[object_id]
+                    self._sizes.pop(object_id, None)
 
     def remove_object(self, object_id: ObjectID):
         with self._lock:
             self._locations.pop(object_id, None)
+            self._sizes.pop(object_id, None)
             # A freed object can never gain a location; drop its waiters
             # (wait() wakeup hooks would otherwise accumulate forever).
             self._subscribers.pop(object_id, None)
@@ -156,6 +179,7 @@ class ObjectDirectory:
                     locs.discard(node_id)
                     if not locs:
                         del self._locations[oid]
+                        self._sizes.pop(oid, None)
                         lost.append(oid)
         return lost
 
@@ -177,6 +201,11 @@ class NodeObjectManager:
         self._pull_pool = DaemonPool(
             4, name=f"ray_tpu::pull::{raylet.node_id.hex()[:6]}")
         self.stats = {"pulled_objects": 0, "pulled_bytes": 0,
+                      # Bytes fetched from OTHER nodes to satisfy local
+                      # work — the placement-quality metric the
+                      # arg-locality cost term is measured against
+                      # (locality-aware placement should shrink it).
+                      "cross_node_fetch_bytes": 0,
                       "chunks_transferred": 0, "failed_pulls": 0,
                       "transfer_gbps_last": 0.0,
                       "inflight_window_peak": 0}
@@ -354,21 +383,29 @@ class NodeObjectManager:
             transfer_span.meta["ok"] = False
             transfer_span.__exit__(None, None, None)
             return self._retry_other_location(object_id, tried)
-        self._directory.add_location(object_id, self._raylet.node_id)
         self.stats["pulled_objects"] += 1
-        self.stats["pulled_bytes"] += nbytes
-        elapsed = max(time.monotonic() - t0, 1e-9)
-        self.stats["transfer_gbps_last"] = round(
-            nbytes / elapsed / 1e9, 3)
+        # The object is local either way — the location row is true
+        # even when a racing transfer moved the bytes.
+        self._directory.add_location(object_id, self._raylet.node_id,
+                                     size=nbytes or None)
+        if nbytes:
+            # nbytes == 0 = the single-writer dedupe adopted a racing
+            # transfer's copy: THIS pull moved no bytes — byte counters
+            # and the transfer rate must not be booked for it.
+            self.stats["pulled_bytes"] += nbytes
+            self.stats["cross_node_fetch_bytes"] += nbytes
+            elapsed = max(time.monotonic() - t0, 1e-9)
+            self.stats["transfer_gbps_last"] = round(
+                nbytes / elapsed / 1e9, 3)
+            from ray_tpu._private.metrics_agent import (observe_internal,
+                                                        record_internal)
+            record_internal("ray_tpu.object_manager.transfer_gbps",
+                            nbytes / elapsed / 1e9,
+                            node=self._raylet.node_id.hex()[:12])
+            observe_internal("ray_tpu.object_manager.transfer_seconds",
+                             elapsed)
         self.stats["inflight_window_peak"] = max(
             self.stats["inflight_window_peak"], window_peak[0])
-        from ray_tpu._private.metrics_agent import (observe_internal,
-                                                    record_internal)
-        record_internal("ray_tpu.object_manager.transfer_gbps",
-                        nbytes / elapsed / 1e9,
-                        node=self._raylet.node_id.hex()[:12])
-        observe_internal("ray_tpu.object_manager.transfer_seconds",
-                         elapsed)
         transfer_span.meta["bytes"] = nbytes
         transfer_span.__exit__(None, None, None)
         return True
@@ -415,6 +452,8 @@ class NodeObjectManager:
         nbytes = view.nbytes
         store = self._raylet.object_store
         writer = store.create_transfer_writer(object_id, nbytes)
+        if writer is None:
+            return 0             # a concurrent pull already delivered it
         try:
             chunk = get_config().object_manager_chunk_size
             for off in range(0, nbytes, chunk):

@@ -230,6 +230,13 @@ class NodeObjectStore:
         # the chunks land so concurrent pulls cannot over-commit the
         # budget; moved into _used at seal, dropped at abort).
         self._transfer_reserved = 0
+        # Objects with an in-flight transfer writer: the source-level
+        # fix for the double-writer native-delete race — concurrent
+        # pulls of one object (raylet pull path + node-host executor
+        # fetch, or two peers racing) are deduped HERE so at most one
+        # transfer writer ever exists per (object, store); later
+        # callers wait for the winner and adopt its sealed copy.
+        self._active_transfers: set = set()
         self._native = native_backend  # ray_tpu.native shm store, optional
         # Create-request queue state (create_request_queue.h parity):
         # over-capacity reservations wait on the store condition and are
@@ -486,25 +493,61 @@ class NodeObjectStore:
         ObjectStoreFullError when even spilling cannot make room) and
         the bytes stay charged to ``_transfer_reserved`` until
         seal/abort, so N concurrent pulls cannot collectively
-        over-commit what a single put could not."""
+        over-commit what a single put could not.
+
+        Single-writer guarantee: if another transfer writer for this
+        object is already in flight, this call BLOCKS until it
+        seals/aborts, then returns None when the object landed (the
+        caller's pull goal is met without streaming a duplicate copy —
+        and, crucially, without a second writer whose abort/seal could
+        free the winner's native block underneath its sealed entry).
+        """
         with self._lock:
-            self._ensure_capacity(nbytes)
-            self._transfer_reserved += nbytes
+            while object_id in self._active_transfers:
+                self._lock.wait(0.5)
+            e = self._entries.get(object_id)
+            if e is not None and e.sealed:
+                return None          # the racing transfer delivered it
+            # Claim BEFORE the capacity wait: _ensure_capacity can
+            # release the lock (create-queue backpressure), and an
+            # unclaimed window there would admit a second writer —
+            # the very race this claim exists to close.
+            self._active_transfers.add(object_id)
             r = None
-            if self._native is not None:
+            try:
+                self._ensure_capacity(nbytes)
+                e = self._entries.get(object_id)
+                if e is not None and e.sealed:
+                    # A plain put landed the object while we waited
+                    # for capacity: adopt it.
+                    self._active_transfers.discard(object_id)
+                    self._lock.notify_all()
+                    return None
+                self._transfer_reserved += nbytes
                 try:
-                    r = self._reserve_native_locked(object_id, nbytes)
+                    if self._native is not None:
+                        r = self._reserve_native_locked(object_id,
+                                                        nbytes)
                 except BaseException:
                     self._transfer_reserved -= nbytes
                     raise
+            except BaseException:
+                self._active_transfers.discard(object_id)
+                self._lock.notify_all()
+                raise
         if r is not None and r[1] != _ADOPT:
             return _SegmentTransferWriter(self, object_id, nbytes,
                                           r[1], pin)
         return _HeapTransferWriter(self, object_id, nbytes, pin)
 
-    def _release_transfer_reservation(self, nbytes: int) -> None:
+    def _release_transfer_reservation(self, nbytes: int,
+                                      object_id: Optional[ObjectID] = None
+                                      ) -> None:
         with self._lock:
             self._transfer_reserved -= nbytes
+            if object_id is not None:
+                self._active_transfers.discard(object_id)
+                self._lock.notify_all()
 
     def register_native_entry(self, object_id: ObjectID, size: int):
         """Adopt an object a CLIENT created+sealed directly in the
@@ -1071,20 +1114,32 @@ class _SegmentTransferWriter:
         from ray_tpu._private.serialization import copy_into_view
         copy_into_view(self._view, offset, data)
 
-    def _release(self) -> None:
-        if self._reserved:
-            self._reserved = False
-            self._store._release_transfer_reservation(self.nbytes)
-
     def seal(self) -> None:
         store = self._store
         key = self._object_id.binary()
         self._view = None
-        store._native.seal(key)
+        try:
+            store._native.seal(key)
+        except BaseException:
+            # A failed native seal must still release the reservation
+            # AND the single-writer claim (a leaked claim hangs every
+            # future pull of this object forever) and drop the block.
+            with store._lock:
+                if self._reserved:
+                    self._reserved = False
+                    store._transfer_reserved -= self.nbytes
+                try:
+                    store._native.delete(key)
+                except Exception:
+                    pass
+                store._active_transfers.discard(self._object_id)
+                store._lock.notify_all()
+            raise
         with store._lock:
             if self._reserved:
                 self._reserved = False
                 store._transfer_reserved -= self.nbytes
+            store._active_transfers.discard(self._object_id)
             existing = store._entries.get(self._object_id)
             if existing is not None:
                 # Lost a materialization race; keep the winner unless it
@@ -1092,6 +1147,7 @@ class _SegmentTransferWriter:
                 if not (isinstance(existing.data, _NativeHandle)
                         and existing.data.key == key):
                     store._native.delete(key)
+                store._lock.notify_all()
                 return
             e = _Entry(data=_NativeHandle(store._native, key, self.nbytes),
                        size=self.nbytes)
@@ -1101,12 +1157,29 @@ class _SegmentTransferWriter:
             store._lock.notify_all()
 
     def abort(self) -> None:
+        store = self._store
         self._view = None
-        self._release()
-        try:
-            self._store._native.delete(self._object_id.binary())
-        except Exception:
-            pass
+        # ONE lock acquisition for reservation release, native delete
+        # AND the single-writer claim release: dropping the claim first
+        # would wake a waiting successor whose freshly-reserved block
+        # (same key) this delete would then free underneath it.
+        with store._lock:
+            if self._reserved:
+                self._reserved = False
+                store._transfer_reserved -= self.nbytes
+            try:
+                # Never free the native key underneath a SEALED entry
+                # another path registered (put / racing seal): that is
+                # exactly the lost-free race behind vanished_objects.
+                existing = store._entries.get(self._object_id)
+                if not (existing is not None and existing.sealed and
+                        isinstance(existing.data, _NativeHandle) and
+                        existing.data.key == self._object_id.binary()):
+                    store._native.delete(self._object_id.binary())
+            except Exception:
+                pass
+            store._active_transfers.discard(self._object_id)
+            store._lock.notify_all()
 
 
 class _HeapTransferWriter:
@@ -1132,13 +1205,33 @@ class _HeapTransferWriter:
     def _release(self) -> None:
         if self._reserved:
             self._reserved = False
-            self._store._release_transfer_reservation(self.nbytes)
+            self._store._release_transfer_reservation(self.nbytes,
+                                                      self._object_id)
 
     def seal(self) -> None:
-        restored = SerializedObject.from_bytes(bytes(self._buf))
-        self._buf = None
-        self._release()         # put() re-charges _used itself
-        self._store.put(self._object_id, restored, pin=self._pin)
+        store = self._store
+        try:
+            # from_bytes INSIDE the try: a corrupt payload must not
+            # leak the reservation or the single-writer claim (a
+            # leaked claim hangs every future pull of this object).
+            restored = SerializedObject.from_bytes(bytes(self._buf))
+            self._buf = None
+            if self._reserved:
+                self._reserved = False
+                # put() re-charges _used itself; the single-writer
+                # claim is held until the entry is registered so a
+                # waiting duplicate pull adopts it instead of starting
+                # a second transfer.
+                store._release_transfer_reservation(self.nbytes)
+            store.put(self._object_id, restored, pin=self._pin)
+        finally:
+            self._buf = None
+            with store._lock:
+                if self._reserved:
+                    self._reserved = False
+                    store._transfer_reserved -= self.nbytes
+                store._active_transfers.discard(self._object_id)
+                store._lock.notify_all()
 
     def abort(self) -> None:
         self._buf = None

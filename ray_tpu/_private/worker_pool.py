@@ -398,7 +398,7 @@ class WorkerHostService:
         size = int(payload["size"])
         store.register_native_entry(oid, size)
         self._node.cluster.object_directory.add_location(
-            oid, self._node.node_id)
+            oid, self._node.node_id, size=size)
         core = self._node.core_worker
         if core is not None:
             core.memory_store.put(oid, InPlasmaMarker(self._node.node_id))

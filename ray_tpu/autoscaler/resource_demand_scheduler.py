@@ -13,21 +13,51 @@ TPU-first twist: instead of dict-of-dict first-fit loops, the packer is
 columnar — demands dedup into (class, count) runs over a shared resource
 vocabulary and each class is waterfilled against an [N, R] availability
 matrix, the *same* math as ``ray_tpu.scheduler.jax_backend``'s device
-solve. ``get_bin_pack_residual`` is the exact numpy path;
-``pack_with_jax_kernel`` is the batched one-kernel-call alternative for
-very large sweeps (callers opt in; its packing order follows the
-kernel's utilization scoring rather than strict first-fit-decreasing).
+solve.  ``get_bin_pack_residual`` and ``get_nodes_for`` ROUTE through
+that kernel (pack mode: inverted-utilization ordering, zero per-class
+shifts — most-utilized-feasible first, first-fit within a bucket) when
+the problem is big enough for the device dispatch to pay
+(``autoscaler_kernel_backend`` / ``autoscaler_kernel_min_cells``); the
+numpy first-fit-decreasing below stays as the exact small-problem path
+and the fallback on any kernel failure.  ``get_nodes_for`` batches each
+candidate node type as a hypothetical fleet of ``max_to_add`` identical
+nodes and solves ALL residual demand classes against it in one call —
+the per-node python loop only survives on the numpy path.
 """
 
 from __future__ import annotations
 
 import copy
+import importlib.util
+import logging
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 ResourceDict = Dict[str, float]
 NodeType = str
+
+logger = logging.getLogger(__name__)
+
+_JAX_OK = importlib.util.find_spec("jax") is not None
+
+# Kernel-vs-numpy routing telemetry (folded into the autoscaler_solve
+# bench row).
+kernel_stats = {"kernel_solves": 0, "kernel_errors": 0, "numpy_solves": 0}
+
+
+def _kernel_enabled(num_nodes: int, num_demands: int) -> bool:
+    from ray_tpu._private.config import get_config
+    cfg = get_config()
+    mode = cfg.autoscaler_kernel_backend
+    if mode == "off" or not _JAX_OK:
+        return False
+    if mode == "force":
+        return True
+    # A near-single-node pack is trivial host-side — the device
+    # dispatch can never pay for it, however long the demand list.
+    return num_nodes >= 8 and \
+        num_nodes * num_demands >= cfg.autoscaler_kernel_min_cells
 
 
 def _vocab(node_resources: List[ResourceDict],
@@ -70,17 +100,83 @@ def _group_sorted(demands: List[ResourceDict]):
     return runs
 
 
+def _pack_mode_matrices(node_resources: List[ResourceDict],
+                        resource_demands: List[ResourceDict]):
+    """Shared host-side prep for the pack-mode kernel solve."""
+    names = _vocab(node_resources, resource_demands)
+    runs = _group_sorted(resource_demands)
+    demand = _to_matrix([d for d, _ in runs], names).astype(np.float32)
+    counts = np.array([c for _, c in runs], dtype=np.float32)
+    avail = _to_matrix(node_resources, names).astype(np.float32)
+    return names, runs, demand, counts, avail
+
+
+def _pack_mode_solve(runs, demand, counts, avail):
+    """THE pack-mode kernel call (inverted utilization + zero shifts)
+    — one implementation behind pack_with_jax_kernel AND the routed
+    residual path.  Returns (unfulfilled, alloc[C, N])."""
+    from ray_tpu.scheduler.jax_backend import BatchSolver
+    alloc = BatchSolver().solve_matrices(
+        avail, avail, demand, counts, spread_threshold=0.0,
+        invert_util=True, zero_shifts=True)
+    kernel_stats["kernel_solves"] += 1
+    unfulfilled: List[ResourceDict] = []
+    for i, (d, c) in enumerate(runs):
+        short = int(c) - int(alloc[i].sum())
+        if short > 0:
+            unfulfilled.extend([dict(d)] * short)
+    return unfulfilled, alloc
+
+
+def _kernel_bin_pack(node_resources: List[ResourceDict],
+                     resource_demands: List[ResourceDict],
+                     ) -> Tuple[List[ResourceDict], List[ResourceDict], int]:
+    """One-device-call bin-pack deriving the residual contract on top
+    of :func:`_pack_mode_solve`: (unfulfilled, nodes_after,
+    nodes_used)."""
+    names, runs, demand, counts, avail = _pack_mode_matrices(
+        node_resources, resource_demands)
+    unfulfilled, alloc = _pack_mode_solve(runs, demand, counts, avail)
+    after = np.maximum(
+        avail.astype(np.float64) -
+        alloc.T.astype(np.float64) @ demand.astype(np.float64), 0.0)
+    idx = {n: i for i, n in enumerate(names)}
+    nodes_after = [{k: float(after[r, idx[k]]) for k in orig}
+                   for r, orig in enumerate(node_resources)]
+    nodes_used = int((alloc.sum(axis=0) > 0).sum())
+    return unfulfilled, nodes_after, nodes_used
+
+
 def get_bin_pack_residual(node_resources: List[ResourceDict],
                           resource_demands: List[ResourceDict],
                           strict_spread: bool = False,
+                          _use_kernel: Optional[bool] = None,
                           ) -> Tuple[List[ResourceDict], List[ResourceDict]]:
     """Columnar first-fit-decreasing. Returns (unfulfilled, nodes_after).
 
     Semantics match reference ``get_bin_pack_residual`` (:895): demands
     sorted complex/heavy-first; ``strict_spread`` forbids node reuse.
+    Big non-strict problems route through the batched TPU kernel
+    (``_kernel_bin_pack``); numpy is the exact small-problem path and
+    the fallback on any kernel failure (``_use_kernel=False`` pins the
+    numpy path — get_nodes_for's own fallback loop uses it so a
+    just-failed kernel is not re-entered per inner call).
     """
     if not resource_demands:
         return [], copy.deepcopy(node_resources)
+    use_kernel = _kernel_enabled(len(node_resources),
+                                 len(resource_demands)) \
+        if _use_kernel is None else _use_kernel
+    if not strict_spread and use_kernel:
+        try:
+            unfulfilled, nodes_after, _ = _kernel_bin_pack(
+                node_resources, resource_demands)
+            return unfulfilled, nodes_after
+        except Exception:
+            kernel_stats["kernel_errors"] += 1
+            logger.exception("autoscaler bin-pack kernel failed; "
+                             "numpy fallback")
+    kernel_stats["numpy_solves"] += 1
     names = _vocab(node_resources, resource_demands)
     avail = _to_matrix(node_resources, names)
     used = np.zeros(len(node_resources), dtype=bool)
@@ -124,6 +220,67 @@ def get_bin_pack_residual(node_resources: List[ResourceDict],
     return unfulfilled, nodes_after
 
 
+def _kernel_get_nodes_for(node_types: Dict[NodeType, dict],
+                          existing_nodes: Dict[NodeType, int],
+                          max_to_add: int,
+                          resources: List[ResourceDict],
+                          strict_spread: bool = False,
+                          ) -> Tuple[Dict[NodeType, int],
+                                     List[ResourceDict]]:
+    """Batched node-count solve: each candidate type is a hypothetical
+    fleet of ``headroom`` identical nodes and ALL residual demand
+    classes solve against it in ONE kernel call (pack mode, so the
+    solve uses as few fleet nodes as the fill allows); the used-node
+    count IS the launch count for the winning type.  Replaces the
+    numpy path's one-node-per-iteration python loop."""
+    nodes_to_add: Dict[NodeType, int] = {}
+    allocated = dict(existing_nodes)
+    residual = list(resources)
+    while residual and sum(nodes_to_add.values()) < max_to_add:
+        budget = max_to_add - sum(nodes_to_add.values())
+        best = None  # ((num_fit, -node_size), type, used, new_residual)
+        for node_type, spec in node_types.items():
+            limit = spec.get("max_workers", 2 ** 30)
+            headroom = min(budget, limit - allocated.get(node_type, 0))
+            if headroom <= 0:
+                continue
+            node_res = spec.get("resources", {})
+            if not node_res:
+                continue
+            if strict_spread:
+                # Each demand gets its own fresh node: a per-demand fit
+                # check is exact (no packing interaction).  Place up to
+                # ``headroom`` fitting demands, keep the rest.
+                unfulfilled = []
+                used = 0
+                for d in residual:
+                    if used < headroom and all(
+                            node_res.get(k, 0) >= v
+                            for k, v in d.items()):
+                        used += 1
+                    else:
+                        unfulfilled.append(d)
+            else:
+                unfulfilled, _, used = _kernel_bin_pack(
+                    [dict(node_res)] * headroom, residual)
+            num_fit = len(residual) - len(unfulfilled)
+            if num_fit <= 0:
+                continue
+            # Most demands fitted first, then FEWEST nodes launched,
+            # then the smaller node type (less waste) — mirrors the
+            # numpy path's one-node-at-a-time preference for the type
+            # that fits the most demands per node.
+            score = (num_fit, -max(used, 1), -sum(node_res.values()))
+            if best is None or score > best[0]:
+                best = (score, node_type, max(used, 1), unfulfilled)
+        if best is None:
+            break
+        _, node_type, used, residual = best
+        nodes_to_add[node_type] = nodes_to_add.get(node_type, 0) + used
+        allocated[node_type] = allocated.get(node_type, 0) + used
+    return nodes_to_add, residual
+
+
 def get_nodes_for(node_types: Dict[NodeType, dict],
                   existing_nodes: Dict[NodeType, int],
                   max_to_add: int,
@@ -133,7 +290,18 @@ def get_nodes_for(node_types: Dict[NodeType, dict],
     """Pick node types to satisfy ``resources`` (reference ``get_nodes_for``,
     :812): greedily add the node type whose resources satisfy the largest
     number of demands (utilization-scored), respecting per-type
-    ``max_workers`` and the global ``max_to_add``."""
+    ``max_workers`` and the global ``max_to_add``.  Big problems route
+    through the batched kernel variant; numpy below is the exact
+    small-problem path and the fallback on any kernel failure."""
+    if _kernel_enabled(max_to_add, len(resources)):
+        try:
+            return _kernel_get_nodes_for(node_types, existing_nodes,
+                                         max_to_add, resources,
+                                         strict_spread)
+        except Exception:
+            kernel_stats["kernel_errors"] += 1
+            logger.exception("autoscaler get_nodes_for kernel failed; "
+                             "numpy fallback")
     nodes_to_add: Dict[NodeType, int] = {}
     allocated = dict(existing_nodes)
     residual = list(resources)
@@ -146,8 +314,11 @@ def get_nodes_for(node_types: Dict[NodeType, dict],
             node_res = spec.get("resources", {})
             if not node_res:
                 continue
+            # Single-node pack: always the numpy path — never re-enter
+            # a kernel this loop may be the fallback FOR.
             fulfilled, _ = get_bin_pack_residual(
-                [dict(node_res)], residual, strict_spread=strict_spread)
+                [dict(node_res)], residual, strict_spread=strict_spread,
+                _use_kernel=False)
             num_fit = len(residual) - len(fulfilled)
             if num_fit <= 0:
                 continue
@@ -318,19 +489,10 @@ def pack_with_jax_kernel(node_resources: List[ResourceDict],
                          resource_demands: List[ResourceDict]):
     """Batched variant: dedup demands into classes and solve all classes
     against all nodes in ONE TPU kernel call
-    (``jax_backend.BatchSolver.solve_matrices``). Used for very large
-    autoscaler sweeps; returns (unfulfilled, alloc[C, N])."""
-    from ray_tpu.scheduler.jax_backend import BatchSolver
-    names = _vocab(node_resources, resource_demands)
-    runs = _group_sorted(resource_demands)
-    demand = _to_matrix([d for d, _ in runs], names).astype(np.float32)
-    counts = np.array([c for _, c in runs], dtype=np.float32)
-    avail = _to_matrix(node_resources, names).astype(np.float32)
-    alloc = BatchSolver().solve_matrices(
-        avail, avail, demand, counts, spread_threshold=1.0)
-    unfulfilled: List[ResourceDict] = []
-    for i, (d, c) in enumerate(runs):
-        short = c - int(alloc[i].sum())
-        if short > 0:
-            unfulfilled.extend([dict(d)] * short)
-    return unfulfilled, alloc
+    (``jax_backend.BatchSolver.solve_matrices`` in pack mode — the same
+    solve ``get_bin_pack_residual`` now routes through by default).
+    Kept for callers that want the raw alloc[C, N]; returns
+    (unfulfilled, alloc)."""
+    _, runs, demand, counts, avail = _pack_mode_matrices(
+        node_resources, resource_demands)
+    return _pack_mode_solve(runs, demand, counts, avail)

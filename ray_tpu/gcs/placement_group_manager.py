@@ -9,10 +9,14 @@ node_manager.proto:319-330) and ``gcs_resource_scheduler.{h,cc}``
 gcs_resource_scheduler.h:29-40,74,108).
 
 The bundle->node solve is delegated to
-:func:`ray_tpu.scheduler.bundle_packing.pack_bundles`, which has a numpy
-reference implementation and the batched TPU kernel behind the same
-signature (the north-star reuse: one kernel serves raylet tick, PG packing,
-autoscaler bin-pack — SURVEY.md §3.4).
+:func:`ray_tpu.scheduler.bundle_packing.pack_bundles`, which routes
+through the TPU bundle kernel (``jax_backend._jit_pack_bundles`` —
+PACK/SPREAD as used-node cost terms, STRICT_SPREAD as a used-node mask,
+STRICT_PACK as one composite row; ONE device call per group) on big
+clusters and keeps the numpy greedy as the small-cluster/CPU fallback
+and validation oracle (the north-star reuse: one kernel serves raylet
+tick, PG packing, autoscaler bin-pack — SURVEY.md §3.4).  This manager
+exports the kernel-vs-greedy routing counters at /metrics.
 """
 
 from __future__ import annotations
@@ -79,6 +83,15 @@ class GcsPlacementGroupManager:
         self._ready_callbacks: Dict[PlacementGroupID, list] = {}
         # Retry cadence for pending PGs (SchedulePendingPlacementGroups).
         gcs.loop.schedule_every(0.05, self._schedule_pending, "pg.tick")
+        # Kernel-vs-greedy routing telemetry for the bundle solve.
+        from ray_tpu._private.metrics_agent import (get_metrics_registry,
+                                                    record_internal)
+        from ray_tpu.scheduler import bundle_packing as _bp
+
+        def _collect(_mgr):
+            for k, v in _bp.kernel_stats.items():
+                record_internal(f"ray_tpu.pg.bundle_packing.{k}", v)
+        get_metrics_registry().register_collector(self, _collect)
 
     # ---- API ------------------------------------------------------------
     def create_placement_group(self, pg: GcsPlacementGroup, ready_cb=None):

@@ -5,20 +5,50 @@ Parity: reference ``src/ray/gcs/gcs_server/gcs_resource_scheduler.{h,cc}``
 STRICT_SPREAD, gcs_resource_scheduler.h:29-40,108; best-fit via
 ``LeastResourceScorer`` :74 — after-allocation leftover minimized).
 
-This is the shared solve surface: the numpy implementation below is the
-oracle, and ``ray_tpu.scheduler.jax_backend`` exposes the same contract for
-batched solves on TPU (SURVEY.md §3.4: one kernel signature serves the
-raylet tick, GCS PG packing, and the autoscaler bin-pack).
+This is the shared solve surface: ``pack_bundles`` routes through the
+TPU bundle kernel (``jax_backend._jit_pack_bundles`` — strategy
+semantics as cost terms/masks in ONE device scan per group) whenever
+the cluster is big enough for the dispatch to pay for itself
+(``pg_kernel_backend``/``pg_kernel_min_nodes``), and keeps the numpy
+greedy below as the small-cluster/CPU fallback AND the validation
+oracle: kernel output is re-validated against the exact quantized
+vectors host-side, and any failure — kernel error, invalid assignment,
+kernel-infeasible — falls back to the greedy solve, so the two paths
+can never silently diverge on feasibility (SURVEY.md §3.4: one kernel
+signature serves the raylet tick, GCS PG packing, and the autoscaler
+bin-pack).
 """
 
 from __future__ import annotations
 
+import importlib.util
+import logging
 from typing import Dict, List, Optional, Sequence, Set
 
 import numpy as np
 
+from ray_tpu._private.config import get_config
 from ray_tpu.scheduler.resources import (
     ClusterResourceView, NodeResources, ResourceRequest)
+
+logger = logging.getLogger(__name__)
+
+_JAX_OK = importlib.util.find_spec("jax") is not None
+
+# Solve-surface telemetry (exported by GcsPlacementGroupManager's
+# collector): how often PG packing rode the kernel vs fell back.
+kernel_stats = {"kernel_placements": 0, "kernel_misses": 0,
+                "kernel_errors": 0, "greedy_placements": 0}
+
+
+def _kernel_enabled(num_nodes: int) -> bool:
+    cfg = get_config()
+    mode = cfg.pg_kernel_backend
+    if mode == "off" or not _JAX_OK:
+        return False
+    if mode == "force":
+        return True
+    return num_nodes >= cfg.pg_kernel_min_nodes
 
 
 def _least_resource_score(avail: Dict[str, int], demand: Dict[str, int]) -> float:
@@ -43,7 +73,130 @@ def pack_bundles(view: ClusterResourceView,
     All-or-nothing: placement is simulated on a copy of the availability
     maps so a partial fit never leaks into the live view (the actual
     reservation happens via the 2PC prepare/commit against raylets).
+
+    Routing: the TPU bundle kernel solves the group in one device call
+    when enabled (``_kernel_enabled``); its output is validated against
+    the exact quantized vectors, and a miss/error of any kind falls
+    through to the numpy greedy solve below — the kernel can only ADD
+    placements, never lose one the greedy would have found.
     """
+    if _kernel_enabled(view.num_nodes()):
+        try:
+            assignment = pack_bundles_kernel(view, bundles, strategy,
+                                             exclude_nodes)
+        except Exception:
+            kernel_stats["kernel_errors"] += 1
+            logger.exception("PG bundle kernel failed; greedy fallback")
+            assignment = None
+        if assignment is not None:
+            kernel_stats["kernel_placements"] += 1
+            return assignment
+        kernel_stats["kernel_misses"] += 1
+    result = _pack_bundles_greedy(view, bundles, strategy, exclude_nodes)
+    if result is not None:
+        kernel_stats["greedy_placements"] += 1
+    return result
+
+
+def validate_assignment(view: ClusterResourceView,
+                        bundles: Sequence[ResourceRequest],
+                        assignment: List, strategy: str,
+                        exclude_nodes: Set) -> bool:
+    """Exact host-side check of a proposed bundle->node assignment
+    against the quantized per-node vectors (the raylet-authoritative
+    validation the task tick applies to kernel output): sequential
+    feasibility, exclusion, and the hard strategy constraints."""
+    sim: Dict = {}
+    if strategy == "STRICT_PACK" and len(set(assignment)) > 1:
+        return False
+    if strategy == "STRICT_SPREAD" and \
+            len(set(assignment)) != len(assignment):
+        return False
+    for nid, bundle in zip(assignment, bundles):
+        if nid in exclude_nodes:
+            return False
+        if nid not in sim:
+            res = view.node_resources(nid)
+            if res is None:
+                return False
+            sim[nid] = dict(res.available)
+        have = sim[nid]
+        for k, v in bundle.quantized().items():
+            if have.get(k, 0) < v:
+                return False
+            have[k] = have[k] - v
+    return True
+
+
+def pack_bundles_kernel(view: ClusterResourceView,
+                        bundles: Sequence[ResourceRequest],
+                        strategy: str,
+                        exclude_nodes: Optional[Set] = None
+                        ) -> Optional[List]:
+    """One-device-call bundle->node solve (``_jit_pack_bundles``).
+
+    Host side does exactly what the greedy does around its loop: sort
+    large bundles first (FFD), collapse STRICT_PACK into one composite
+    row, then validate the kernel's assignment against the exact
+    quantized vectors.  Returns None (caller falls back to greedy) on
+    any miss."""
+    from ray_tpu.scheduler.jax_backend import BatchSolver
+    exclude_nodes = exclude_nodes or set()
+    if not bundles or any(not b.quantized() for b in bundles):
+        return None                  # empty bundles: greedy's edge case
+    if view.num_nodes() == 0:
+        return None
+    if strategy == "STRICT_PACK":
+        combined: Dict[str, float] = {}
+        for b in bundles:
+            for k, v in b.to_dict().items():
+                combined[k] = combined.get(k, 0.0) + v
+        reqs = [ResourceRequest(combined)]
+        order = [0]
+    else:
+        order = sorted(range(len(bundles)),
+                       key=lambda i: -sum(bundles[i].quantized().values()))
+        reqs = [bundles[i] for i in order]
+    # demand_matrix first (it may create columns), ONE snapshot after.
+    demand = view.demand_matrix(reqs)
+    node_ids, total, avail, columns = view.snapshot()
+    if not node_ids:
+        return None
+    if demand.shape[1] < total.shape[1]:
+        demand = np.pad(demand,
+                        ((0, 0), (0, total.shape[1] - demand.shape[1])))
+    excluded = np.array([nid in exclude_nodes for nid in node_ids],
+                        dtype=bool)
+    idx, ok = BatchSolver().solve_bundles(avail, total, demand, strategy,
+                                          excluded)
+    if not ok.all():
+        return None
+    if strategy == "STRICT_PACK":
+        node = node_ids[int(idx[0])] if 0 <= int(idx[0]) < len(node_ids) \
+            else None
+        if node is None:
+            return None
+        assignment: List = [node] * len(bundles)
+    else:
+        assignment = [None] * len(bundles)
+        for j, i in enumerate(order):
+            n = int(idx[j])
+            if not 0 <= n < len(node_ids):
+                return None
+            assignment[i] = node_ids[n]
+    if not validate_assignment(view, bundles, assignment, strategy,
+                               exclude_nodes):
+        return None
+    return assignment
+
+
+def _pack_bundles_greedy(view: ClusterResourceView,
+                         bundles: Sequence[ResourceRequest],
+                         strategy: str,
+                         exclude_nodes: Optional[Set] = None
+                         ) -> Optional[List]:
+    """Reference-parity numpy greedy (LeastResourceScorer best-fit) —
+    the small-cluster fallback and the kernel's validation oracle."""
     node_ids = view.node_ids()
     exclude_nodes = exclude_nodes or set()
     node_ids = [n for n in node_ids if n not in exclude_nodes]

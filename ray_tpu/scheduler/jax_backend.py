@@ -77,13 +77,38 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ray_tpu._private.config import get_config
-from ray_tpu.scheduler.resources import ACCELERATOR_COLUMNS
+from ray_tpu.scheduler.resources import accelerator_node_mask
 
 _BIG = 1e9
-_NUM_BUCKETS = 19
 _UTIL_LEVELS = 16
+# Cost pre-buckets BELOW the utilization mapping: the per-(class, node)
+# cost term moves a node by floor(cost * scale + 1/2) buckets, and a
+# negative (preferred) cost needs somewhere to land even when the whole
+# fleet sits in the flat below-threshold bucket — without these the
+# cost would saturate against bucket 0 and locality/heterogeneity
+# preferences would be invisible on an idle cluster.
+_COST_BUCKETS = 16
+# 16 pre-buckets + [flat below-threshold, 16 util levels, accel-avoid,
+# empty] = 35.
+_NUM_BUCKETS = _COST_BUCKETS + _UTIL_LEVELS + 3
 _GROUP = 128  # node-axis block for the two-level prefix (lane width)
 _ROT_STRIDE = 977  # per-class rotation stride (prime, coprime with N_pad)
+
+# Node labels feeding the heterogeneity cost term (Gavel-style
+# effective-rate scaling, PAPERS.md 2008.09213): a float throughput
+# multiplier per node, with an optional accelerator-class override so
+# the rate matrix is genuinely per-class x per-node.  Unlabeled nodes
+# rate 1.0; all-equal rates produce a zero cost term (no behavior
+# change).
+NODE_THROUGHPUT_LABEL = "ray_tpu.throughput"
+NODE_ACCEL_THROUGHPUT_LABEL = "ray_tpu.accel_throughput"
+
+
+def _label_rate(labels: Dict, key: str, default: float = 1.0) -> float:
+    try:
+        return max(float(labels.get(key, default)), 1e-3)
+    except (TypeError, ValueError):
+        return default
 
 
 def _pad_to(x: np.ndarray, shape: Tuple[int, ...]) -> np.ndarray:
@@ -99,8 +124,8 @@ def _round_up(n: int, m: int) -> int:
 # Shared per-class fill (device).
 # ---------------------------------------------------------------------------
 
-def _bucket_fill_step(av, total, d, cnt, is_accel, shift, accel_node, empty,
-                      spread_threshold):
+def _bucket_fill_step(av, total, d, cnt, is_accel, shift, cost_row, invert,
+                      accel_node, empty, spread_threshold):
     """One class's water-fill against the running availability.
 
     Layout is TPU-native: av/total are [R, N] (resources on the 8-wide
@@ -108,6 +133,18 @@ def _bucket_fill_step(av, total, d, cnt, is_accel, shift, accel_node, empty,
     multiple of 128 so every op is tile-aligned) and bucket tensors are
     [B, N] for the same reason.  ``shift`` rotates the within-bucket fill
     order (see module docstring).  Returns (new_av[R,N], take[N]).
+
+    Cost-matrix extension (the unified-scheduler surface): ``cost_row``
+    [N] is this class's per-node cost added to the utilization score
+    BEFORE bucketization — negative cost pulls a node into an earlier
+    fill bucket.  In utilization units: 1/16 per bucket.  Carries the
+    heterogeneity term (Gavel-style effective-rate: slower nodes cost
+    more), the arg-locality bonus (nodes holding a class's argument
+    bytes cost less) and the PG-PACK used-node bonus.  ``invert`` flips
+    the utilization ordering (score := 1 - util): most-utilized
+    feasible nodes fill first — bin-packing/PACK mode for the
+    autoscaler's node-count solve (with zero per-class shifts the
+    within-bucket order is plain node id, i.e. first-fit).
 
     All f32; prefix sums stay exact for integer capacities while the
     running prefix is < 2^24, beyond which the prefix already dwarfs any
@@ -132,14 +169,23 @@ def _bucket_fill_step(av, total, d, cnt, is_accel, shift, accel_node, empty,
         jnp.where(demanded[:, None], util, -_BIG), axis=0)
     score_overall = jnp.max(util, axis=0)
     score = jnp.where(any_demand, score_demanded, score_overall)  # [N]
-    # Bucketize: below threshold -> 0; else utilization quantized.
+    score = jnp.where(invert > 0, 1.0 - score, score)
+    # Bucketize: below threshold -> flat pack zone; else utilization
+    # quantized — then offset by the cost term in BUCKET units (with
+    # 16 pre-buckets below the pack zone so preferences resolve even
+    # when the whole fleet ties at bucket 0).  cost == 0 shifts
+    # uniformly by _COST_BUCKETS: identical fill order to the cost-free
+    # kernel.
     scale = _UTIL_LEVELS / jnp.maximum(1.0 - spread_threshold, eps)
     lvl = jnp.clip(
         jnp.floor((score - spread_threshold) * scale) + 1.0,
         1.0, float(_UTIL_LEVELS))
-    bucket = jnp.where(score < spread_threshold, 0.0, lvl)
+    b_util = jnp.where(score < spread_threshold, 0.0, lvl)
+    cost_b = jnp.floor(cost_row * scale + 0.5)
+    bucket = jnp.clip(b_util + float(_COST_BUCKETS) + cost_b,
+                      0.0, float(_COST_BUCKETS + _UTIL_LEVELS))
     bucket = jnp.where(jnp.logical_and(accel_node, ~is_accel),
-                       float(_UTIL_LEVELS + 1), bucket)
+                       float(_COST_BUCKETS + _UTIL_LEVELS + 1), bucket)
     bucket = jnp.where(empty, float(_NUM_BUCKETS - 1), bucket)
     bucket = bucket.astype(jnp.int32)
     # Prefix capacity in (bucket, rotated node-id) order — sort-free,
@@ -234,7 +280,7 @@ def _pallas_class_fill(c_pad: int, n_pad: int, r_pad: int,
     eps = 1e-6
 
     def kernel(counts_ref, accel_ref, shifts_ref, thr_ref,
-               demand_ref, total_ref, accel_node_ref, av0_ref,
+               demand_ref, total_ref, accel_node_ref, av0_ref, cost_ref,
                av_out_ref, allocs_ref, av_s):
         c = pl.program_id(0)
 
@@ -248,7 +294,9 @@ def _pallas_class_fill(c_pad: int, n_pad: int, r_pad: int,
         is_accel = accel_ref[c] > 0
         shift = shifts_ref[c]
         thr = thr_ref[0]
+        inv = thr_ref[1]
         d = demand_ref[0]                                  # [R, 1]
+        cost = cost_ref[0]                                 # [1, N]
         demanded = d > 0
         any_demand = jnp.any(demanded)
         ratios = jnp.where(demanded, av / jnp.maximum(d, eps), _BIG)
@@ -260,15 +308,19 @@ def _pallas_class_fill(c_pad: int, n_pad: int, r_pad: int,
                           axis=0, keepdims=True)
         score_o = jnp.max(util, axis=0, keepdims=True)
         score = jnp.where(any_demand, score_d, score_o)    # [1, N]
+        score = jnp.where(inv > 0, 1.0 - score, score)
         empty = jnp.max(total, axis=0, keepdims=True) <= 0.0
         accel_node = accel_node_ref[...] > 0.0             # [1, N]
         scale = _UTIL_LEVELS / jnp.maximum(1.0 - thr, eps)
         lvl = jnp.clip(jnp.floor((score - thr) * scale) + 1.0,
                        1.0, float(_UTIL_LEVELS))
-        bucket = jnp.where(score < thr, 0.0, lvl)
+        b_util = jnp.where(score < thr, 0.0, lvl)
+        cost_b = jnp.floor(cost * scale + 0.5)
+        bucket = jnp.clip(b_util + float(_COST_BUCKETS) + cost_b,
+                          0.0, float(_COST_BUCKETS + _UTIL_LEVELS))
         bucket = jnp.where(
             jnp.logical_and(accel_node, jnp.logical_not(is_accel)),
-            float(_UTIL_LEVELS + 1), bucket)
+            float(_COST_BUCKETS + _UTIL_LEVELS + 1), bucket)
         bucket = jnp.where(empty, float(B - 1), bucket).astype(jnp.int32)
         onehot = bucket == jax.lax.broadcasted_iota(
             jnp.int32, (B, n_pad), 0)
@@ -311,6 +363,7 @@ def _pallas_class_fill(c_pad: int, n_pad: int, r_pad: int,
             pl.BlockSpec((r_pad, n_pad), lambda c, *_: (0, 0)),
             pl.BlockSpec((1, n_pad), lambda c, *_: (0, 0)),
             pl.BlockSpec((r_pad, n_pad), lambda c, *_: (0, 0)),
+            pl.BlockSpec((1, 1, n_pad), lambda c, *_: (c, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((r_pad, n_pad), lambda c, *_: (0, 0)),
@@ -329,17 +382,19 @@ def _pallas_class_fill(c_pad: int, n_pad: int, r_pad: int,
     )
 
     def fill(av_t, total_t, demand, counts, accel_class, accel_node,
-             spread_threshold):
+             spread_threshold, cost, invert, shifts):
         import jax.numpy as jnp
         av_out, allocs = fn(
             counts.astype(jnp.float32),
             accel_class.astype(jnp.int32),
-            _class_shifts(c_pad, n_pad),
-            jnp.reshape(jnp.asarray(spread_threshold, jnp.float32), (1,)),
+            shifts.astype(jnp.int32),
+            jnp.stack([jnp.asarray(spread_threshold, jnp.float32),
+                       jnp.asarray(invert, jnp.float32)]),
             demand[:, :, None].astype(jnp.float32),
             total_t,
             accel_node.astype(jnp.float32)[None, :],
-            av_t)
+            av_t,
+            cost[:, None, :].astype(jnp.float32))
         return av_out, allocs[:, 0, :]
 
     return fill
@@ -347,28 +402,37 @@ def _pallas_class_fill(c_pad: int, n_pad: int, r_pad: int,
 
 def _class_fill(av_t, total_t, demand, counts, accel_class, accel_node,
                 spread_threshold, *, c_pad: int, n_pad: int, r_pad: int,
-                use_pallas: bool):
+                use_pallas: bool, cost=None, invert=None, shifts=None):
     """Run the per-class waterfill over all classes against ``av_t``.
 
-    Returns (av_after [R, N], allocs [C, N]).  One fused Mosaic kernel
-    on TPU; the jnp scan elsewhere (both oracle-exact)."""
+    ``cost`` [C, N] per-(class, node) score offsets (None = zeros),
+    ``invert`` scalar flag for pack mode, ``shifts`` [C] within-bucket
+    rotation offsets (None = the default per-class stride).  Returns
+    (av_after [R, N], allocs [C, N]).  One fused Mosaic kernel on TPU;
+    the jnp scan elsewhere (both oracle-exact)."""
     import jax
     import jax.numpy as jnp
 
+    if cost is None:
+        cost = jnp.zeros((c_pad, n_pad), jnp.float32)
+    if invert is None:
+        invert = jnp.float32(0.0)
+    if shifts is None:
+        shifts = _class_shifts(c_pad, n_pad)
     if use_pallas:
         fill = _pallas_class_fill(c_pad, n_pad, r_pad)
         return fill(av_t, total_t, demand, counts, accel_class,
-                    accel_node, spread_threshold)
+                    accel_node, spread_threshold, cost, invert, shifts)
     empty = jnp.max(total_t, axis=0) <= 0
-    shifts = _class_shifts(c_pad, n_pad)
 
     def body(av, inputs):
-        d, cnt, is_accel, shift = inputs
+        d, cnt, is_accel, shift, cost_row = inputs
         return _bucket_fill_step(av, total_t, d, cnt, is_accel, shift,
-                                 accel_node, empty, spread_threshold)
+                                 cost_row, invert, accel_node, empty,
+                                 spread_threshold)
 
     av_after, allocs = jax.lax.scan(
-        body, av_t, (demand, counts, accel_class, shifts), unroll=8)
+        body, av_t, (demand, counts, accel_class, shifts, cost), unroll=8)
     return av_after, allocs
 
 
@@ -415,13 +479,14 @@ def _jit_waterfill(c_pad: int, n_pad: int, r_pad: int,
     import jax
 
     def solve(avail, total, demand, counts, accel_node, accel_class,
-              spread_threshold):
+              spread_threshold, cost, invert, shifts):
         # avail/total: [N, R]; demand: [C, R]; counts: [C].  Transposed
         # once to the TPU-native [R, N] layout (see _bucket_fill_step).
         final_avail, allocs = _class_fill(
             avail.T, total.T, demand, counts, accel_class, accel_node,
             spread_threshold, c_pad=c_pad, n_pad=n_pad, r_pad=r_pad,
-            use_pallas=use_pallas)
+            use_pallas=use_pallas, cost=cost, invert=invert,
+            shifts=shifts)
         return allocs, final_avail.T
 
     return jax.jit(solve)
@@ -453,7 +518,7 @@ def _jit_waterfill_stream(c_pad: int, n_pad: int, r_pad: int,
     assert c_pad * n_pad < (1 << 24), "sparse idx must stay exact in f32"
 
     def solve(avail0, total, demand, pending0, arrivals, rho, accel_node,
-              accel_class, spread_threshold):
+              accel_class, spread_threshold, cost):
         av0_t, total_t = avail0.T, total.T                 # [R, N]
         inflight0 = jnp.zeros((c_pad, n_pad), jnp.float32)
 
@@ -469,7 +534,7 @@ def _jit_waterfill_stream(c_pad: int, n_pad: int, r_pad: int,
             av_after, allocs = _class_fill(
                 av, total_t, demand, counts_k, accel_class, accel_node,
                 spread_threshold, c_pad=c_pad, n_pad=n_pad, r_pad=r_pad,
-                use_pallas=use_pallas)
+                use_pallas=use_pallas, cost=cost)
             packed, placed_c = _pack_tick(allocs, counts_k, av, demand,
                                           nnz_max)
             pending_next = jnp.maximum(counts_k - placed_c, 0.0)
@@ -499,11 +564,11 @@ def _jit_solve_tick(c_pad: int, n_pad: int, r_pad: int, nnz_max: int,
     assert c_pad * n_pad < (1 << 24), "sparse idx must stay exact in f32"
 
     def solve(avail_t, total_t, demand, counts, accel_node, accel_class,
-              spread_threshold):
+              spread_threshold, cost):
         _, allocs = _class_fill(
             avail_t, total_t, demand, counts, accel_class, accel_node,
             spread_threshold, c_pad=c_pad, n_pad=n_pad, r_pad=r_pad,
-            use_pallas=use_pallas)
+            use_pallas=use_pallas, cost=cost)
         packed, _ = _pack_tick(allocs, counts, avail_t, demand, nnz_max)
         return packed
 
@@ -521,6 +586,66 @@ def _jit_apply_rows(n_pad: int, r_pad: int, k_pad: int):
         return avail_t.at[:, idx].set(rows.T)
 
     return jax.jit(apply, donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=16)
+def _jit_pack_bundles(b_pad: int, n_pad: int, r_pad: int):
+    """Placement-group bundle -> node solve as ONE device program.
+
+    Bundles are count-1 demands whose placement interacts through the
+    evolving (availability, used-node) carry, so the solve is a scan
+    over bundle rows — still a single dispatch for the whole group
+    (and the strategy semantics live in the cost, not host loops):
+
+      * score = LeastResourceScorer best-fit (after-allocation leftover
+        of the demanded resources, gcs_resource_scheduler.h:74),
+      * PACK  -> ``pack_w`` > 0 bonus on already-used nodes,
+        SPREAD -> ``pack_w`` < 0 penalty (soft constraints),
+      * STRICT_SPREAD -> used nodes masked infeasible (hard),
+      * STRICT_PACK is collapsed by the host into one composite row.
+
+    Returns (node_idx [B] int32, ok [B] bool).  Padded bundle rows
+    (empty demand) are no-ops; padded nodes (zero total) are never
+    feasible.  The host validates the assignment against the exact
+    quantized vectors before the 2PC prepare — kernel output never
+    commits unchecked (same contract as the task tick).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def solve(avail, total, demand, excluded, used0, pack_w,
+              strict_spread):
+        eps = 1e-6
+        alive = jnp.max(total, axis=1) > 0                 # [N]
+        node_ok = alive & ~excluded
+
+        def body(carry, d):
+            av, used = carry
+            demanded = d > 0                               # [R]
+            is_real = jnp.any(demanded)
+            feasible = jnp.all(av + eps >= d[None, :], axis=1) & node_ok
+            feasible = jnp.where(strict_spread > 0,
+                                 feasible & ~used, feasible)
+            # LeastResourceScorer: mean over demanded resources of
+            # 1 - leftover/have — higher = tighter fit (best fit).
+            terms = jnp.where(
+                demanded[None, :],
+                1.0 - (av - d[None, :]) / jnp.maximum(av, 1.0), 0.0)
+            nd = jnp.maximum(jnp.sum(demanded.astype(jnp.float32)), 1.0)
+            sc = jnp.sum(terms, axis=1) / nd
+            sc = sc + pack_w * used.astype(jnp.float32)
+            sc = jnp.where(feasible, sc, -_BIG)
+            best = jnp.argmax(sc).astype(jnp.int32)
+            ok = is_real & (sc[best] > -_BIG / 2)
+            hot = (jnp.arange(av.shape[0]) == best) & ok   # [N]
+            av = av - jnp.where(hot[:, None], d[None, :], 0.0)
+            used = used | hot
+            return (av, used), (best, ok)
+
+        (_, _), (idx, ok) = jax.lax.scan(body, (avail, used0), demand)
+        return idx, ok
+
+    return jax.jit(solve)
 
 
 @functools.lru_cache(maxsize=16)
@@ -604,14 +729,26 @@ def _jit_sinkhorn(c_pad: int, n_pad: int, r_pad: int, iters: int):
 # ---------------------------------------------------------------------------
 
 def bucket_oracle(score: np.ndarray, accel_avoid: np.ndarray,
-                  empty: np.ndarray, spread_threshold: float) -> np.ndarray:
-    """Quantize scores into fill-priority buckets (same spec as device)."""
+                  empty: np.ndarray, spread_threshold: float,
+                  cost: Optional[np.ndarray] = None) -> np.ndarray:
+    """Quantize scores into fill-priority buckets (same spec as device):
+    the utilization mapping (flat pack zone below the threshold, 16
+    quantized levels above) offset by the cost term in bucket units,
+    with 16 pre-buckets below the pack zone for cost-preferred nodes."""
     thr = np.float32(spread_threshold)
     scale = np.float32(_UTIL_LEVELS) / max(np.float32(1.0) - thr,
                                            np.float32(1e-6))
     lvl = np.clip(np.floor((score - thr) * scale) + 1.0, 1.0, _UTIL_LEVELS)
-    bucket = np.where(score < thr, 0.0, lvl)
-    bucket = np.where(accel_avoid, _UTIL_LEVELS + 1, bucket)
+    b_util = np.where(score < thr, np.float32(0.0), lvl)
+    if cost is None:
+        cost_b = np.float32(0.0)
+    else:
+        cost_b = np.floor(cost.astype(np.float32) * scale +
+                          np.float32(0.5))
+    bucket = np.clip(b_util + np.float32(_COST_BUCKETS) + cost_b,
+                     0.0, _COST_BUCKETS + _UTIL_LEVELS)
+    bucket = np.where(accel_avoid, _COST_BUCKETS + _UTIL_LEVELS + 1,
+                      bucket)
     bucket = np.where(empty, _NUM_BUCKETS - 1, bucket)
     return bucket.astype(np.int32)
 
@@ -619,9 +756,13 @@ def bucket_oracle(score: np.ndarray, accel_avoid: np.ndarray,
 def waterfill_oracle(avail: np.ndarray, total: np.ndarray,
                      demand: np.ndarray, counts: np.ndarray,
                      accel_node: np.ndarray, accel_class: np.ndarray,
-                     spread_threshold: float) -> np.ndarray:
+                     spread_threshold: float,
+                     cost: Optional[np.ndarray] = None,
+                     invert_util: bool = False,
+                     zero_shifts: bool = False) -> np.ndarray:
     """Pure-numpy reference of the bucketized waterfill (same semantics,
-    including the per-class within-bucket rotation).
+    including the per-class within-bucket rotation, the per-(class,node)
+    ``cost`` offsets and the inverted-utilization pack mode).
 
     Float32 throughout so score/bucket boundaries match the device kernel
     bit-for-bit."""
@@ -654,13 +795,17 @@ def waterfill_oracle(avail: np.ndarray, total: np.ndarray,
                              np.float32(-_BIG)).max(axis=1)
         else:
             score = util.max(axis=1)
+        score = score.astype(np.float32)
+        if invert_util:
+            score = (np.float32(1.0) - score).astype(np.float32)
         accel_avoid = accel_node & (not accel_class[c])
         bucket = bucket_oracle(score.astype(np.float32), accel_avoid, empty,
-                               spread_threshold)
+                               spread_threshold,
+                               cost=None if cost is None else cost[c])
         # Fill order: (bucket, node-id rotated by the class stride) — the
         # padded nodes carry zero capacity so only the real nodes'
         # relative rolled order matters.
-        shift = (c * _ROT_STRIDE) % n_pad
+        shift = 0 if zero_shifts else (c * _ROT_STRIDE) % n_pad
         rot_key = (node_ids - shift) % n_pad
         order = np.lexsort((rot_key, bucket))
         remaining = cnt
@@ -679,7 +824,8 @@ def stream_oracle(avail: np.ndarray, total: np.ndarray, demand: np.ndarray,
                   arrivals: np.ndarray, rho: np.ndarray,
                   accel_node: np.ndarray, accel_class: np.ndarray,
                   spread_threshold: float,
-                  pending0: Optional[np.ndarray] = None) -> List[np.ndarray]:
+                  pending0: Optional[np.ndarray] = None,
+                  cost: Optional[np.ndarray] = None) -> List[np.ndarray]:
     """Numpy replay of the closed-loop tick stream (same release model as
     ``_jit_waterfill_stream``): returns each tick's dense alloc[C, N].
 
@@ -702,7 +848,8 @@ def stream_oracle(avail: np.ndarray, total: np.ndarray, demand: np.ndarray,
         inflight = inflight - release
         queue_k = pending + arrivals[k]
         alloc = waterfill_oracle(avail, total, demand, queue_k,
-                                 accel_node, accel_class, spread_threshold)
+                                 accel_node, accel_class, spread_threshold,
+                                 cost=cost)
         af = alloc.astype(np.float32)
         avail = avail - np.einsum("cn,cr->nr", af, demand)
         inflight = inflight + af
@@ -753,8 +900,16 @@ class BatchSolver:
                        demand: np.ndarray, counts: np.ndarray,
                        accel_node: Optional[np.ndarray] = None,
                        accel_class: Optional[np.ndarray] = None,
-                       spread_threshold: Optional[float] = None):
-        """Returns alloc[C,N] int64 for one tick."""
+                       spread_threshold: Optional[float] = None,
+                       cost: Optional[np.ndarray] = None,
+                       invert_util: bool = False,
+                       zero_shifts: bool = False):
+        """Returns alloc[C,N] int64 for one tick.
+
+        ``cost`` [C, N] adds per-(class, node) score offsets (negative =
+        preferred); ``invert_util`` + ``zero_shifts`` select pack mode
+        (most-utilized-first, first-fit within a bucket) — the
+        autoscaler's node-count bin-packing ordering."""
         import jax
         C, R = demand.shape
         N = avail.shape[0]
@@ -770,31 +925,80 @@ class BatchSolver:
             _pad_to(accel_class.astype(bool), (c_pad,)),
         )
         if self.mode == "sinkhorn":
+            if cost is not None or invert_util or zero_shifts:
+                raise ValueError(
+                    "cost/invert_util/zero_shifts are waterfill-only; "
+                    "the sinkhorn solver does not implement the cost "
+                    "matrix and silently dropping them would return a "
+                    "wrong-ordering solve")
             fn = _jit_sinkhorn(c_pad, n_pad, r_pad, self.sinkhorn_iters)
             allocs, _ = fn(*args, np.float32(spread_threshold),
                            np.float32(0.1))
         else:
+            cost_p = np.zeros((c_pad, n_pad), np.float32) if cost is None \
+                else _pad_to(cost.astype(np.float32), (c_pad, n_pad))
+            shifts = np.zeros(c_pad, np.int32) if zero_shifts else \
+                np.asarray((np.arange(c_pad) * _ROT_STRIDE) % n_pad,
+                           np.int32)
             allocs, _ = _call_with_pallas_fallback(
                 lambda use: _jit_waterfill(c_pad, n_pad, r_pad, use),
-                (*args, np.float32(spread_threshold)))
+                (*args, np.float32(spread_threshold), cost_p,
+                 np.float32(1.0 if invert_util else 0.0), shifts))
         allocs = np.asarray(jax.device_get(allocs))[:C, :N]
         return np.rint(allocs).astype(np.int64)
+
+    # -- bundle interface (GCS placement groups) -------------------------
+    def solve_bundles(self, avail: np.ndarray, total: np.ndarray,
+                      demand: np.ndarray, strategy: str,
+                      excluded: Optional[np.ndarray] = None):
+        """Bundle -> node indices for one placement group in one device
+        call (``_jit_pack_bundles``).  ``demand`` is [B, R] in host
+        (unsorted) order; strategy semantics ride the kernel's cost and
+        masks.  Returns (node_idx [B] int64, ok [B] bool) — callers
+        treat any ``~ok`` as all-or-nothing failure and re-validate
+        against exact vectors before committing."""
+        import jax
+        B, R = demand.shape
+        N = avail.shape[0]
+        b_pad = _round_up(max(B, 1), 8)
+        n_pad = _round_up(max(N, 8), _GROUP)
+        r_pad = _round_up(max(R, 1), 8)
+        if excluded is None:
+            excluded = np.zeros(N, dtype=bool)
+        pack_w = {"PACK": 10.0, "SPREAD": -10.0}.get(strategy, 0.0)
+        fn = _jit_pack_bundles(b_pad, n_pad, r_pad)
+        idx, ok = fn(
+            _pad_to(avail.astype(np.float32), (n_pad, r_pad)),
+            _pad_to(total.astype(np.float32), (n_pad, r_pad)),
+            _pad_to(demand.astype(np.float32), (b_pad, r_pad)),
+            _pad_to(excluded.astype(bool), (n_pad,)),
+            np.zeros(n_pad, dtype=bool),
+            np.float32(pack_w),
+            np.float32(1.0 if strategy == "STRICT_SPREAD" else 0.0))
+        idx = np.asarray(jax.device_get(idx))[:B].astype(np.int64)
+        ok = np.asarray(jax.device_get(ok))[:B].astype(bool)
+        return idx, ok
 
     # -- device-resident tick-stream interface (used by bench) -----------
     def prepare_device(self, avail: np.ndarray, total: np.ndarray,
                        demand: np.ndarray,
                        accel_node: Optional[np.ndarray] = None,
                        accel_class: Optional[np.ndarray] = None,
-                       spread_threshold: Optional[float] = None) -> None:
-        """Upload the cluster world-state once; subsequent solve_stream
-        calls ship only per-tick queue counts."""
+                       spread_threshold: Optional[float] = None,
+                       cost: Optional[np.ndarray] = None) -> None:
+        """Upload the cluster world-state once (including the static
+        per-(class, node) cost matrix); subsequent solve_stream calls
+        ship only per-tick queue counts."""
         import jax
         C, R = demand.shape
         N = avail.shape[0]
         c_pad, n_pad, r_pad = self._pads(C, N, R)
         accel_node, accel_class, spread_threshold = self._defaults(
             N, C, accel_node, accel_class, spread_threshold)
+        cost_p = np.zeros((c_pad, n_pad), np.float32) if cost is None \
+            else _pad_to(cost.astype(np.float32), (c_pad, n_pad))
         dev = {
+            "cost": jax.device_put(cost_p),
             "avail": jax.device_put(
                 _pad_to(avail.astype(np.float32), (n_pad, r_pad))),
             "total": jax.device_put(
@@ -844,7 +1048,8 @@ class BatchSolver:
             lambda use: _jit_waterfill_stream(c_pad, n_pad, r_pad, K,
                                               nnz_max, use),
             (dev["avail"], dev["total"], dev["demand"], pen, arr, rho_vec,
-             dev["accel_node"], dev["accel_class"], dev["thr"])))
+             dev["accel_node"], dev["accel_class"], dev["thr"],
+             dev["cost"])))
         return {
             "idx": np.rint(packed[:, :nnz_max]).astype(np.int64),
             "vals": packed[:, nnz_max:2 * nnz_max],
@@ -904,10 +1109,7 @@ class BatchSolver:
             if demand.shape[1] < total.shape[1]:
                 demand = _pad_to(demand, (demand.shape[0], total.shape[1]))
             counts = np.array([len(groups[c]) for c in classes])
-            accel_node = np.zeros(len(node_ids), dtype=bool)
-            for col in ACCELERATOR_COLUMNS:
-                if col < total.shape[1]:
-                    accel_node |= total[:, col] > 0
+            accel_node = accelerator_node_mask(total)
             accel_class = np.array([r.uses_accelerator() for r in reqs])
             alloc = self.solve_matrices(avail, total, demand, counts,
                                         accel_node, accel_class)
@@ -964,7 +1166,7 @@ class DeviceRuntimeSolver:
     # the kernel's design envelope anyway.
     _MAX_CLASS_ROWS = 4096
 
-    def __init__(self, node_label: str = ""):
+    def __init__(self, node_label: str = "", locality_provider=None):
         self._state: Optional[dict] = None
         # scheduling_class -> demand row.  Rows grow as classes are
         # interned and are compacted by _evict_stale_classes when growth
@@ -976,8 +1178,19 @@ class DeviceRuntimeSolver:
         self._accel_host: Optional[np.ndarray] = None    # [c_cap]
         self._demand_dev = None
         self._accel_dev = None
+        self._zero_cost_dev = None                       # [c_cap, n_pad]
+        # Callable(list_of_specs) -> Dict[node_id, arg_bytes]: the
+        # arg-locality signal (object sizes + locations from the object
+        # directory), provided by the owning ClusterTaskManager.  None
+        # disables the locality cost term.
+        self._locality_provider = locality_provider
+        # True when the LAST solve shipped a nonzero cost matrix — the
+        # caller uses it to label spillbacks (no_capacity vs
+        # locality_override) honestly.
+        self.last_cost_active = False
         self.stats = {"ticks": 0, "full_syncs": 0, "row_deltas": 0,
-                      "fallbacks": 0, "class_evictions": 0}
+                      "fallbacks": 0, "class_evictions": 0,
+                      "cost_ticks": 0}
         from ray_tpu._private.metrics_agent import (get_metrics_registry,
                                                     record_internal)
         # Label by owning node: one solver per raylet, and unlabeled
@@ -1001,6 +1214,10 @@ class DeviceRuntimeSolver:
         """Per-spec node targets, or None if the device path could not
         produce a valid assignment (caller must fall back to greedy)."""
         from ray_tpu.scheduler.policy import SchedulingType
+        # Reset per call: a tick with no HYBRID groups never reaches
+        # _build_cost, and a stale True from the previous tick would
+        # mislabel this tick's spillbacks as locality_override.
+        self.last_cost_active = False
         groups: Dict[int, List[int]] = {}
         fallback: List[int] = []
         for i, spec in enumerate(specs):
@@ -1088,12 +1305,13 @@ class DeviceRuntimeSolver:
         if nnz_max is None:
             return False
         cfg = get_config()
+        cost = self._build_cost(specs, groups, st, c_cap, cfg)
         packed = np.asarray(_call_with_pallas_fallback(
             lambda use: _jit_solve_tick(c_cap, st["n_pad"], st["r_pad"],
                                         nnz_max, use),
             (st["avail_t"], st["total_t"], self._demand_dev, counts,
              st["accel_node"], self._accel_dev,
-             np.float32(cfg.scheduler_spread_threshold))))
+             np.float32(cfg.scheduler_spread_threshold), cost)))
         ok = packed[2 * nnz_max + 1] > 0.5
         if not ok:
             return False
@@ -1116,6 +1334,63 @@ class DeviceRuntimeSolver:
                         k += 1
         return True
 
+    def _build_cost(self, specs, groups, st, c_cap: int, cfg):
+        """Per-(class, node) cost matrix for this tick, or the cached
+        device-resident zeros when no cost term is live (the common
+        case — nothing extra crosses host->device then).
+
+        Two terms, both in utilization units (1/16 = one fill bucket):
+          * heterogeneity (Gavel): ``w_het * (1 - rate/max_rate)`` from
+            the node throughput labels, picked per class (accelerator
+            classes read the accel rate) — slower nodes fill later;
+          * arg-locality (Tesserae placement quality): ``-w_loc *
+            bytes_on_node / max_bytes`` aggregated over the class's
+            queued specs from the object directory's size hints —
+            nodes already holding the class's argument bytes fill
+            first, shrinking cross-node fetches.
+        """
+        w_het = cfg.scheduler_het_weight
+        w_loc = cfg.scheduler_locality_weight
+        het = st["het_active"] and w_het > 0.0
+        loc_rows: Dict[int, Dict] = {}
+        if w_loc > 0.0 and self._locality_provider is not None:
+            for cls, members in groups.items():
+                with_args = [specs[i] for i in members
+                             if getattr(specs[i], "args", None)]
+                if not with_args:
+                    continue
+                try:
+                    by_node = self._locality_provider(with_args)
+                except Exception:
+                    by_node = None
+                if by_node:
+                    loc_rows[cls] = by_node
+        if not het and not loc_rows:
+            self.last_cost_active = False
+            return self._zero_cost_dev
+        self.last_cost_active = True
+        self.stats["cost_ticks"] += 1
+        n_pad = st["n_pad"]
+        cost = np.zeros((c_cap, n_pad), dtype=np.float32)
+        if het:
+            accel = self._accel_host
+            cost[:] = np.where(accel[:, None], st["het_accel"][None, :],
+                               st["het_cpu"][None, :]) * np.float32(w_het)
+        node_index = st["node_index"]
+        for cls, by_node in loc_rows.items():
+            row = self._class_rows.get(cls)
+            if row is None:
+                continue
+            top = max(by_node.values())
+            if top <= 0:
+                continue
+            for nid, nbytes in by_node.items():
+                idx = node_index.get(nid)
+                if idx is not None:
+                    cost[row, idx] -= np.float32(w_loc) * \
+                        np.float32(nbytes / top)
+        return cost
+
     def _full_sync(self, view):
         import jax
         self.stats["full_syncs"] += 1
@@ -1128,13 +1403,32 @@ class DeviceRuntimeSolver:
         if prev is not None:
             n_pad = max(n_pad, prev["n_pad"])
             r_pad = max(r_pad, prev["r_pad"])
-        accel_node = np.zeros(N, dtype=bool)
-        for col in ACCELERATOR_COLUMNS:
-            if col < total.shape[1]:
-                accel_node |= total[:, col] > 0
+        accel_node = accelerator_node_mask(total)
+        # Per-node throughput rates (heterogeneity cost term): read once
+        # per structural change from node labels.  Normalized to the
+        # fleet max so homogeneous fleets cost uniformly zero; padded
+        # nodes carry the max rate (zero cost — they are masked out by
+        # the empty bucket anyway).
+        rates_cpu = np.ones(n_pad, dtype=np.float32)
+        rates_accel = np.ones(n_pad, dtype=np.float32)
+        for i, nid in enumerate(node_ids):
+            res = view.node_resources(nid)
+            labels = getattr(res, "labels", None) or {}
+            r = _label_rate(labels, NODE_THROUGHPUT_LABEL)
+            rates_cpu[i] = r
+            rates_accel[i] = _label_rate(
+                labels, NODE_ACCEL_THROUGHPUT_LABEL, default=r)
+        rates_cpu[N:] = rates_cpu[:max(N, 1)].max()
+        rates_accel[N:] = rates_accel[:max(N, 1)].max()
+        het_cpu = 1.0 - rates_cpu / rates_cpu.max()
+        het_accel = 1.0 - rates_accel / rates_accel.max()
         self._state = {
             "version": ver, "node_ids": node_ids, "columns": columns,
+            "node_index": {nid: i for i, nid in enumerate(node_ids)},
             "n_pad": n_pad, "r_pad": r_pad,
+            "het_cpu": het_cpu.astype(np.float32),
+            "het_accel": het_accel.astype(np.float32),
+            "het_active": bool(het_cpu.any() or het_accel.any()),
             "avail_t": jax.device_put(
                 _pad_to(avail.astype(np.float32), (n_pad, r_pad)).T.copy()),
             "total_t": jax.device_put(
@@ -1159,6 +1453,12 @@ class DeviceRuntimeSolver:
         self._demand_host, self._accel_host = demand, accel
         self._demand_dev = jax.device_put(demand)
         self._accel_dev = jax.device_put(accel)
+        # Device-resident zero cost matrix: the common no-cost tick
+        # passes this cached handle, so nothing extra crosses
+        # host->device unless a locality/heterogeneity term is live.
+        n_pad = self._state["n_pad"] if self._state else _GROUP
+        self._zero_cost_dev = jax.device_put(
+            np.zeros((c_cap, n_pad), dtype=np.float32))
 
     def _evict_stale_classes(self, keep: set, st: dict,
                              force_lru: bool = False) -> bool:

@@ -30,9 +30,9 @@ import numpy as np
 
 from ray_tpu._private.config import get_config
 from ray_tpu.scheduler.resources import (
-    ACCELERATOR_COLUMNS,
     ClusterResourceView,
     ResourceRequest,
+    accelerator_node_mask,
 )
 
 
@@ -118,10 +118,7 @@ def _masks(view: ClusterResourceView, req: ResourceRequest,
     # so they rank last among equals (reference .cc:143-165 hard-skips when
     # alternatives exist; penalty + argsort gives the same preference).
     if options.avoid_accelerator_nodes and not req.uses_accelerator():
-        accel = np.zeros(n, dtype=bool)
-        for c in ACCELERATOR_COLUMNS:
-            if c < total.shape[1]:
-                accel |= total[:, c] > 0
+        accel = accelerator_node_mask(total)
         score = score + accel.astype(np.float32) * 1.0
     return node_ids, available, feasible, score
 

@@ -38,6 +38,17 @@ def _quantize(value: float) -> int:
     return int(round(float(value) * FP_SCALE))
 
 
+def accelerator_node_mask(total: np.ndarray) -> np.ndarray:
+    """[N] bool mask of nodes carrying any accelerator column — the
+    shared input of the greedy policy's avoid-accel penalty and the
+    kernel's accel-avoid bucket (one definition, three schedulers)."""
+    mask = np.zeros(total.shape[0], dtype=bool)
+    for c in ACCELERATOR_COLUMNS:
+        if c < total.shape[1]:
+            mask |= total[:, c] > 0
+    return mask
+
+
 class ResourceRequest:
     """A task/bundle resource demand as a quantized sparse vector."""
 

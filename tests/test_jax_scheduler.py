@@ -314,7 +314,8 @@ class TestPallasClassFill:
     falls back to jnp on any Mosaic failure."""
 
     @pytest.mark.parametrize("seed", [0, 1, 2])
-    def test_interpret_mode_matches_jnp_scan(self, seed):
+    @pytest.mark.parametrize("with_cost", [False, True])
+    def test_interpret_mode_matches_jnp_scan(self, seed, with_cost):
         import jax.numpy as jnp
 
         from ray_tpu.scheduler import jax_backend as jb
@@ -339,12 +340,26 @@ class TestPallasClassFill:
         ac = jnp.asarray(jb._pad_to(accel_class.astype(np.float32),
                                     (c_pad,)) > 0)
         thr = np.float32(0.5)
+        if with_cost:
+            # Locality/heterogeneity-shaped offsets: a few strong node
+            # preferences per class, the rest zero.
+            cost_np = np.where(rng.random((c_pad, n_pad)) < 0.1,
+                               rng.uniform(-0.6, 0.4,
+                                           (c_pad, n_pad)), 0.0)
+            cost = jnp.asarray(cost_np.astype(np.float32))
+            invert = jnp.float32(1.0 if seed % 2 else 0.0)
+        else:
+            cost = jnp.zeros((c_pad, n_pad), jnp.float32)
+            invert = jnp.float32(0.0)
+        shifts = jb._class_shifts(c_pad, n_pad)
 
         av_jnp, alloc_jnp = jb._class_fill(
             av_t, total_t, dm, cn, ac, an, thr,
-            c_pad=c_pad, n_pad=n_pad, r_pad=r_pad, use_pallas=False)
+            c_pad=c_pad, n_pad=n_pad, r_pad=r_pad, use_pallas=False,
+            cost=cost, invert=invert, shifts=shifts)
         fill = jb._pallas_class_fill(c_pad, n_pad, r_pad, interpret=True)
-        av_pl, alloc_pl = fill(av_t, total_t, dm, cn, ac, an, thr)
+        av_pl, alloc_pl = fill(av_t, total_t, dm, cn, ac, an, thr,
+                               cost, invert, shifts)
 
         np.testing.assert_array_equal(np.asarray(alloc_jnp),
                                       np.asarray(alloc_pl))
