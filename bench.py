@@ -153,18 +153,18 @@ def _model_bench_row(on_cpu: bool):
         return {"skipped": True, "reason": "unparseable bench_model output"}
 
 
-def _dispatch_latency_row():
+def _dispatch_latency_rows():
     """Run bench_runtime.py --dispatch-only in a subprocess (its own
     CPU-side runtime, never touches the chip) and return the parsed
-    task_dispatch_latency_p99 row, or a structured skip dict — the
-    bench trajectory records the north-star p99 from every bench.py
-    invocation."""
+    task_dispatch_latency_p99 sweep rows (n=500/2000/5000), or a
+    structured skip dict — the bench trajectory records the north-star
+    p99 from every bench.py invocation."""
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "bench_runtime.py")
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     try:
         proc = subprocess.run(
-            [sys.executable, path, "--dispatch-only", "--quick"],
+            [sys.executable, path, "--dispatch-only"],
             env=env, capture_output=True, text=True, timeout=600)
     except subprocess.TimeoutExpired:
         return {"skipped": True, "reason": "dispatch bench timed out"}
@@ -172,14 +172,17 @@ def _dispatch_latency_row():
         return {"skipped": True,
                 "reason": f"dispatch bench rc={proc.returncode}: "
                           f"{(proc.stderr or '')[-400:]}"}
-    for line in reversed(proc.stdout.strip().splitlines()):
+    rows = []
+    for line in proc.stdout.strip().splitlines():
         try:
             row = json.loads(line)
         except ValueError:
             continue
         if row.get("metric") == "task_dispatch_latency_p99":
-            return row
-    return {"skipped": True, "reason": "no dispatch-latency row in output"}
+            rows.append(row)
+    if not rows:
+        return {"skipped": True, "reason": "no dispatch-latency row in output"}
+    return {"rows": rows}
 
 
 def main():
@@ -322,17 +325,27 @@ def main():
         res["autoscaler_solve"] = {"skipped": True, "reason": repr(err)}
 
     # North-star runtime axis: p99 task-dispatch latency, decomposed by
-    # stage — measured end-to-end through ray_tpu.remote by a CPU-side
-    # subprocess (the chip is untouched), folded into the headline row.
-    dispatch = _dispatch_latency_row()
+    # stage and swept across burst sizes (n=500/2000/5000) — measured
+    # end-to-end through ray_tpu.remote by a CPU-side subprocess (the
+    # chip is untouched), folded into the headline row.  The headline
+    # dispatch_p99_ms stays the n=500 row for cross-round continuity.
+    dispatch = _dispatch_latency_rows()
     if dispatch.get("skipped"):
         res["dispatch_p99_ms"] = None
         res["dispatch_skip_reason"] = dispatch.get("reason")
     else:
-        print(json.dumps(dispatch))
-        res["dispatch_p99_ms"] = dispatch.get("value")
-        res["dispatch_p50_ms"] = dispatch.get("p50_ms")
-        res["dispatch_stages"] = dispatch.get("stages")
+        rows = dispatch["rows"]
+        head_row = next((r for r in rows if r.get("n") == 500), rows[0])
+        for row in rows:
+            print(json.dumps(row))
+        res["dispatch_p99_ms"] = head_row.get("value")
+        res["dispatch_p50_ms"] = head_row.get("p50_ms")
+        res["dispatch_stages"] = head_row.get("stages")
+        res["dispatch_lease_rpcs"] = head_row.get("lease_rpcs")
+        res["dispatch_sweep"] = [
+            {k: row.get(k) for k in ("n", "value", "p50_ms",
+                                     "lease_rpcs", "stages")}
+            for row in rows]
     print(json.dumps(res))
 
 

@@ -78,12 +78,16 @@ def bench_queued(n, num_blockers):
                 drain_rate=round(n / drain_dt, 2))
 
 
-def bench_dispatch_latency(n):
+def bench_dispatch_latency(n, warm=True, reset_window=True):
     """Task-dispatch latency decomposed by lifecycle stage — the
     BASELINE.json north-star metric (p99 task-dispatch latency),
     derived from the task-event pipeline: queue_wait (submit ->
-    scheduled), dispatch (scheduled -> handed to worker), startup
-    (handoff -> running), total (submit -> running)."""
+    scheduled/bound), dispatch (scheduled -> handed to worker), startup
+    (handoff -> running), total (submit -> running).  Every task gets a
+    queue_wait sample (lease-reuse pushes emit SCHEDULED transport-side
+    since the fast-path PR), so the per-stage counts must agree —
+    asserted here so a coverage regression fails the bench, not just a
+    test."""
     import ray_tpu
     from ray_tpu.experimental.state.api import summarize_tasks
 
@@ -91,24 +95,63 @@ def bench_dispatch_latency(n):
     def noop():
         return None
 
-    ray_tpu.get([noop.remote() for _ in range(200)])      # warm
+    from ray_tpu._private.worker import global_worker
+    cluster = global_worker().cluster
+    if warm:
+        ray_tpu.get([noop.remote() for _ in range(200)])
+    if reset_window:
+        # One concurrency level per sample window: without the reset a
+        # sweep's later rows would blend the earlier levels' samples.
+        # Flush first so straggling pre-reset events can't leak into
+        # the fresh window and skew the per-stage counts.
+        summarize_tasks()
+        cluster.gcs.task_event_manager.reset_stage_samples()
+    lease_before = dict(cluster.head_node.lease_stats)
     ray_tpu.get([noop.remote() for _ in range(n)])
     stages = summarize_tasks().get("dispatch_latency", {})
     total = stages.get("total", {})
-    from ray_tpu._private.worker import global_worker
-    ticks = global_worker().cluster.head_node.cluster_task_manager \
-        .tick_stats
+    ticks = cluster.head_node.cluster_task_manager.tick_stats
+    lease = cluster.head_node.lease_stats
+    counts = {s: row["count"] for s, row in stages.items()}
+    assert len(set(counts.values())) <= 1, \
+        f"stage-coverage gap: {counts}"
+    cfg = __import__("ray_tpu._private.config",
+                     fromlist=["get_config"]).get_config()
     return emit("task_dispatch_latency_p99",
                 total.get("p99_s", 0.0) * 1000.0, "ms", n=n,
                 spillbacks_no_capacity=ticks["spillbacks_no_capacity"],
                 spillbacks_locality_override=ticks[
                     "spillbacks_locality_override"],
+                lease_rpcs=(lease["lease_requests"]
+                            - lease_before["lease_requests"]
+                            + lease["lease_batch_requests"]
+                            - lease_before["lease_batch_requests"]),
+                fastpath={
+                    "lease_batch_size": cfg.lease_batch_size,
+                    "worker_lease_keepalive_ms":
+                        cfg.worker_lease_keepalive_ms,
+                    "num_prestart_workers": cfg.num_prestart_workers,
+                    "scheduler_wakeup_debounce_ms":
+                        cfg.scheduler_wakeup_debounce_ms,
+                },
                 p50_ms=round(total.get("p50_s", 0.0) * 1000.0, 4),
                 stages={
                     stage: {"p50_ms": round(row["p50_s"] * 1000.0, 4),
                             "p99_ms": round(row["p99_s"] * 1000.0, 4),
                             "count": row["count"]}
                     for stage, row in stages.items()})
+
+
+def bench_dispatch_sweep(levels=(500, 2_000, 5_000)):
+    """Concurrency sweep of the dispatch-latency row: one row per burst
+    size, same warm worker pool, fresh sample window per level — the
+    trajectory captures how the stage breakdown scales with queue
+    depth."""
+    rows = []
+    for i, n in enumerate(levels):
+        rows.append(bench_dispatch_latency(
+            n, warm=(i == 0), reset_window=True))
+    return rows
 
 
 def bench_actors(n):
@@ -555,11 +598,17 @@ def main():
     ray_tpu.init(num_cpus=cpus, _system_config={
         "scheduler_backend": "native",   # runtime envelope, not kernel
         "object_store_memory": 4 * 1024**3,
+        # Dispatch fast path: park idle leases briefly for direct push
+        # across bursts, prestart the burst's workers off the dispatch
+        # path.  Batching + wakeup debounce are on by default.
+        "worker_lease_keepalive_ms": 50,
+        "num_prestart_workers": cpus,
+        "prestart_on_submit": True,
     })
 
     quick = args.quick
     if args.dispatch_only:
-        bench_dispatch_latency(500 if quick else 2_000)
+        bench_dispatch_sweep((500, 2_000, 5_000))
         ray_tpu.shutdown()
         return 0
     rows = []

@@ -44,6 +44,49 @@ _TICK_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
                  0.1, 0.25, 1.0)
 
 
+class _LeaseBatch:
+    """Collector for one batched lease request: N entries, ONE reply.
+
+    Each entry resolves independently (grant when the local dispatch
+    path binds a worker, spillback during the scheduling pass, backlog
+    when the sweep withdraws it); the batch reply fires once, when the
+    last entry lands, carrying the ordered result vector — the
+    one-round-trip shape the wire protocol needs."""
+
+    __slots__ = ("results", "_remaining", "_reply", "_lock")
+
+    def __init__(self, n: int, reply: Callable):
+        self.results: list = [None] * n
+        self._remaining = n
+        self._reply = reply
+        self._lock = threading.Lock()
+
+    def resolve(self, idx: int, result: dict) -> None:
+        with self._lock:
+            if self.results[idx] is not None:
+                return          # duplicate resolution: first wins
+            self.results[idx] = result
+            self._remaining -= 1
+            done = self._remaining == 0
+        if done:
+            self._reply({"results": self.results})
+
+
+class _BatchEntry:
+    """Per-entry reply callable of a :class:`_LeaseBatch` — the queues
+    hold ``(spec, reply)`` pairs, and the backlog sweep recognizes batch
+    entries by this type to withdraw them."""
+
+    __slots__ = ("batch", "idx")
+
+    def __init__(self, batch: _LeaseBatch, idx: int):
+        self.batch = batch
+        self.idx = idx
+
+    def __call__(self, result: dict) -> None:
+        self.batch.resolve(self.idx, result)
+
+
 class ClusterTaskManager:
     def __init__(self, raylet):
         self._raylet = raylet
@@ -52,6 +95,14 @@ class ClusterTaskManager:
         self._infeasible: Dict[int, deque] = defaultdict(deque)
         self._view_version = -1
         self._jax_solver = None
+        # Event-driven wakeup coalescing: True while a tick is already
+        # scheduled but not yet started — further wakeup requests
+        # inside the debounce window fold into it (guarded by _lock).
+        self._wakeup_pending = False
+        # Lease batches whose unresolved entries the next tick's sweep
+        # may withdraw as backlog (guarded by _lock); a batch is swept
+        # only by a scheduling pass that STARTED after it was queued.
+        self._pending_batches: list = []
         # Tick telemetry: the hot path bumps these plain counters; the
         # scrape-time collector renders them at /metrics (the repo-wide
         # stats pattern — no registry lock on the tick path).  Only the
@@ -83,6 +134,8 @@ class ClusterTaskManager:
         def _collect(mgr):
             for k, v in mgr.tick_stats.items():
                 record_internal(f"ray_tpu.scheduler.tick.{k}", v, **label)
+            for k, v in mgr._raylet.lease_stats.items():
+                record_internal(f"ray_tpu.scheduler.{k}", v, **label)
             record_internal("ray_tpu.scheduler.pending_queue_depth",
                             mgr.num_queued(), **label)
             # The latency histogram is observed on the tick path, not
@@ -96,17 +149,37 @@ class ClusterTaskManager:
     def queue_and_schedule(self, spec: TaskSpec, reply: Callable):
         with self._lock:
             self._queues[spec.scheduling_class].append((spec, reply))
-        self._raylet.loop.post(self.schedule_and_dispatch, "cluster.schedule")
+        self._maybe_prestart(1)
+        self.request_tick()
+
+    def queue_and_schedule_batch(self, specs, reply: Callable):
+        """Batched lease entry (the dispatch fast path): N same-class
+        lease requests in one call, ONE reply carrying the ordered
+        grant/spillback/backlog vector.  Entries the first scheduling
+        pass can serve resolve through the normal dispatch machinery;
+        the pass's leftovers are withdrawn as ``backlog`` (or
+        ``infeasible``) by the sweep so the reply is one tick prompt
+        instead of deferred until the last worker frees — a deferred
+        batch reply would hold granted workers hostage behind entries
+        still waiting on the resources those workers occupy."""
+        batch = _LeaseBatch(len(specs), reply)
+        with self._lock:
+            for i, spec in enumerate(specs):
+                self._queues[spec.scheduling_class].append(
+                    (spec, _BatchEntry(batch, i)))
+            self._pending_batches.append(batch)
+        self._maybe_prestart(len(specs))
+        self.request_tick()
 
     def requeue_for_spill(self, spec: TaskSpec, reply: Callable):
         """A locally-queued task whose resources vanished (e.g. PG removed)
         goes back through cluster scheduling."""
         with self._lock:
             self._queues[spec.scheduling_class].appendleft((spec, reply))
-        self._raylet.loop.post(self.schedule_and_dispatch, "cluster.schedule")
+        self.request_tick()
 
     def on_resources_freed(self):
-        self._raylet.loop.post(self.schedule_and_dispatch, "cluster.schedule")
+        self.request_tick()
 
     def on_cluster_changed(self):
         """Retry infeasible queues when nodes/resources change (:125-159)."""
@@ -114,7 +187,38 @@ class ClusterTaskManager:
             for cls, q in self._infeasible.items():
                 self._queues[cls].extend(q)
                 q.clear()
-        self._raylet.loop.post(self.schedule_and_dispatch, "cluster.schedule")
+        self.request_tick()
+
+    def request_tick(self):
+        """Event-driven scheduling wakeup, coalesced: the first request
+        schedules the tick ``scheduler_wakeup_debounce_ms`` out and
+        every further request before it runs folds into it — a
+        submission burst becomes ONE batched solve instead of one tick
+        per arrival flooding the loop with redundant passes.  The
+        periodic ``event_loop_tick_ms`` tick stays as the fallback for
+        anything a wakeup edge misses."""
+        with self._lock:
+            if self._wakeup_pending:
+                return
+            self._wakeup_pending = True
+        debounce = get_config().scheduler_wakeup_debounce_ms / 1000.0
+        if debounce > 0:
+            self._raylet.loop.schedule_after(
+                debounce, self.schedule_and_dispatch, "cluster.schedule")
+        else:
+            self._raylet.loop.post(self.schedule_and_dispatch,
+                                   "cluster.schedule")
+
+    def _maybe_prestart(self, queued_now: int):
+        """Predictive warm-worker prestart from queue depth
+        (PrestartWorkers parity): fire-and-forget, bounded by
+        ``num_prestart_workers``; a no-op when the knob is 0 or the
+        pool already has enough idle+starting workers."""
+        cfg = get_config()
+        if not cfg.num_prestart_workers or not cfg.prestart_on_submit:
+            return
+        self._raylet.worker_pool.prestart_for_backlog(
+            self.num_queued() + queued_now, cfg.num_prestart_workers)
 
     # ---- the tick -------------------------------------------------------
     @loop_only("raylet")
@@ -128,6 +232,14 @@ class ClusterTaskManager:
         from ray_tpu._private.metrics_agent import observe_internal
         from ray_tpu.util import tracing
         cfg = get_config()
+        with self._lock:
+            # Requests arriving from here on need a fresh tick.
+            self._wakeup_pending = False
+            # Sweep set: batches queued BEFORE this pass starts — the
+            # pass below definitely considers their entries, so
+            # whatever it leaves queued is genuine backlog.  Batches
+            # queued mid-pass wait for the next tick.
+            sweep, self._pending_batches = self._pending_batches, []
         depth = self._total_queued()
         t0 = time.perf_counter()
         # One span per WORKING tick (idle ticks fire every
@@ -147,6 +259,9 @@ class ClusterTaskManager:
                 self.tick_stats["jnp_fallbacks"] += 1
             self._schedule_greedy()
         finally:
+            # Even when the pass raised: an unreplied batch entry left
+            # queued would defer the whole batch reply indefinitely.
+            self._resolve_batch_backlog(sweep)
             if span is not None:
                 span.__exit__(None, None, None)
             dt = time.perf_counter() - t0
@@ -248,8 +363,47 @@ class ClusterTaskManager:
             return
         with self._lock:
             self._queues[spec.scheduling_class].append((spec, reply))
-        self._raylet.loop.post(self.schedule_and_dispatch,
-                               "cluster.schedule")
+        self.request_tick()
+
+    def _resolve_batch_backlog(self, swept) -> None:
+        """Withdraw swept batches' entries the scheduling pass left
+        behind: still in ``_queues`` = feasible but no capacity this
+        tick (``backlog`` — the submitter keeps the task client-side
+        and re-pumps on its next progress edge); parked in
+        ``_infeasible`` = no node's totals fit (``infeasible`` — the
+        submitter re-leases it through the SINGLE-lease path, which
+        parks at the raylet exactly like today so the autoscaler's
+        ``resource_load`` demand stays visible until the cluster
+        changes)."""
+        if not swept:
+            return
+        swept_set = set(swept)
+        withdrawn = []
+        with self._lock:
+            for queues, infeasible in ((self._queues, False),
+                                       (self._infeasible, True)):
+                for q in queues.values():
+                    if not q:
+                        continue
+                    kept = [(spec, rep) for spec, rep in q
+                            if not (isinstance(rep, _BatchEntry) and
+                                    rep.batch in swept_set)]
+                    if len(kept) != len(q):
+                        withdrawn.extend(
+                            (rep, infeasible) for _s, rep in q
+                            if isinstance(rep, _BatchEntry) and
+                            rep.batch in swept_set)
+                        q.clear()
+                        q.extend(kept)
+        for rep, infeasible in withdrawn:
+            result = {"backlog": True}
+            if infeasible:
+                result["infeasible"] = True
+            try:
+                rep(result)
+            except Exception:
+                self.tick_stats["dispatch_errors"] += 1
+                logger.exception("batch backlog reply failed")
 
     def _schedule_greedy(self):
         """Reference-parity greedy loop: per class, per task, pick the best

@@ -64,8 +64,42 @@ class Config:
     autoscaler_kernel_backend: str = "auto"
     autoscaler_kernel_min_cells: int = 2048
     #: Max lease requests in flight per scheduling class
-    #: (ray_config_def.h:342).
+    #: (ray_config_def.h:342).  Batched lease requests count each
+    #: entry against this cap.
     max_pending_lease_requests_per_scheduling_category: int = 10
+    #: Max lease entries coalesced into ONE request_worker_lease_batch
+    #: round-trip (the dispatch fast path: a same-class burst leases up
+    #: to this many workers per RPC instead of one).  1 disables
+    #: batching (every lease rides the single-lease RPC).
+    lease_batch_size: int = 10
+    #: Retry delay for lease-batch entries the raylet returned as
+    #: ``backlog`` (feasible but no capacity this tick) when nothing
+    #: else — a completion, a new submit, a lease reply — re-pumps the
+    #: class first.  Pure fallback; the common re-pump is event-driven.
+    lease_backlog_retry_ms: int = 20
+    #: How long an idle LEASED worker is parked submitter-side before
+    #: its lease is returned to the raylet (lease pipelining: a
+    #: same-class task submitted within the window is pushed directly,
+    #: zero scheduling round-trips).  Trade-off: a parked lease HOLDS
+    #: its resource reservation for up to the window, so other
+    #: scheduling classes see less capacity; keep it at request-gap
+    #: scale.  0 = off (return leases immediately, current behavior).
+    worker_lease_keepalive_ms: int = 0
+    #: Submit-side flow control: when a scheduling class's transport
+    #: queue is deeper than this at submit time, the submitting thread
+    #: yields the GIL (``time.sleep(0)``) so executing workers can
+    #: drain — a tight submission loop otherwise starves the very
+    #: pipeline it is filling and every queued task's latency grows by
+    #: the imbalance.  A yield, not a block: semantics are unchanged,
+    #: and shallow queues never hit it.  0 disables.
+    submit_backpressure_depth: int = 64
+    #: Event-driven scheduling wakeup debounce: a task arrival /
+    #: resource release schedules the tick this many ms out, and
+    #: further wakeups inside the window coalesce into that one tick —
+    #: a submission burst becomes one batched solve instead of one tick
+    #: per task.  0 = post the tick immediately (no coalescing).  The
+    #: periodic event_loop_tick_ms tick remains as fallback.
+    scheduler_wakeup_debounce_ms: float = 1.0
     #: GCS-side actor scheduling (ray_config_def.h:463).
     gcs_actor_scheduling_enabled: bool = False
 
@@ -131,6 +165,21 @@ class Config:
     worker_process_mode: str = "thread"
     #: Soft cap of idle workers kept alive per node (ray_config_def.h:129).
     num_workers_soft_limit: int = 64
+    #: Warm-worker prestart target (reference ``PrestartWorkers``,
+    #: worker_pool.h:350): when queued work outnumbers idle+starting
+    #: workers, the dispatch loop starts workers AHEAD of pop_worker up
+    #: to this many total, so a burst doesn't pay per-task worker
+    #: startup inline.  Memory trade-off: every prestarted worker holds
+    #: a thread stack (thread mode) or a whole Python interpreter
+    #: (process mode, tens of MB each) even if the burst never
+    #: materializes — size it to expected burst width, not max_workers.
+    #: 0 = off (workers start lazily in pop_worker, current behavior).
+    num_prestart_workers: int = 0
+    #: Also prestart from the SUBMIT edge (cluster task manager queue
+    #: arrival), not just the local dispatch loop — fires before
+    #: scheduling, so workers warm while the solve runs.  No effect
+    #: unless num_prestart_workers > 0.
+    prestart_on_submit: bool = False
     #: Seconds an idle worker thread lingers before exit.
     idle_worker_killing_time_threshold_ms: int = 1000
     #: Maximum workers starting up concurrently (reference semantics:

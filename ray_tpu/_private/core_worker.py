@@ -693,7 +693,14 @@ def _is_device_array(value) -> bool:
     jax = sys.modules.get("jax")
     if jax is None:
         return False
-    return isinstance(value, jax.Array) and not value.is_deleted()
+    try:
+        return isinstance(value, jax.Array) and not value.is_deleted()
+    except AttributeError:
+        # jax is mid-import on ANOTHER thread (the module is in
+        # sys.modules before its attributes exist) — same race the
+        # serialization path guards.  A partially-imported jax has no
+        # live device arrays to mishandle.
+        return False
 
 
 class _ReconState:

@@ -16,6 +16,7 @@ Named points wired into the runtime (grep ``fault_injection.hook``):
 ``transfer.chunk``        per received chunk of a streamed object transfer
 ``node.heartbeat``        before a raylet sends its GCS heartbeat
 ``worker.dispatch``       before a scheduled task is handed to local dispatch
+``worker.lease_batch``    before a batched lease request enters scheduling
 ========================  ====================================================
 
 Modes:

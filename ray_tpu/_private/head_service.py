@@ -225,6 +225,33 @@ class RemoteNodeProxy:
 
         self.client.call_async("request_worker_lease", spec, on_reply)
 
+    def request_worker_lease_batch(self, specs, reply):
+        """Batched lease protocol over the wire: N same-class lease
+        entries in ONE RPC; the reply vector's grant tokens are wrapped
+        into remote worker handles exactly like the single path.  A
+        connection error rejects every entry (the submitter's transient
+        re-lease machinery takes over)."""
+
+        def on_reply(result, err):
+            if err is not None:
+                reply({"results": [
+                    {"rejected": True,
+                     "reason": f"node connection lost: {err}"}
+                    for _ in specs]})
+                return
+            results = (result or {}).get("results") or []
+            for r in results:
+                token = r.pop("worker_token", None)
+                if token is not None:
+                    with self._tokens_lock:
+                        self._held_tokens.add(token)
+                    r["worker"] = _RemoteWorkerHandle(self, token)
+                    r["raylet"] = self
+            reply({"results": results})
+
+        self.client.call_async("request_worker_lease_batch",
+                               {"specs": specs}, on_reply)
+
     def return_worker(self, worker, disconnect: bool = False):
         token = worker.worker_id.binary()
         # Mirror the node's own bookkeeping: a dedicated actor worker's

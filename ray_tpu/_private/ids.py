@@ -11,9 +11,29 @@ hot path, and object indices are carried separately in the object-ref metadata.
 from __future__ import annotations
 
 import os
+import random
 import threading
 
 _ID_SIZE = 16  # 128-bit, matches reference UniqueID size.
+
+# ID generation is on the task-submission hot path (TaskID + one
+# ObjectID per return), and ``os.urandom`` is a real syscall per call —
+# measured at >200us under sandboxed kernels, which made it THE
+# dominant cost of ``remote()``.  IDs need uniqueness, not
+# cryptographic strength: draw them from a per-thread PRNG seeded once
+# from urandom (+ pid + thread id, so forks and threads can't share a
+# stream).
+_rand_local = threading.local()
+
+
+def _random_bytes(n: int) -> bytes:
+    rng = getattr(_rand_local, "rng", None)
+    if rng is None or _rand_local.pid != os.getpid():
+        seed = int.from_bytes(os.urandom(16), "little") \
+            ^ (os.getpid() << 64) ^ threading.get_ident()
+        rng = _rand_local.rng = random.Random(seed)
+        _rand_local.pid = os.getpid()
+    return rng.getrandbits(n * 8).to_bytes(n, "little")
 
 
 class BaseID:
@@ -32,7 +52,7 @@ class BaseID:
 
     @classmethod
     def from_random(cls):
-        return cls(os.urandom(cls.SIZE))
+        return cls(_random_bytes(cls.SIZE))
 
     @classmethod
     def from_hex(cls, hex_str: str):

@@ -15,6 +15,7 @@ from collections import deque
 from typing import Callable, Dict, List
 
 from ray_tpu import exceptions
+from ray_tpu._private.config import get_config
 from ray_tpu._private.task_spec import TaskSpec
 from ray_tpu.scheduler.resources import ResourceRequest
 from ray_tpu._private.debug import diag_lock, diag_rlock
@@ -130,6 +131,17 @@ class LocalTaskManager:
     # cluster view's local row is the authoritative NodeResources map),
     # so dispatch only needs a worker slot.
     def dispatch(self):
+        prestart_bound = get_config().num_prestart_workers
+        if prestart_bound:
+            # Predictive warm-worker prestart from dispatch-queue depth
+            # (PrestartWorkers parity): start the burst's workers on a
+            # side thread while this loop binds the first ones, instead
+            # of paying each startup inline in pop_worker.
+            with self._lock:
+                backlog = len(self._dispatch_queue)
+            if backlog > 1:
+                self._raylet.worker_pool.prestart_for_backlog(
+                    backlog, prestart_bound)
         while True:
             with self._lock:
                 if not self._dispatch_queue:

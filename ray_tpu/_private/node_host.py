@@ -630,6 +630,8 @@ class NodeHost:
             name=f"nodehost-{self.raylet.node_id.hex()[:6]}")
         s = self.server
         s.register_async("request_worker_lease", self._handle_lease)
+        s.register_async("request_worker_lease_batch",
+                         self._handle_lease_batch)
         s.register_async("push_task", self._handle_push)
         s.register_async("assign_actor", self._handle_assign_actor)
         s.register_async("push_actor_task", self._handle_push_actor_task)
@@ -686,6 +688,30 @@ class NodeHost:
             reply(result)
 
         self.raylet.request_worker_lease(spec, on_reply)
+
+    def _handle_lease_batch(self, payload, reply):
+        """Batched lease RPC (one round-trip for up to lease_batch_size
+        grants): package each granted worker as a lease token exactly
+        like the single-lease handler; spillback/backlog/reject entries
+        pass through untouched."""
+        import time
+
+        def on_reply(result):
+            out = []
+            for r in result.get("results") or []:
+                worker = r.pop("worker", None)
+                r.pop("raylet", None)
+                if worker is not None:
+                    token = worker.worker_id.binary()
+                    with self._workers_lock:
+                        self._workers[token] = worker
+                        self._grant_times[token] = time.monotonic()
+                    r["worker_token"] = token
+                    r["node_id"] = self.raylet.node_id.binary()
+                out.append(r)
+            reply({"results": out})
+
+        self.raylet.request_worker_lease_batch(payload["specs"], on_reply)
 
     def _worker(self, token: bytes):
         with self._workers_lock:
@@ -879,6 +905,10 @@ class NodeHost:
     def shutdown(self):
         self.stopped = True
         self._stop_event.set()
+        try:
+            self.adapter.gcs.task_events.stop()
+        except Exception:
+            pass
         try:
             self.raylet.shutdown()
         except Exception:

@@ -63,6 +63,13 @@ class Raylet:
         self.cluster_task_manager = ClusterTaskManager(self)
         self.object_manager = NodeObjectManager(self, cluster.object_directory)
         self.core_worker = None      # wired by the cluster/driver
+        # Lease-protocol round-trip counters (plain bumps on the hot
+        # path, rendered by the tick collector): the dispatch fast
+        # path's "a 500-task burst costs dozens of RPCs, not 500" claim
+        # is asserted against lease_requests + lease_batch_requests.
+        self.lease_stats = {"lease_requests": 0,
+                            "lease_batch_requests": 0,
+                            "lease_batch_entries": 0}
         self._dead = False
         self._host_stats = None
         self._host_stats_ts = 0.0
@@ -206,7 +213,34 @@ class Raylet:
         if self._dead:
             reply({"rejected": True, "reason": "node dead"})
             return
+        self.lease_stats["lease_requests"] += 1
         self.cluster_task_manager.queue_and_schedule(spec, reply)
+
+    def request_worker_lease_batch(self, specs, reply: Callable):
+        """Batched HandleRequestWorkerLease: lease up to len(specs)
+        workers of one scheduling class in ONE round-trip.  ``reply``
+        fires once with ``{"results": [...]}`` ordered like ``specs``;
+        each result is a grant (``worker``/``raylet``), a spillback
+        (``retry_at``), a rejection, or ``backlog`` (feasible but no
+        capacity this tick — the submitter keeps the task and re-pumps;
+        with ``infeasible: True`` it re-leases through the single-lease
+        path, which parks raylet-side until the cluster changes)."""
+        if self._dead:
+            reply({"results": [{"rejected": True, "reason": "node dead"}
+                               for _ in specs]})
+            return
+        self.lease_stats["lease_batch_requests"] += 1
+        self.lease_stats["lease_batch_entries"] += len(specs)
+        try:
+            # Chaos point: bounce a WHOLE batch (the submitter must
+            # fall back to single leases without burning task retries).
+            fault_injection.hook("worker.lease_batch")
+        except Exception as e:
+            reply({"results": [{"rejected": True, "batch_fault": True,
+                                "reason": f"lease batch fault: {e}"}
+                               for _ in specs]})
+            return
+        self.cluster_task_manager.queue_and_schedule_batch(specs, reply)
 
     def return_worker(self, worker, disconnect: bool = False):
         """HandleReturnWorker: release lease + resources."""

@@ -845,6 +845,44 @@ class WorkerPool:
                     self._all[w.worker_id] = w
                     self._idle.append(w)
 
+    def prestart_for_backlog(self, depth: int, bound: int) -> int:
+        """Predictive warm-worker prestart (``PrestartWorkers``,
+        worker_pool.h:350): bring idle+starting up to
+        ``min(depth, bound)`` workers ahead of ``pop_worker`` so a
+        queued burst doesn't pay worker startup one task at a time on
+        the dispatch path.  The construction runs on a throwaway daemon
+        thread — a process-mode spawn storm must block neither the
+        raylet loop nor the submitting thread.  Returns the shortfall
+        this call saw (0 = pool already warm enough).  Leased workers
+        count as serving the backlog (they cycle back through reuse),
+        and the hard worker cap bounds the target — otherwise a
+        saturated pool would spawn a futile no-op thread on EVERY
+        submit/dispatch edge of a burst."""
+
+        def shortfall() -> int:
+            # Callers hold self._lock.
+            warm = len(self._idle) + self._starting + len(self._leased)
+            room = self._max_workers - len(self._all) - self._starting
+            return min(min(depth, bound) - warm, room)
+
+        with self._lock:
+            want = shortfall()
+        if want <= 0:
+            return 0
+
+        def _prestart():
+            # Re-check under the pool lock at spawn time: concurrent
+            # prestart calls and pop_worker starts shrink the shortfall
+            # between the caller's check and this thread running.
+            with self._lock:
+                n = shortfall()
+            if n > 0:
+                self.prestart_workers(n)
+
+        threading.Thread(target=_prestart, daemon=True,
+                         name="ray_tpu::prestart").start()
+        return want
+
     def pop_worker(self, runtime_env=None) -> Optional[Worker]:
         """Lease an idle worker, starting one if under the cap
         (WorkerPool::PopWorker, worker_pool.h:338).  In process mode

@@ -461,4 +461,5 @@ class GcsServer:
         self._node_death_listeners.append(cb)
 
     def shutdown(self):
+        self.task_events.stop()
         self.loop.stop()

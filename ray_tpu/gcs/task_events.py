@@ -93,8 +93,13 @@ class TaskEventBuffer:
     parity): ``emit`` is the hot-path call — append under a lock, no
     I/O; batches go out over the pubsub channel when the buffer reaches
     ``batch_size`` or ``flush_interval`` has elapsed since the last
-    flush (checked on emit — no dedicated thread), or on an explicit
-    ``flush()`` from the query layer (read-your-writes)."""
+    flush, or on an explicit ``flush()`` from the query layer
+    (read-your-writes).  The actual flush+ingest runs on a dedicated
+    (lazily started) daemon thread, reference io_service parity: a
+    flush delivers the batch straight into the manager's ingest — a
+    couple of ms for a full batch — and paying that inline on whichever
+    WORKER thread happened to cross the threshold put a hard stall in
+    the task hot path's latency tail.  ``emit`` only signals."""
 
     def __init__(self, publisher, buffer_id: str = "head",
                  max_buffer: int = 8192, batch_size: int = 256,
@@ -118,6 +123,12 @@ class TaskEventBuffer:
         self._events: List[dict] = []
         self._last_flush = time.monotonic()
         self.dropped = 0          # cumulative, rides every batch
+        # Lazily-started flusher thread (see class docstring): emit
+        # signals, the thread flushes; stop() on GCS/node shutdown so
+        # per-test clusters don't accumulate parked threads.
+        self._flush_wake = threading.Event()
+        self._flusher_started = False
+        self._stopped = False
 
     def emit(self, task_id, state: str, *, name: str = "",
              job_id: str = "", task_type: str = "NORMAL_TASK",
@@ -146,17 +157,60 @@ class TaskEventBuffer:
         if error is not None:
             ev["error"] = str(error)[:500]
         flush_now = False
+        start_flusher = False
+        inline_flush = False
         with self._lock:
             if len(self._events) >= self._max_buffer:
                 self.dropped += 1
                 return
             self._events.append(ev)
-            if len(self._events) >= self._batch_size or \
+            depth = len(self._events)
+            if depth >= self._batch_size or \
                     time.monotonic() - self._last_flush \
                     >= self._flush_interval:
                 flush_now = True
-        if flush_now:
+                # High-water backstop: the off-thread flusher removed
+                # the inline backpressure that used to bound the
+                # buffer, so a GIL-starved flusher under a hot burst
+                # could overflow max_buffer and silently drop events.
+                # Past half the buffer the emitting thread pays the
+                # flush itself — backpressure over loss.
+                inline_flush = depth >= self._max_buffer // 2
+                if not self._flusher_started:
+                    self._flusher_started = True
+                    start_flusher = True
+        if start_flusher:
+            threading.Thread(
+                target=self._flusher_loop, daemon=True,
+                name=f"ray_tpu::task-events::{self._buffer_id[:16]}"
+            ).start()
+        if inline_flush:
             self.flush()
+        elif flush_now:
+            self._flush_wake.set()
+
+    def _flusher_loop(self):
+        from ray_tpu._private.debug import swallow
+        while not self._stopped:
+            self._flush_wake.wait(timeout=self._flush_interval)
+            if self._stopped:
+                return
+            self._flush_wake.clear()
+            try:
+                self.flush()
+            except Exception as e:
+                # Publish failures are already counted inside flush;
+                # anything else must not kill the flusher silently.
+                swallow.noted("task_events.flush", e)
+
+    def stop(self):
+        """Shut the flusher down, draining tail events first."""
+        self._stopped = True
+        self._flush_wake.set()
+        try:
+            self.flush()
+        except Exception:
+            pass
 
     def flush(self) -> None:
         with self._flush_lock:
@@ -333,6 +387,13 @@ class TaskEventManager:
             window.append(dt)
             observe_internal("ray_tpu.task.dispatch_stage_seconds", dt,
                              buckets=_STAGE_BUCKETS, stage=stage)
+
+    def reset_stage_samples(self) -> None:
+        """Clear the per-stage sample windows (bench sweeps measure one
+        concurrency level per window; the /metrics histogram keeps its
+        cumulative trend)."""
+        with self._lock:
+            self._stage_samples.clear()
 
     def latency_summary(self) -> Dict[str, dict]:
         """Per-stage p50/p99 rollup over the recent sample window
