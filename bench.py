@@ -185,6 +185,40 @@ def _dispatch_latency_rows():
     return {"rows": rows}
 
 
+def _broadcast_relay_row():
+    """Run bench_runtime.py --broadcast-only in a subprocess (CPU-side
+    runtime, never touches the chip) and return the parsed
+    broadcast_relay sweep row, or a structured skip dict — the data
+    plane's collective-transfer claim (relay-arm >= 3x naive, origin
+    <= 2x fair share) rides every bench.py invocation."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench_runtime.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, path, "--broadcast-only"],
+            env=env, capture_output=True, text=True, timeout=1200)
+    except subprocess.TimeoutExpired:
+        return {"skipped": True, "reason": "broadcast bench timed out"}
+    # Parse the row even on rc!=0: the sweep prints its data BEFORE
+    # exiting 1 on a fair-share violation — the honest failure must
+    # reach the JSON, not collapse into a skip.
+    for line in proc.stdout.strip().splitlines():
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if row.get("metric") == "broadcast_relay":
+            if proc.returncode != 0:
+                row["failed"] = True
+                row["failed_rc"] = proc.returncode
+            return row
+    return {"skipped": True,
+            "reason": f"no broadcast_relay row in output "
+                      f"(rc={proc.returncode}): "
+                      f"{(proc.stderr or '')[-400:]}"}
+
+
 def main():
     probe = _probe()
     probed_cpu = not probe.get("ok") or probe.get("backend") != "tpu"
@@ -329,6 +363,13 @@ def main():
     # end-to-end through ray_tpu.remote by a CPU-side subprocess (the
     # chip is untouched), folded into the headline row.  The headline
     # dispatch_p99_ms stays the n=500 row for cross-round continuity.
+    # Data-plane collective axis: relay-vs-naive broadcast sweep
+    # (64/256 MiB x 8/16/32 in-process stores, modeled link time,
+    # per-source served-bytes balance), folded as broadcast_relay.
+    res["broadcast_relay"] = {
+        k: v for k, v in _broadcast_relay_row().items()
+        if k not in ("metric", "value", "unit")}
+
     dispatch = _dispatch_latency_rows()
     if dispatch.get("skipped"):
         res["dispatch_p99_ms"] = None

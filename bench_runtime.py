@@ -387,6 +387,201 @@ def bench_broadcast(mb, n_nodes):
                 pass
 
 
+def _run_broadcast_arm(cluster, nodes, mb, relay, link_delay_s):
+    """One broadcast of a fresh ``mb``-MiB object to every node in
+    ``nodes``, with a modeled per-chunk link delay (the
+    ``transfer.chunk`` fault point in delay mode — receiver-side, one
+    sleep per chunk, overlapping across concurrent transfers exactly
+    like link time does).  Returns (seconds, served-bytes per source
+    [head first], relay-served delta)."""
+    import gc
+    import threading
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu._private import fault_injection
+    from ray_tpu._private.config import get_config
+
+    cfg = get_config()
+    cfg.object_transfer_relay_enabled = relay
+    cfg.object_transfer_source_selection = "load" if relay else "first"
+    head = cluster.head_node
+    data = np.ones(mb * 1024 * 1024, dtype=np.uint8)
+    ref = ray_tpu.put(data)
+    oid = ref.object_id()
+    del data
+    stores = [head.object_store] + [n.object_store for n in nodes]
+    served_before = [s.stats["outbound_served_bytes"] for s in stores]
+    relayed_before = sum(s.stats["relay_served_bytes"] for s in stores)
+    fault_injection.arm("transfer.chunk", "delay", count=-1,
+                        delay_s=link_delay_s)
+    try:
+        t0 = time.monotonic()
+        events, results = [], []
+        for node in nodes:
+            ev = threading.Event()
+            res = {}
+
+            def cb(ok, ev=ev, res=res):
+                res["ok"] = ok
+                ev.set()
+
+            node.object_manager.pull_async(oid, cb)
+            events.append(ev)
+            results.append(res)
+            if relay:
+                # Stagger only until the pull's transfer writer exists:
+                # a chain link can only attach to an OBSERVABLE
+                # in-flight transfer.  The stagger is inside the timed
+                # region — it is part of the relay arm's real cost.
+                deadline = time.monotonic() + 10.0
+                while time.monotonic() < deadline and \
+                        node.object_store.num_partials() == 0 and \
+                        not ev.is_set():
+                    time.sleep(0.002)
+        for ev in events:
+            assert ev.wait(timeout=900), "broadcast pull timed out"
+        dt = time.monotonic() - t0
+    finally:
+        fault_injection.disarm("transfer.chunk")
+    assert all(r.get("ok") for r in results), \
+        f"{sum(not r.get('ok') for r in results)} pulls failed"
+    served = [s.stats["outbound_served_bytes"] - b
+              for s, b in zip(stores, served_before)]
+    relayed = sum(s.stats["relay_served_bytes"]
+                  for s in stores) - relayed_before
+    for node in nodes:
+        node.object_store.delete(oid)
+        cluster.object_directory.remove_location(oid, node.node_id)
+    del ref
+    gc.collect()
+    # The release cascade is deferred (drain thread): wait for the
+    # origin copy to actually leave the head store before the next arm
+    # charges its budget.
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline and \
+            head.object_store.contains(oid):
+        time.sleep(0.01)
+    return dt, served, relayed
+
+
+def bench_broadcast_relay(sweep=((64, 8), (64, 16), (64, 32),
+                                 (256, 8), (256, 16), (256, 32)),
+                          link_time_s=0.8):
+    """broadcast_relay row: relay-vs-naive broadcast sweep.
+
+    Same-box model of the cluster envelope's GiB broadcast: per-chunk
+    link time is injected (``transfer.chunk`` delay, scaled so every
+    hop costs ``link_time_s`` of modeled link regardless of size) and
+    the sender admission cap is 1 per store — a shared source NIC
+    serves N full-object streams in N x link-time no matter the
+    concurrency, which is exactly what the cap models.  Both arms run
+    under the SAME cap and delay; the only difference is relay +
+    load-aware selection vs first-row selection (the pre-relay code
+    path).  Memcpy cost is NOT modeled — it is real, identical in both
+    arms, and serialized by the host's actual core count (recorded:
+    a 1-core runner understates the speedup; see cpu_throttled).
+
+    Asserts the collective property: in the relay arm the origin
+    serves <= 2x its fair share of the bytes moved."""
+    import shutil
+
+    import ray_tpu
+    from ray_tpu._private.config import get_config
+    from ray_tpu._private.worker import global_worker
+
+    cluster = global_worker().cluster
+    cfg = get_config()
+    saved = {k: getattr(cfg, k) for k in
+             ("object_manager_chunk_size",
+              "object_transfer_max_outbound_sessions",
+              "object_transfer_relay_enabled",
+              "object_transfer_source_selection")}
+    chunk = 1024 * 1024
+    cfg.object_manager_chunk_size = chunk
+    cfg.object_transfer_max_outbound_sessions = 1
+    results = []
+    try:
+        for mb, n_nodes in sweep:
+            need = (n_nodes + 2) * mb * 1024 * 1024
+            try:
+                free = shutil.disk_usage("/dev/shm").free
+            except OSError:
+                free = need
+            if need > free // 2:
+                results.append({"mb": mb, "n_nodes": n_nodes,
+                                "skipped": True,
+                                "reason": f"needs {need} bytes of shm, "
+                                          f"{free} free"})
+                continue
+            per_node_store = max(2 * mb, 64) * 1024 * 1024
+            nodes = [cluster.add_node(num_cpus=0,
+                                      object_store_memory=per_node_store)
+                     for _ in range(n_nodes)]
+            try:
+                delay = link_time_s / mb      # 1 MiB chunks: mb chunks
+                naive_s, naive_served, _ = _run_broadcast_arm(
+                    cluster, nodes, mb, relay=False, link_delay_s=delay)
+                relay_s, relay_served, relayed = _run_broadcast_arm(
+                    cluster, nodes, mb, relay=True, link_delay_s=delay)
+            finally:
+                for node in nodes:
+                    try:
+                        cluster.remove_node(node)
+                    except Exception:
+                        pass
+            total = max(sum(relay_served), 1)
+            fair = total / (n_nodes + 1)
+            origin_ratio = relay_served[0] / fair
+            results.append({
+                "mb": mb, "n_nodes": n_nodes,
+                # The collective claim (origin <= 2x fair share in the
+                # relay arm, one chunk of rounding slack), RECORDED per
+                # config — a violation must not abort the envelope's
+                # remaining rows; --broadcast-only turns it into rc=1.
+                "origin_fair_ok":
+                    bool(relay_served[0] <= 2 * fair + chunk),
+                "naive_s": round(naive_s, 2),
+                "relay_s": round(relay_s, 2),
+                "speedup": round(naive_s / relay_s, 2),
+                "origin_served_mb": round(relay_served[0] / 2**20, 1),
+                "origin_fair_ratio": round(origin_ratio, 2),
+                "naive_origin_served_mb":
+                    round(naive_served[0] / 2**20, 1),
+                "relayed_mb": round(relayed / 2**20, 1),
+                "served_balance_mb": [round(s / 2**20, 1)
+                                      for s in relay_served],
+            })
+    finally:
+        for k, v in saved.items():
+            setattr(cfg, k, v)
+    cores = os.cpu_count() or 1
+    best = {}
+    for r in results:
+        if not r.get("skipped"):
+            best.setdefault("speedup_min", r["speedup"])
+            best["speedup_min"] = min(best["speedup_min"], r["speedup"])
+    acceptance = next((r for r in results
+                       if r.get("mb") == 256 and r.get("n_nodes") == 16
+                       and not r.get("skipped")), None)
+    return emit("broadcast_relay", len(results), "configs",
+                modeled_link_time_s_per_hop=link_time_s,
+                admission_cap=1, chunk_mb=1,
+                cores=cores,
+                # Real memcpy on few cores dilutes the modeled-link
+                # speedup: mark it so the trajectory reads honestly.
+                cpu_throttled=cores < 4,
+                fair_share_ok=all(r.get("origin_fair_ok", True)
+                                  for r in results),
+                acceptance_256x16=(
+                    None if acceptance is None else
+                    {"speedup": acceptance["speedup"],
+                     "origin_fair_ratio":
+                         acceptance["origin_fair_ratio"]}),
+                sweep=results, **best)
+
+
 def _synthetic_view(n_nodes, rng):
     """A heterogeneous ClusterResourceView without a live cluster —
     the PG/autoscaler solves are pure functions of the view."""
@@ -589,6 +784,10 @@ def main():
     parser.add_argument("--dispatch-only", action="store_true",
                         help="run only the dispatch-latency row "
                              "(bench.py folds this into its JSON)")
+    parser.add_argument("--broadcast-only", action="store_true",
+                        help="run only the relay-vs-naive broadcast "
+                             "sweep (bench.py folds this into its "
+                             "JSON)")
     args = parser.parse_args()
 
     import jax
@@ -611,6 +810,13 @@ def main():
         bench_dispatch_sweep((500, 2_000, 5_000))
         ray_tpu.shutdown()
         return 0
+    if args.broadcast_only:
+        row = bench_broadcast_relay()
+        ray_tpu.shutdown()
+        # The fair-share property is the acceptance gate here: the row
+        # is already printed (bench.py parses stdout regardless of rc),
+        # so a violation surfaces as rc=1 WITHOUT losing the data.
+        return 0 if row.get("fair_share_ok", True) else 1
     rows = []
     rows.append(bench_tasks(1_000 if quick else 10_000))
     rows.append(bench_dispatch_latency(500 if quick else 2_000))
@@ -626,6 +832,9 @@ def main():
     rows.append(bench_object_gb(0.25 if quick else 1.0))
     rows.append(bench_broadcast(64 if quick else 256,
                                 4 if quick else 8))
+    rows.append(bench_broadcast_relay(
+        sweep=((64, 4),) if quick else ((64, 8), (256, 16)),
+        link_time_s=0.4 if quick else 0.8))
     rows.append(bench_process_mode_objects(8 if quick else 32,
                                            3 if quick else 10))
     queued = args.queued if args.queued is not None else \

@@ -138,6 +138,32 @@ class Config:
     #: window of this many pipelined chunk RPCs open to hide round-trip
     #: latency (push_manager.cc ack window / pull retry flow).
     object_transfer_pipeline_depth: int = 8
+    #: Sender-side transfer admission: max concurrent OUTBOUND transfer
+    #: sessions per store (chunk sessions + in-process store-to-store
+    #: copies share the cap).  Excess pulls queue FIFO instead of
+    #: thrashing every session's window (push_manager.cc bounded
+    #: chunks-in-flight, made a per-store budget).
+    object_transfer_max_outbound_sessions: int = 4
+    #: How long a ``fetch_meta`` waits in the sender's FIFO admission
+    #: queue before replying ``busy`` (the receiver then backs off or
+    #: re-selects another source).
+    object_transfer_admission_wait_s: float = 1.0
+    #: Chunk-level relay: a node mid-pull registers a PARTIAL location
+    #: row and serves the already-assembled prefix of its in-flight
+    #: transfer to downstream pullers, so a 1->N broadcast completes as
+    #: a pipelined chain/tree instead of N full copies out of the
+    #: origin.  Off = every pull streams from a full copy only.
+    object_transfer_relay_enabled: bool = True
+    #: Source selection for pulls with multiple known locations:
+    #: "load" weighs candidates by live per-source outbound load
+    #: (sessions + queue + in-flight bytes), "first" keeps the naive
+    #: first-directory-row choice (the pre-relay behavior; the bench's
+    #: naive arm).
+    object_transfer_source_selection: str = "load"
+    #: Server-side wait for the assembly watermark to advance past a
+    #: relay chunk request before replying ``pending`` (the receiver
+    #: re-requests that chunk).
+    object_transfer_relay_wait_s: float = 2.0
 
     # ------ core worker / task path ------
     #: Args at or below this size are inlined into the task spec

@@ -14,6 +14,8 @@ Named points wired into the runtime (grep ``fault_injection.hook``):
 ``spill.write``           before a spill batch is written to disk
 ``restore.read``          before a spilled object is read back
 ``transfer.chunk``        per received chunk of a streamed object transfer
+``transfer.relay``        per relay read served from an IN-FLIGHT transfer's
+                          assembled prefix (chunk relay, sender side)
 ``node.heartbeat``        before a raylet sends its GCS heartbeat
 ``worker.dispatch``       before a scheduled task is handed to local dispatch
 ``worker.lease_batch``    before a batched lease request enters scheduling

@@ -132,7 +132,8 @@ class _ProxyObjectStore:
 
     def fetch_into(self, object_id: ObjectID, local_store,
                    pipeline: int = 8, on_chunk=None,
-                   timeout: float = 300.0):
+                   timeout: float = 300.0,
+                   busy_patience_s: Optional[float] = None):
         """Streamed head-side pull from a spoke: the windowed chunk
         pipeline assembles directly into a reserved block of the head's
         segment (same zero-copy receive path the spokes use)."""
@@ -140,7 +141,8 @@ class _ProxyObjectStore:
         try:
             return fetch_object_into(
                 self._proxy.client, object_id, local_store,
-                pipeline=pipeline, on_chunk=on_chunk, timeout=timeout)
+                pipeline=pipeline, on_chunk=on_chunk, timeout=timeout,
+                busy_patience_s=busy_patience_s)
         except Exception:
             return None
 
@@ -363,6 +365,10 @@ class HeadService:
         s.register("put_inline", self._handle_put_inline)
         s.register("add_location", self._handle_add_location)
         s.register("remove_location", self._handle_remove_location)
+        s.register("add_partial_location",
+                   self._handle_add_partial_location)
+        s.register("remove_partial_location",
+                   self._handle_remove_partial_location)
         s.register("get_locations", self._handle_get_locations)
         s.register("get_node_address", self._handle_get_node_address)
         s.register_async("wait_object", self._handle_wait_object)
@@ -384,10 +390,22 @@ class HeadService:
                 return None
             return segment_chunk_source(head.object_store)(oid_bin)
 
+        def _head_partial_source(oid_bin):
+            from ray_tpu._private.object_store import partial_chunk_source
+            head = cluster.head_node
+            if head is None:
+                return None
+            return partial_chunk_source(head.object_store)(oid_bin)
+
+        head_store = cluster.head_node.object_store \
+            if cluster.head_node is not None else None
         self.chunk_server = serve_chunks(
             s, lambda oid_bin: self._handle_fetch_object(
                 {"object_id": oid_bin}),
-            get_source=_head_segment_source)
+            get_source=_head_segment_source,
+            get_partial=_head_partial_source,
+            ledger=head_store.transfer_ledger
+            if head_store is not None else None)
         # Remote-driver surface (Ray Client parity): drivers in other
         # processes connect via init(address="ray-tpu://host:port").
         from ray_tpu._private.client_service import register_client_surface
@@ -603,18 +621,61 @@ class HeadService:
             ObjectID(payload["object_id"]), NodeID(payload["node_id"]))
         return True
 
+    def _handle_add_partial_location(self, payload):
+        """Register a spoke's in-flight pull as a relayable PARTIAL
+        directory row; replies with the row's seq (the cycle-free
+        ordering relay chains rely on)."""
+        directory = self._cluster.object_directory
+        if not hasattr(directory, "add_partial_location"):
+            return None
+        return directory.add_partial_location(
+            ObjectID(payload["object_id"]), NodeID(payload["node_id"]))
+
+    def _handle_remove_partial_location(self, payload) -> bool:
+        directory = self._cluster.object_directory
+        if hasattr(directory, "remove_partial_location"):
+            directory.remove_partial_location(
+                ObjectID(payload["object_id"]),
+                NodeID(payload["node_id"]))
+        return True
+
+    def _node_transfer_load(self, node_id: NodeID) -> Optional[dict]:
+        """Outbound-transfer load hint for a directory answer: the
+        head's own ledger is read live; spokes' ride their resource
+        reports (at most one poll stale)."""
+        head = self._cluster.head_node
+        if head is not None and node_id == head.node_id:
+            return head.object_store.transfer_ledger.load_snapshot()
+        proxy = self._proxy_for(node_id)
+        if proxy is not None:
+            return (proxy._last_report or {}).get("transfer_load")
+        return None
+
     def _handle_get_locations(self, payload):
         """Locations WITH dialable addresses: peers use these to pull
         node-to-node directly (OwnershipBasedObjectDirectory parity —
         the directory answer is what makes the plane peer-to-peer).
         Head-resident copies carry host=None: the asking spoke already
-        holds a head connection."""
+        holds a head connection.  Each row carries the source's
+        outbound-load hint (load-aware selection) and partial relay
+        rows ride along flagged ``partial`` with their seq — legacy
+        spokes that only want full copies filter on the flag."""
         oid = ObjectID(payload["object_id"])
+        directory = self._cluster.object_directory
+        if hasattr(directory, "get_candidates"):
+            rows = directory.get_candidates(oid)
+        else:
+            rows = [{"node_id": n, "partial": False, "seq": 0}
+                    for n in directory.get_locations(oid)]
         out = []
         seen = set()
-        for node_id in self._cluster.object_directory.get_locations(oid):
+        for row in rows:
+            node_id = row["node_id"]
             entry = {"node_id": node_id.binary(), "host": None,
-                     "port": None}
+                     "port": None, "partial": bool(row.get("partial")),
+                     "seq": int(row.get("seq") or 0),
+                     "size": int(row.get("size") or 0),
+                     "load": self._node_transfer_load(node_id)}
             proxy = self._proxy_for(node_id)
             if proxy is not None:
                 entry["host"], entry["port"] = proxy.address
@@ -624,7 +685,8 @@ class HeadService:
         if head is not None and head.node_id.binary() not in seen and \
                 self._owner_inline_blob(oid) is not None:
             out.append({"node_id": head.node_id.binary(),
-                        "host": None, "port": None})
+                        "host": None, "port": None, "partial": False,
+                        "seq": 0, "load": None})
         return out
 
     def _handle_get_node_address(self, payload):

@@ -210,7 +210,9 @@ class _PeerStoreReader:
 
     def fetch_into(self, object_id: ObjectID, local_store,
                    pipeline: int = 8, on_chunk=None,
-                   timeout: float = 300.0) -> Optional[int]:
+                   timeout: float = 300.0,
+                   busy_patience_s: Optional[float] = None
+                   ) -> Optional[int]:
         """Streamed pull: assemble the windowed chunk pipeline DIRECTLY
         into a reserved block of ``local_store`` (no intermediate
         ``bytearray`` — the zero-copy receive half of the data plane).
@@ -223,7 +225,8 @@ class _PeerStoreReader:
             try:
                 nbytes = fetch_object_into(
                     client, object_id, local_store, pipeline=pipeline,
-                    on_chunk=on_chunk, timeout=timeout)
+                    on_chunk=on_chunk, timeout=timeout,
+                    busy_patience_s=busy_patience_s)
             except exc.ObjectStoreFullError as err:
                 # LOCAL store cannot take the object: the peer is not
                 # at fault (don't tear its link down) and the head leg
@@ -365,23 +368,59 @@ class _RemoteDirectory:
              "node_id": node_id.binary()},
             lambda _r, _e: None)
 
+    def add_partial_location(self, object_id: ObjectID,
+                             node_id: NodeID) -> int:
+        """Register this node's in-flight pull as a relayable PARTIAL
+        row at the head's directory.  Synchronous: the returned seq is
+        what makes relay chains cycle-free (we may only relay FROM
+        lower-seq rows), so the pull cannot proceed without it."""
+        seq = self._host.client.call(
+            "add_partial_location",
+            {"object_id": object_id.binary(),
+             "node_id": node_id.binary()},
+            timeout=10.0)
+        if seq is None:
+            raise RuntimeError("head rejected partial registration")
+        return int(seq)
+
+    def remove_partial_location(self, object_id: ObjectID,
+                                node_id: NodeID):
+        self._host.client.call_async(
+            "remove_partial_location",
+            {"object_id": object_id.binary(),
+             "node_id": node_id.binary()},
+            lambda _r, _e: None)
+
     def remove_object(self, object_id):
         pass
 
-    def get_locations(self, object_id: ObjectID):
+    def _entries(self, object_id: ObjectID):
         try:
             locs = self._host.client.call(
                 "get_locations", {"object_id": object_id.binary()},
                 timeout=10.0)
         except Exception:
-            return set()
-        out = set()
+            return []
         for entry in locs:
-            node_id = NodeID(entry["node_id"])
             self._host.peers.note_address(
-                node_id, entry.get("host"), entry.get("port"))
-            out.add(node_id)
-        return out
+                NodeID(entry["node_id"]), entry.get("host"),
+                entry.get("port"))
+        return locs
+
+    def get_locations(self, object_id: ObjectID):
+        return {NodeID(e["node_id"]) for e in self._entries(object_id)
+                if not e.get("partial")}
+
+    def get_candidates(self, object_id: ObjectID):
+        """Full + partial rows with the head's load hints (the spoke
+        has no cross-node ledger visibility — the head's resource polls
+        carry each node's outbound-transfer load)."""
+        return [{"node_id": NodeID(e["node_id"]),
+                 "partial": bool(e.get("partial")),
+                 "seq": int(e.get("seq") or 0),
+                 "size": int(e.get("size") or 0),
+                 "load": e.get("load")}
+                for e in self._entries(object_id)]
 
     def subscribe_location(self, object_id: ObjectID, cb: Callable):
         """One async ``wait_object`` call: the head blocks event-driven
@@ -653,12 +692,18 @@ class NodeHost:
         s.register("fault_fired",
                    lambda p: fault_injection.fired(p["point"]))
         s.register("stop", self._handle_stop)
-        from ray_tpu._private.object_store import segment_chunk_source
+        from ray_tpu._private.object_store import (partial_chunk_source,
+                                                   segment_chunk_source)
         from ray_tpu.rpc.chunked import serve_chunks
         self.chunk_server = serve_chunks(
             s, lambda oid_bin: self._handle_fetch_object(
                 {"object_id": oid_bin}),
-            get_source=segment_chunk_source(self.raylet.object_store))
+            get_source=segment_chunk_source(self.raylet.object_store),
+            # Relay: downstream peers stream the assembled prefix of a
+            # transfer still landing here; outbound sessions are
+            # charged to the store's admission ledger.
+            get_partial=partial_chunk_source(self.raylet.object_store),
+            ledger=self.raylet.object_store.transfer_ledger)
         self._stop_event = threading.Event()
 
         # Join the cluster (NodeInfoGcsService RegisterNode parity).

@@ -25,17 +25,25 @@ from ray_tpu._private.debug import diag_lock
 
 def fetch_object_into(client, object_id: ObjectID, local_store,
                       pipeline: int = 8, on_chunk=None,
-                      timeout: float = 300.0):
+                      timeout: float = 300.0,
+                      busy_patience_s: Optional[float] = None):
     """One complete streamed pull over ``client``: negotiate the chunk
     session (inline reply / busy-backoff / windowed pipeline) and
     assemble the object DIRECTLY into a reserved block of
     ``local_store`` via ``create_transfer_writer`` — the shared receive
     half of the zero-copy data plane, used by spoke-to-peer, spoke-to-
     head and head-to-spoke pulls alike.  Returns the flat byte count on
-    success, None on failure/absence."""
+    success, None on failure/absence.
+
+    ``busy_patience_s`` bounds how long ``busy`` replies are retried
+    against THIS source before giving up (the caller re-selects a
+    less-loaded location); None = retry until the pull deadline (the
+    single-source behavior — a storm degrades to queuing)."""
     from ray_tpu._private.serialization import SerializedObject
     from ray_tpu.rpc.chunked import fetch_session_into
     deadline = time.monotonic() + timeout
+    busy_deadline = None if busy_patience_s is None else \
+        time.monotonic() + busy_patience_s
     backoff = 0.02
     while True:
         meta = client.call("fetch_meta",
@@ -51,8 +59,11 @@ def fetch_object_into(client, object_id: ObjectID, local_store,
                 on_chunk(len(blob), 0)
             return len(blob)
         if meta.get("busy"):
-            # Sender admission control: back off and retry.
-            if time.monotonic() >= deadline:
+            # Sender admission control: back off and retry (bounded by
+            # busy_patience_s when the caller has other sources).
+            now = time.monotonic()
+            if now >= deadline or \
+                    (busy_deadline is not None and now >= busy_deadline):
                 return None
             time.sleep(backoff)
             backoff = min(backoff * 2, 1.0)
@@ -106,6 +117,57 @@ class ObjectDirectory:
         # through the directory, not just locations.
         self._sizes: Dict[ObjectID, int] = {}
         self._subscribers: Dict[ObjectID, List[Callable]] = {}
+        # PARTIAL rows (chunk relay): node -> registration seq for
+        # objects a node is mid-pull of and can relay the assembled
+        # prefix of.  Never surfaced through get_locations — only
+        # get_candidates — so every legacy caller keeps full-copy
+        # semantics.
+        self._partials: Dict[ObjectID, Dict[NodeID, int]] = {}
+        self._partial_seq: Dict[ObjectID, int] = {}
+
+    def add_partial_location(self, object_id: ObjectID,
+                             node_id: NodeID) -> int:
+        """Register a PARTIAL location row: ``node_id`` is mid-pull of
+        the object and can relay its assembled prefix downstream.
+        Returns the row's per-object sequence number — a puller may
+        relay only from rows with a LOWER seq than its own, so relay
+        edges point strictly backward in registration order and chains
+        are cycle-free by construction."""
+        with self._lock:
+            seq = self._partial_seq.get(object_id, 0) + 1
+            self._partial_seq[object_id] = seq
+            self._partials.setdefault(object_id, {})[node_id] = seq
+        return seq
+
+    def remove_partial_location(self, object_id: ObjectID,
+                                node_id: NodeID):
+        """Drop a partial row (transfer sealed into a full row, or
+        aborted).  The per-object seq counter is deliberately kept
+        while the object lives: a fresh registration must never reuse
+        a seq an in-flight puller already compares against."""
+        with self._lock:
+            rows = self._partials.get(object_id)
+            if rows:
+                rows.pop(node_id, None)
+                if not rows:
+                    del self._partials[object_id]
+
+    def get_candidates(self, object_id: ObjectID) -> List[dict]:
+        """Every source a pull may stream from: full rows
+        (``partial=False, seq=0``) plus partial relay rows with their
+        registration seq.  Rows carry the object's size hint so
+        pullers can skip relay bookkeeping for sub-chunk objects."""
+        with self._lock:
+            size = self._sizes.get(object_id, 0)
+            full = self._locations.get(object_id, set())
+            out = [{"node_id": n, "partial": False, "seq": 0,
+                    "size": size}
+                   for n in full]
+            for n, seq in (self._partials.get(object_id) or {}).items():
+                if n not in full:
+                    out.append({"node_id": n, "partial": True,
+                                "seq": seq, "size": size})
+        return out
 
     def add_location(self, object_id: ObjectID, node_id: NodeID,
                      size: Optional[int] = None):
@@ -136,6 +198,8 @@ class ObjectDirectory:
         with self._lock:
             self._locations.pop(object_id, None)
             self._sizes.pop(object_id, None)
+            self._partials.pop(object_id, None)
+            self._partial_seq.pop(object_id, None)
             # A freed object can never gain a location; drop its waiters
             # (wait() wakeup hooks would otherwise accumulate forever).
             self._subscribers.pop(object_id, None)
@@ -181,6 +245,11 @@ class ObjectDirectory:
                         del self._locations[oid]
                         self._sizes.pop(oid, None)
                         lost.append(oid)
+            # A dead node can relay nothing: prune its partial rows so
+            # downstream pullers stop being routed to it.
+            for oid, rows in list(self._partials.items()):
+                if rows.pop(node_id, None) is not None and not rows:
+                    del self._partials[oid]
         return lost
 
 
@@ -208,7 +277,11 @@ class NodeObjectManager:
                       "cross_node_fetch_bytes": 0,
                       "chunks_transferred": 0, "failed_pulls": 0,
                       "transfer_gbps_last": 0.0,
-                      "inflight_window_peak": 0}
+                      "inflight_window_peak": 0,
+                      # Collective-transfer counters: pulls streamed
+                      # from a relay (partial) source, and admission
+                      # waits abandoned for a less-loaded source.
+                      "relay_pulls": 0, "load_reselects": 0}
         from ray_tpu._private.metrics_agent import (get_metrics_registry,
                                                     record_internal)
         nid = raylet.node_id.hex()[:12]
@@ -255,7 +328,7 @@ class NodeObjectManager:
             for w in waiters:
                 w(ok)
 
-        def attempt(node_id):
+        def attempt(_hint=None):
             if self.is_local_or_inline(object_id):
                 finish(True)
                 return
@@ -264,14 +337,13 @@ class NodeObjectManager:
             # leaving every waiter (and all future pulls of this id,
             # parked on the orphaned inflight entry) hung forever.
             try:
-                ok = self._fetch_from(object_id, node_id)
+                ok = self._pull_once(object_id)
             except Exception:
                 ok = False
             finish(ok)
 
-        locations = self._directory.get_locations(object_id)
-        if locations:
-            self._pull_pool.submit(attempt, next(iter(locations)))
+        if self._candidate_rows(object_id):
+            self._pull_pool.submit(attempt)
             return
         # Freed object: nothing will ever produce it again — fail fast
         # instead of subscribing forever (the caller may try lineage
@@ -298,19 +370,209 @@ class NodeObjectManager:
     def stop(self):
         self._pull_pool.stop()
 
-    def _retry_other_location(self, object_id: ObjectID,
-                              tried: set) -> bool:
-        """A source was unusable (dead, stale, failed copy): try the
-        remaining known locations before declaring the pull failed —
-        one bad directory row must not fail a pull the other rows could
-        have served."""
-        for other in self._directory.get_locations(object_id):
-            if other not in tried:
-                return self._fetch_from(object_id, other, tried)
-        return False
+    # ---- source selection (load-aware, relay-capable) -------------------
+    #: Bounded pull rounds: each consumes one candidate source (or one
+    #: tried-set reset); a pull that cannot land in this many attempts
+    #: reports failure to its waiters (lineage recovery decides next).
+    MAX_SOURCE_ROUNDS = 16
+    #: Sentinel: the source was merely BUSY and a freer one exists —
+    #: re-run selection without marking the source as failed.
+    _RESELECT = object()
+
+    def _candidate_rows(self, object_id: ObjectID) -> List[dict]:
+        d = self._directory
+        if hasattr(d, "get_candidates"):
+            return d.get_candidates(object_id)
+        return [{"node_id": n, "partial": False, "seq": 0}
+                for n in d.get_locations(object_id)]
+
+    def _source_load(self, row: dict):
+        """Live outbound-load score for a candidate source: the
+        in-process ledger when the source shares this process (exact),
+        else the load hint the directory reply carried (head-reported,
+        at most one resource-poll stale), else zero."""
+        raylet = self._raylet.cluster.gcs.raylet(row["node_id"])
+        store = getattr(raylet, "object_store", None)
+        ledger = getattr(store, "transfer_ledger", None)
+        if ledger is not None:
+            return ledger.load_score()
+        report = getattr(raylet, "_last_report", None)
+        hint = row.get("load") or (report or {}).get("transfer_load")
+        if hint:
+            return (int(hint.get("active", 0)) + int(hint.get("queued",
+                                                              0)),
+                    int(hint.get("inflight_bytes", 0)))
+        return (0, 0)
+
+    def _source_has_free_slot(self, row: dict) -> bool:
+        raylet = self._raylet.cluster.gcs.raylet(row["node_id"])
+        store = getattr(raylet, "object_store", None)
+        ledger = getattr(store, "transfer_ledger", None)
+        return ledger is not None and ledger.has_free_slot()
+
+    def _select_source(self, object_id: ObjectID, tried: set,
+                       my_seq: Optional[int],
+                       require_free: bool = False,
+                       rows: Optional[List[dict]] = None
+                       ) -> Optional[dict]:
+        """Pick the pull source (returns the candidate ROW, or None):
+        weigh candidates by live outbound load (so concurrent pulls of
+        one object spread across every node that holds a copy),
+        admitting PARTIAL relay rows only with a lower registration seq
+        than ours (cycle-free chains).  Ties break toward the
+        HIGHEST-seq partial — the most recently started transfer, i.e.
+        the deepest link of the chain, which is exactly where a new
+        puller extends it.
+
+        ``require_free`` restricts to sources with a free admission
+        slot RIGHT NOW (the mid-queue re-selection probe; load mode
+        only — the naive arm queues where it first landed)."""
+        cfg = get_config()
+        if require_free and \
+                cfg.object_transfer_source_selection != "load":
+            return None
+        local_id = self._raylet.node_id
+        allow_partial = cfg.object_transfer_relay_enabled and \
+            my_seq is not None
+        usable = []
+        if rows is None:
+            rows = self._candidate_rows(object_id)
+        for row in rows:
+            nid = row["node_id"]
+            if nid is None or nid in tried:
+                continue
+            if nid == local_id:
+                # A stale SELF-row (our copy was dropped after the row
+                # was written, e.g. a vanished-entry heal): "pulling
+                # from ourselves" can never succeed — drop the lying
+                # row.  Our own partial registration is skipped
+                # silently.
+                if not row.get("partial") and \
+                        not self._raylet.object_store.contains(object_id):
+                    self._directory.remove_location(object_id, local_id)
+                continue
+            if row.get("partial"):
+                if not allow_partial or row.get("seq", 0) >= my_seq:
+                    continue
+            usable.append(row)
+        if not usable:
+            return None
+        if cfg.object_transfer_source_selection != "load":
+            # Naive arm: first full directory row (pre-relay behavior).
+            for row in usable:
+                if not row.get("partial"):
+                    return row
+            return usable[0]
+        if require_free:
+            usable = [r for r in usable if self._source_has_free_slot(r)]
+            if not usable:
+                return None
+        return min(usable,
+                   key=lambda r: (self._source_load(r),
+                                  -int(r.get("seq", 0))))
+
+    def _relay_worthwhile(self, object_id: ObjectID,
+                          rows: List[dict]) -> bool:
+        """Partial-row registration gate: only multi-chunk objects can
+        ever serve a relay (the store-side writer gate is the same), so
+        sub-chunk pulls skip the directory round-trip entirely.  An
+        unknown size (0) registers — functional-safe."""
+        size = max((int(r.get("size") or 0) for r in rows), default=0)
+        if size == 0:
+            hint = getattr(self._directory, "size_hint", None)
+            if hint is not None:
+                size = hint(object_id)
+        return size == 0 or size > get_config().object_manager_chunk_size
+
+    def _pull_once(self, object_id: ObjectID) -> bool:
+        """One complete pull: register our PARTIAL directory row first
+        (downstream pullers can chain off our in-flight transfer), then
+        stream from load-ranked sources until one delivers, healing or
+        skipping bad rows along the way."""
+        cfg = get_config()
+        tried: set = set()
+        my_seq = None
+        local_id = self._raylet.node_id
+        first_rows = self._candidate_rows(object_id)
+        if cfg.object_transfer_relay_enabled and \
+                hasattr(self._directory, "add_partial_location") and \
+                self._relay_worthwhile(object_id, first_rows):
+            try:
+                my_seq = self._directory.add_partial_location(object_id,
+                                                              local_id)
+            except Exception:
+                my_seq = None       # relay off for this pull; still safe
+        try:
+            reset_used = False
+            partial_failures = 0
+            for _round in range(self.MAX_SOURCE_ROUNDS):
+                if self.is_local_or_inline(object_id):
+                    return True
+                # After a couple of failed relay attempts, stop chasing
+                # partial rows: in a simultaneous burst many rows exist
+                # BEFORE any transfer writer does (each fails in
+                # milliseconds), and a pull must degrade to queuing at
+                # a full copy, never fail while the origin is healthy.
+                # The LAST round enforces exactly that: full rows only,
+                # no reselect, unbounded patience — reselect bounces
+                # and busy sources can consume rounds, never the pull.
+                final = _round >= self.MAX_SOURCE_ROUNDS - 1
+                eff_seq = my_seq if partial_failures < 2 and not final \
+                    else None
+                rows = first_rows if first_rows is not None else \
+                    self._candidate_rows(object_id)
+                first_rows = None       # later rounds re-fetch (load)
+                row = self._select_source(object_id, tried, eff_seq,
+                                          rows=rows)
+                if row is None:
+                    if tried and not reset_used:
+                        # Every candidate was consumed by transient
+                        # failures (busy sources, a dead relay): one
+                        # fresh pass over the directory before giving
+                        # up — the rows may have changed under us.
+                        tried.clear()
+                        reset_used = True
+                        continue
+                    # Exhausted for good: one last PATIENT attempt on
+                    # the best FULL row, if any — a merely-busy source
+                    # must queue us to its grant, never fail the pull
+                    # (the per-round busy-patience that consumed the
+                    # rows above is bounded; this attempt is not).
+                    tried.clear()
+                    last = self._select_source(object_id, tried, None)
+                    if last is None:
+                        return False
+                    return self._fetch_from(object_id,
+                                            last["node_id"], tried,
+                                            None,
+                                            others_available=False)
+                target = row["node_id"]
+                # Busy-patience only makes sense when somewhere else to
+                # go existed at selection time (no extra directory RPC:
+                # probed against the SAME row snapshot).
+                others = (not final) and self._select_source(
+                    object_id, tried | {target}, eff_seq,
+                    rows=rows) is not None
+                if self._fetch_from(object_id, target, tried, eff_seq,
+                                    others_available=others):
+                    return True
+                # Only a GENUINE relay failure counts toward the cap: a
+                # load-reselect took the target back out of ``tried``
+                # (it was merely busy, not dead).
+                if row.get("partial") and target in tried:
+                    partial_failures += 1
+            return False
+        finally:
+            if my_seq is not None:
+                try:
+                    self._directory.remove_partial_location(object_id,
+                                                            local_id)
+                except Exception:
+                    pass
 
     def _fetch_from(self, object_id: ObjectID, node_id: NodeID,
-                    _tried: Optional[set] = None) -> bool:
+                    tried: set, my_seq: Optional[int] = None,
+                    others_available: bool = False) -> bool:
         """Streamed transfer of the serialized object from a remote node
         store into the local store (ObjectBufferPool chunk assembly
         parity) — single-copy end to end:
@@ -324,25 +586,20 @@ class NodeObjectManager:
           reservation under a source-side pin.
 
         Per-transfer throughput and the in-flight window peak are
-        exported through the metrics agent."""
-        tried = set() if _tried is None else _tried
+        exported through the metrics agent.  Returns True only when the
+        object is local afterwards; a False return left ``node_id`` in
+        ``tried`` unless the source was merely busy (the caller's
+        selection loop retries the others)."""
         tried.add(node_id)
         local_id = self._raylet.node_id
-        if node_id == local_id:
-            if self._raylet.object_store.contains(object_id):
-                # The object landed locally since the caller's check
-                # (concurrent put/restore): the pull's goal is met.
-                return True
-            # A stale SELF-location (the local copy was dropped after
-            # the directory row was written — e.g. a vanished-entry
-            # heal): "pulling from ourselves" can never succeed.  Drop
-            # the lying row and pull from a genuine remote copy.
-            self._directory.remove_location(object_id, local_id)
-            return self._retry_other_location(object_id, tried)
+        if node_id == local_id or node_id is None:
+            # The object landed locally since the caller's check
+            # (concurrent put/restore) — or a None row from a timed-out
+            # remote wait_object.
+            return self._raylet.object_store.contains(object_id)
         source = self._raylet.cluster.gcs.raylet(node_id)
         if source is None:
-            # Source died; try another location or give up.
-            return self._retry_other_location(object_id, tried)
+            return False            # source died; caller tries others
         from ray_tpu.util import tracing
         transfer_span = tracing.span(
             "object.transfer", category="transfer",
@@ -365,12 +622,24 @@ class NodeObjectManager:
             if hasattr(reader, "fetch_into"):
                 # Cross-process peer: pipelined chunk stream into the
                 # local segment (PullManager admission + ack flow).
+                # With other untried sources on the board (known from
+                # the caller's row snapshot — no extra directory RPC),
+                # bound the busy-retry patience so a saturated sender
+                # makes us re-select instead of camping in its backoff
+                # loop.
+                patience = None
+                if others_available:
+                    patience = max(
+                        2.0,
+                        2 * get_config().object_transfer_admission_wait_s)
                 nbytes = reader.fetch_into(
                     object_id, self._raylet.object_store,
                     pipeline=get_config().object_transfer_pipeline_depth,
-                    on_chunk=on_chunk)
+                    on_chunk=on_chunk, busy_patience_s=patience)
             elif isinstance(reader, NodeObjectStore):
-                nbytes = self._copy_local(object_id, reader, on_chunk)
+                nbytes = self._pull_in_process(
+                    object_id, reader, node_id, tried, my_seq,
+                    on_chunk, allow_reselect=others_available)
             else:
                 nbytes = self._copy_via_serialized(object_id, reader,
                                                    on_chunk)
@@ -378,11 +647,17 @@ class NodeObjectManager:
             transfer_span.meta["ok"] = False
             transfer_span.__exit__(None, None, None)
             raise
+        if nbytes is self._RESELECT:
+            # Busy source with a freer alternative: not a failure — the
+            # caller re-ranks (the source was taken back OUT of tried).
+            transfer_span.meta["ok"] = "reselect"
+            transfer_span.__exit__(None, None, None)
+            return False
         if nbytes is None:
             self.stats["failed_pulls"] += 1
             transfer_span.meta["ok"] = False
             transfer_span.__exit__(None, None, None)
-            return self._retry_other_location(object_id, tried)
+            return False
         self.stats["pulled_objects"] += 1
         # The object is local either way — the location row is true
         # even when a racing transfer moved the bytes.
@@ -409,6 +684,103 @@ class NodeObjectManager:
         transfer_span.meta["bytes"] = nbytes
         transfer_span.__exit__(None, None, None)
         return True
+
+    def _pull_in_process(self, object_id: ObjectID,
+                         src: "NodeObjectStore", node_id: NodeID,
+                         tried: set, my_seq: Optional[int], on_chunk,
+                         allow_reselect: bool = True):
+        """In-process store-to-store pull under sender admission:
+        FIFO-queue on the source's outbound ledger, but keep probing
+        for a source with a FREE slot while queued — a relay one hop
+        downstream beats waiting behind the origin's queue, which is
+        exactly what turns a simultaneous 1→N burst into a pipelined
+        chain.  Returns the byte count, None on failure, or
+        ``_RESELECT`` (the caller re-ranks; this source stays
+        un-tried)."""
+        ledger = getattr(src, "transfer_ledger", None)
+        if ledger is None:
+            return self._copy_local(object_id, src, on_chunk)
+        deadline = time.monotonic() + 300.0
+        # One ticket for the whole wait: the FIFO position is KEPT
+        # across the bounded polls the better-source probes ride on
+        # (re-enqueueing per poll would let steady remote admits starve
+        # an in-process waiter forever).
+        ticket = ledger.enqueue()
+        while not ledger.wait_grant(ticket, timeout=0.25):
+            if time.monotonic() >= deadline:
+                ledger.cancel(ticket)
+                return None
+            if not allow_reselect:
+                continue        # final patient round: queue to grant
+            better = self._select_source(object_id, tried, my_seq,
+                                         require_free=True)
+            if better is not None:
+                # Leave this source's queue without branding it failed.
+                ledger.cancel(ticket)
+                tried.discard(node_id)
+                self.stats["load_reselects"] += 1
+                return self._RESELECT
+        try:
+            relay = None
+            if not src.contains(object_id):
+                relay = src.open_relay_source(object_id)
+            if relay is not None:
+                nbytes = self._relay_copy_local(object_id, relay,
+                                                on_chunk)
+                if nbytes:
+                    ledger.note_served(nbytes, relay=True)
+                    self.stats["relay_pulls"] += 1
+                return nbytes
+            nbytes = self._copy_local(object_id, src, on_chunk)
+            if nbytes:
+                ledger.note_served(nbytes)
+            return nbytes
+        finally:
+            ledger.release()
+
+    def _relay_copy_local(self, object_id: ObjectID, relay,
+                          on_chunk) -> Optional[int]:
+        """Chunk-copy the assembled prefix of a peer's IN-FLIGHT
+        transfer into a local reservation, chasing its watermark — the
+        in-process leg of chain relay.  An upstream abort fails this
+        transfer cleanly (writer aborted, caller re-selects); a stalled
+        upstream is abandoned after a progress timeout."""
+        nbytes = relay.nbytes
+        store = self._raylet.object_store
+        writer = store.create_transfer_writer(object_id, nbytes)
+        if writer is None:
+            return 0             # a concurrent pull already delivered it
+        chunk = get_config().object_manager_chunk_size
+        step_wait = max(get_config().object_transfer_relay_wait_s, 0.1)
+        try:
+            off = 0
+            last_progress = time.monotonic()
+            while off < nbytes:
+                end = min(off + chunk, nbytes)
+                try:
+                    data = relay.read_range(off, end, timeout=step_wait)
+                except TimeoutError:
+                    # Upstream alive but not yet past ``end``: keep
+                    # chasing, bounded by a no-progress cap.
+                    if time.monotonic() - last_progress > 60.0:
+                        writer.abort()
+                        return None
+                    continue
+                except Exception:
+                    writer.abort()
+                    return None
+                if data is None:          # upstream transfer died
+                    writer.abort()
+                    return None
+                writer.write(off, data)
+                on_chunk(len(data), 0)
+                off = end
+                last_progress = time.monotonic()
+            writer.seal()
+        except BaseException:
+            writer.abort()
+            raise
+        return nbytes
 
     def _copy_local(self, object_id: ObjectID, src: "NodeObjectStore",
                     on_chunk) -> Optional[int]:

@@ -206,6 +206,317 @@ class MemoryStore:
             return len(self._entries)
 
 
+class TransferLedger:
+    """Sender-side outbound-transfer accounting for ONE store: active
+    sessions, in-flight bytes, and a FIFO overflow queue (transfer
+    admission — push_manager.cc's bounded concurrent sends, made a
+    per-store budget).  Both outbound legs share it: chunk sessions a
+    ChunkServer admits for remote pullers, and in-process
+    store-to-store copies.  Gauges land in the owning store's ``stats``
+    dict so they ride the existing /metrics collector and
+    ``ray-tpu memory``.
+
+    The condition is a LEAF lock: nothing else is ever acquired under
+    it, so any thread (RPC handlers, pull pools) may block in
+    ``try_acquire`` safely.
+    """
+
+    __slots__ = ("_cond", "_active", "_inflight", "_queue", "stats")
+
+    def __init__(self, stats: dict):
+        self._cond = diag_condition(name="TransferLedger._cond")
+        self._active = 0
+        self._inflight = 0
+        self._queue: list = []        # FIFO of waiter tokens
+        self.stats = stats
+        for key in ("outbound_sessions_active", "outbound_inflight_bytes",
+                    "transfer_admission_queue_depth",
+                    "transfer_admission_waits",
+                    "outbound_served_bytes", "relay_served_bytes"):
+            stats.setdefault(key, 0)
+
+    def _sync_gauges_locked(self) -> None:
+        self.stats["outbound_sessions_active"] = self._active
+        self.stats["outbound_inflight_bytes"] = self._inflight
+        self.stats["transfer_admission_queue_depth"] = len(self._queue)
+
+    def enqueue(self) -> object:
+        """Join the FIFO admission queue; returns a ticket that KEEPS
+        its position across bounded ``wait_grant`` polls (a waiter that
+        probes for better sources between polls must not forfeit its
+        turn).  Pair with ``wait_grant``/``cancel``."""
+        token = object()
+        with self._cond:
+            self._queue.append(token)
+            self._sync_gauges_locked()
+            if len(self._queue) > 1 or self._active >= max(
+                    1, get_config().object_transfer_max_outbound_sessions):
+                self.stats["transfer_admission_waits"] += 1
+        return token
+
+    def wait_grant(self, token, timeout: Optional[float] = None,
+                   nbytes: int = 0) -> bool:
+        """Bounded wait for ``token`` to reach the queue head with a
+        free slot.  False on timeout — the ticket KEEPS its position
+        (call again, or ``cancel`` to leave the queue)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while True:
+                cap = max(1, get_config()
+                          .object_transfer_max_outbound_sessions)
+                if self._queue and self._queue[0] is token and \
+                        self._active < cap:
+                    self._queue.pop(0)
+                    self._active += 1
+                    self._inflight += int(nbytes)
+                    self._sync_gauges_locked()
+                    # The pop changed who is head: with cap > 1 the
+                    # next waiter may be grantable NOW — wake it
+                    # instead of letting it ride the 0.2 s poll.
+                    self._cond.notify_all()
+                    return True
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cond.wait(timeout=0.2 if remaining is None
+                                else min(remaining, 0.2))
+
+    def cancel(self, token) -> None:
+        """Leave the queue without a grant (timeout / re-selection)."""
+        with self._cond:
+            if token in self._queue:
+                self._queue.remove(token)
+                self._sync_gauges_locked()
+                # The head of the queue may have become grantable.
+                self._cond.notify_all()
+
+    def try_acquire(self, nbytes: int = 0,
+                    timeout: Optional[float] = None) -> bool:
+        """FIFO slot acquisition; True on grant.  A timeout leaves the
+        queue (False) — the caller replies busy / re-selects another
+        source.  ``timeout=None`` waits indefinitely (in-process pulls
+        bound the wait with their own deadline)."""
+        token = self.enqueue()
+        if self.wait_grant(token, timeout=timeout, nbytes=nbytes):
+            return True
+        self.cancel(token)
+        return False
+
+    def release(self, nbytes: int = 0) -> None:
+        with self._cond:
+            self._active = max(0, self._active - 1)
+            self._inflight = max(0, self._inflight - int(nbytes))
+            self._sync_gauges_locked()
+            self._cond.notify_all()
+
+    def note_served(self, nbytes: int, relay: bool = False) -> None:
+        with self._cond:
+            self.stats["outbound_served_bytes"] += int(nbytes)
+            if relay:
+                self.stats["relay_served_bytes"] += int(nbytes)
+
+    def load_score(self) -> Tuple[int, int]:
+        """(sessions incl. queued, in-flight bytes) — the live signal
+        load-aware source selection ranks candidates by."""
+        with self._cond:
+            return (self._active + len(self._queue), self._inflight)
+
+    def has_free_slot(self) -> bool:
+        with self._cond:
+            cap = max(1, get_config()
+                      .object_transfer_max_outbound_sessions)
+            return not self._queue and self._active < cap
+
+    def load_snapshot(self) -> dict:
+        """Wire form for resource reports (head-side load hints)."""
+        with self._cond:
+            return {"active": self._active, "queued": len(self._queue),
+                    "inflight_bytes": self._inflight}
+
+
+class _PartialTransfer:
+    """Relay surface over ONE in-flight transfer writer: tracks the
+    contiguous assembly watermark and serves prefix reads to downstream
+    pullers while the upstream chunks are still landing — the chain
+    half of the collective broadcast path.
+
+    Lifecycle: registered by the transfer writer (the single-writer
+    guarantee means at most one per (object, store)), advanced per
+    landed chunk, quiesced+promoted at seal (later reads go through the
+    sealed entry) or failed at abort (readers get None and re-select a
+    different source).
+
+    Safety: the prefix memcpy runs OUTSIDE the condition under a
+    reader count; seal/abort wait for readers to drain BEFORE the
+    backing block is sealed-registered/deleted, so a relay read can
+    never observe recycled bytes.  A read never crosses the watermark —
+    no torn chunks.  The condition is a leaf from the reader side
+    (readers touch no store lock while holding it)."""
+
+    __slots__ = ("store", "object_id", "nbytes", "_cond", "_watermark",
+                 "_ooo", "_readers", "_failed", "_sealing", "_sealed",
+                 "_read_raw", "_raw_after_seal", "_sealed_cache")
+
+    def __init__(self, store: "NodeObjectStore", object_id: ObjectID,
+                 nbytes: int, read_raw):
+        self.store = store
+        self.object_id = object_id
+        self.nbytes = nbytes
+        self._cond = diag_condition(name="_PartialTransfer._cond")
+        self._watermark = 0
+        self._ooo: Dict[int, int] = {}   # offset -> end, out-of-order
+        self._readers = 0
+        self._failed = False
+        self._sealing = False
+        self._sealed = False
+        self._read_raw = read_raw        # (start, end) -> buffer view
+        # Heap-backed writers keep their raw buffer valid past seal
+        # (nothing ever recycles a private bytearray): tail relay reads
+        # stay O(chunk) instead of re-materializing via the store.
+        self._raw_after_seal = False
+        # One-time flat materialization for sealed entries with no
+        # O(chunk) read surface (python-held winner of a put race) —
+        # without it every tail chunk would re-flatten the whole
+        # object.
+        self._sealed_cache: Optional[bytes] = None
+
+    # ---- writer side ---------------------------------------------------
+    def advance(self, offset: int, length: int) -> None:
+        """A chunk landed at [offset, offset+length): extend the
+        contiguous watermark (the chunk pipeline assembles in order, so
+        the out-of-order stash is almost always empty)."""
+        with self._cond:
+            self._ooo[offset] = offset + length
+            while self._watermark in self._ooo:
+                self._watermark = self._ooo.pop(self._watermark)
+            self._cond.notify_all()
+
+    def quiesce_for_seal(self) -> None:
+        """Stop raw-view reads and drain in-flight ones — called BEFORE
+        the backing block is sealed/registered, after which eviction
+        could recycle it under a raw read.  Reads arriving during the
+        window time out ``pending`` and retry into the sealed path."""
+        with self._cond:
+            self._sealing = True
+            self._cond.notify_all()
+            while self._readers:
+                self._cond.wait(timeout=0.1)
+
+    def mark_sealed(self, raw_still_valid: bool = False) -> None:
+        """Promote to sealed.  ``raw_still_valid`` says the raw buffer
+        the reads ran against cannot be recycled (heap bytearray, kept
+        alive by the read closure) — tail relay reads keep using it
+        directly instead of round-tripping through the store entry."""
+        with self._cond:
+            self._sealed = True
+            self._raw_after_seal = raw_still_valid
+            if not raw_still_valid:
+                # Post-seal reads resolve through the store entry; drop
+                # the raw view so sessions can't pin it needlessly.
+                self._read_raw = None
+            self._sealing = False
+            self._watermark = self.nbytes
+            self._cond.notify_all()
+
+    def mark_failed(self) -> None:
+        """Upstream transfer died (abort/failed seal): fail downstream
+        relay readers cleanly and drain any raw read before the caller
+        recycles the backing block.  The raw-read closure is dropped —
+        lingering relay sessions must not keep a dead transfer's
+        buffer alive until their TTL."""
+        with self._cond:
+            self._failed = True
+            self._read_raw = None
+            self._cond.notify_all()
+            while self._readers:
+                self._cond.wait(timeout=0.1)
+
+    @property
+    def watermark(self) -> int:
+        with self._cond:
+            return self._watermark
+
+    @property
+    def failed(self) -> bool:
+        with self._cond:
+            return self._failed
+
+    # ---- reader side (relay sessions) ----------------------------------
+    def read_range(self, start: int, end: int,
+                   timeout: Optional[float] = None):
+        """Bytes of ``[start, end)`` once the watermark covers them.
+        Raises TimeoutError while the range is still being assembled
+        (the receiver re-requests that chunk); returns None when the
+        upstream transfer failed (the receiver re-selects another
+        source)."""
+        fault_injection.hook("transfer.relay")
+        end = min(end, self.nbytes)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        raw = None
+        with self._cond:
+            while True:
+                if self._failed:
+                    return None
+                if self._sealed:
+                    if self._raw_after_seal:
+                        # Un-recyclable raw buffer: serve directly, no
+                        # reader accounting needed post-seal.
+                        return bytes(self._read_raw(start, end))
+                    break
+                if self._watermark >= end and not self._sealing:
+                    self._readers += 1
+                    # Capture under the condition: mark_failed nulls
+                    # the closure, but only after readers drain.
+                    raw = self._read_raw
+                    break
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        f"relay watermark {self._watermark} < {end}")
+                self._cond.wait(timeout=0.2 if remaining is None
+                                else min(remaining, 0.2))
+        if raw is not None:
+            try:
+                return bytes(raw(start, end))
+            finally:
+                with self._cond:
+                    self._readers -= 1
+                    self._cond.notify_all()
+        # Sealed: the bytes live in the store entry now (reads go
+        # through a native pin / the spill mmap, eviction-safe).
+        data = self.store.read_sealed_range(self.object_id, start, end)
+        if data is not None:
+            return data
+        # No O(chunk) surface (a python-held put won the
+        # materialization race): flatten ONCE, cache, slice — tail
+        # relay reads stay linear in object size overall.
+        with self._cond:
+            blob = self._sealed_cache
+        if blob is None:
+            serialized = self.store.get_serialized(self.object_id)
+            if serialized is None:
+                return None
+            blob = serialized.to_bytes()
+            with self._cond:
+                self._sealed_cache = blob
+        return blob[start:end]
+
+
+def partial_chunk_source(store: Optional["NodeObjectStore"]):
+    """``get_partial`` hook for :class:`ray_tpu.rpc.chunked.ChunkServer`:
+    serve the assembled prefix of an in-flight transfer to downstream
+    pullers (chunk-level relay) when no sealed copy exists yet."""
+
+    def get_partial(oid_bin: bytes):
+        if store is None:
+            return None
+        return store.open_relay_source(ObjectID(oid_bin))
+
+    return get_partial
+
+
 class NodeObjectStore:
     """Plasma-equivalent per-node store with capacity, pinning and spilling.
 
@@ -237,6 +548,10 @@ class NodeObjectStore:
         # transfer writer ever exists per (object, store); later
         # callers wait for the winner and adopt its sealed copy.
         self._active_transfers: set = set()
+        # In-flight transfers relayable to downstream pullers (chunk
+        # relay): object -> _PartialTransfer.  At most one per object
+        # (rides the single-writer claim above).
+        self._partials: Dict[ObjectID, _PartialTransfer] = {}
         self._native = native_backend  # ray_tpu.native shm store, optional
         # Create-request queue state (create_request_queue.h parity):
         # over-capacity reservations wait on the store condition and are
@@ -257,6 +572,9 @@ class NodeObjectStore:
                       "native_puts": 0, "queued_creates": 0,
                       "create_queue_wait_ms": 0.0,
                       "create_queue_timeouts": 0, "spill_errors": 0}
+        # Outbound transfer admission + accounting (sender side of the
+        # collective broadcast path); gauges live in self.stats.
+        self.transfer_ledger = TransferLedger(self.stats)
         from ray_tpu._private.metrics_agent import (get_metrics_registry,
                                                     record_internal)
         nid = getattr(node_id, "hex", lambda: str(node_id))()[:12]
@@ -1026,6 +1344,68 @@ class NodeObjectStore:
 
         return view, release
 
+    # ---- chunk relay (collective broadcast) -----------------------------
+    def _register_partial(self, object_id: ObjectID, nbytes: int,
+                          read_raw) -> "_PartialTransfer":
+        """Publish an in-flight transfer as relayable (called by the
+        writer holding the single-writer claim, so no double
+        registration is possible)."""
+        p = _PartialTransfer(self, object_id, nbytes, read_raw)
+        with self._lock:
+            self._partials[object_id] = p
+        return p
+
+    def _unregister_partial(self, object_id: ObjectID,
+                            p: "_PartialTransfer") -> None:
+        with self._lock:
+            if self._partials.get(object_id) is p:
+                del self._partials[object_id]
+
+    def open_relay_source(self, object_id: ObjectID
+                          ) -> Optional["_PartialTransfer"]:
+        """Relay read surface over an in-flight transfer of
+        ``object_id``, or None when nothing is being assembled here —
+        the sender half of chunk-level relay.  The returned object
+        stays valid past seal/abort (reads then resolve through the
+        sealed entry / fail cleanly)."""
+        with self._lock:
+            return self._partials.get(object_id)
+
+    def num_partials(self) -> int:
+        with self._lock:
+            return len(self._partials)
+
+    def read_sealed_range(self, object_id: ObjectID, start: int,
+                          end: int) -> Optional[bytes]:
+        """Byte range of a SEALED object (relay tail reads after the
+        upstream transfer sealed): spilled objects are served from
+        their spill-file mmap, native blocks under a pin — None when
+        the object is gone (the downstream puller re-selects)."""
+        spilled = self.open_spilled_view(object_id)
+        if spilled is not None:
+            view, release = spilled
+            try:
+                return bytes(view[start:end])
+            finally:
+                release()
+        e = self.get(object_id)
+        if e is None:
+            return None
+        data = e.data
+        if isinstance(data, _NativeHandle) and self._native is not None:
+            key = data.key
+            if self._native.pin(key):
+                try:
+                    view = data.read()
+                    if view is not None:
+                        return bytes(view[start:end])
+                finally:
+                    self._native.unpin(key)
+        # No O(chunk) read surface (python-held winner / vanished
+        # block): None — the relay caller materializes ONCE and caches,
+        # never per chunk.
+        return None
+
     def spill_now(self) -> int:
         """Force-spill all spillable entries (test/chaos hook).
         Reader-pinned entries are refused, same as the background
@@ -1091,14 +1471,30 @@ class _NativeHandle:
             pass
 
 
+def _maybe_register_partial(store: "NodeObjectStore",
+                            object_id: ObjectID, nbytes: int,
+                            read_raw) -> Optional["_PartialTransfer"]:
+    """Writer-side relay registration gate: multi-chunk transfers only
+    (single-chunk objects gain nothing from a relay hop), and only when
+    relay is enabled — the bench's naive arm must stay honestly
+    relay-free."""
+    cfg = get_config()
+    if not cfg.object_transfer_relay_enabled or \
+            nbytes <= cfg.object_manager_chunk_size:
+        return None
+    return store._register_partial(object_id, nbytes, read_raw)
+
+
 class _SegmentTransferWriter:
     """Incoming-transfer sink over a reserved shm block: the chunk
     pipeline writes each arriving chunk straight into the segment at
     its final offset (ObjectBufferPool chunk assembly without the
-    intermediate ``bytearray``); ``seal`` publishes the entry."""
+    intermediate ``bytearray``); ``seal`` publishes the entry.  While
+    in flight the assembled prefix is relayable to downstream pullers
+    through the store's partial registry."""
 
     __slots__ = ("_store", "_object_id", "nbytes", "_offset", "_pin",
-                 "_view", "_reserved")
+                 "_view", "_reserved", "_partial")
 
     def __init__(self, store: "NodeObjectStore", object_id: ObjectID,
                  nbytes: int, offset: int, pin: bool):
@@ -1107,16 +1503,31 @@ class _SegmentTransferWriter:
         self.nbytes = nbytes
         self._offset = offset
         self._pin = pin
-        self._view = store._native.view(offset, nbytes)
+        view = store._native.view(offset, nbytes)
+        self._view = view
         self._reserved = True
+        # The relay raw-read closes over its OWN reference to the view:
+        # seal/abort null the writer's attribute, but readers are
+        # drained before the backing block can be recycled.
+        self._partial = _maybe_register_partial(
+            store, object_id, nbytes, lambda s, e: view[s:e])
 
     def write(self, offset: int, data) -> None:
         from ray_tpu._private.serialization import copy_into_view
         copy_into_view(self._view, offset, data)
+        if self._partial is not None:
+            self._partial.advance(
+                offset, getattr(data, "nbytes", None) or len(data))
 
     def seal(self) -> None:
         store = self._store
         key = self._object_id.binary()
+        partial = self._partial
+        if partial is not None:
+            # Raw relay reads must drain BEFORE the block becomes a
+            # sealed entry eviction could recycle; reads arriving in
+            # the window retry into the sealed path below.
+            partial.quiesce_for_seal()
         self._view = None
         try:
             store._native.seal(key)
@@ -1124,6 +1535,9 @@ class _SegmentTransferWriter:
             # A failed native seal must still release the reservation
             # AND the single-writer claim (a leaked claim hangs every
             # future pull of this object forever) and drop the block.
+            if partial is not None:
+                store._unregister_partial(self._object_id, partial)
+                partial.mark_failed()
             with store._lock:
                 if self._reserved:
                     self._reserved = False
@@ -1135,29 +1549,45 @@ class _SegmentTransferWriter:
                 store._active_transfers.discard(self._object_id)
                 store._lock.notify_all()
             raise
-        with store._lock:
-            if self._reserved:
-                self._reserved = False
-                store._transfer_reserved -= self.nbytes
-            store._active_transfers.discard(self._object_id)
-            existing = store._entries.get(self._object_id)
-            if existing is not None:
-                # Lost a materialization race; keep the winner unless it
-                # is (now) backed by this very block.
-                if not (isinstance(existing.data, _NativeHandle)
-                        and existing.data.key == key):
-                    store._native.delete(key)
+        try:
+            with store._lock:
+                if self._reserved:
+                    self._reserved = False
+                    store._transfer_reserved -= self.nbytes
+                store._active_transfers.discard(self._object_id)
+                existing = store._entries.get(self._object_id)
+                if existing is not None:
+                    # Lost a materialization race; keep the winner
+                    # unless it is (now) backed by this very block.
+                    if not (isinstance(existing.data, _NativeHandle)
+                            and existing.data.key == key):
+                        store._native.delete(key)
+                    store._lock.notify_all()
+                    return
+                e = _Entry(data=_NativeHandle(store._native, key,
+                                              self.nbytes),
+                           size=self.nbytes)
+                e.primary = self._pin
+                store._entries[self._object_id] = e
+                store._used += self.nbytes
                 store._lock.notify_all()
-                return
-            e = _Entry(data=_NativeHandle(store._native, key, self.nbytes),
-                       size=self.nbytes)
-            e.primary = self._pin
-            store._entries[self._object_id] = e
-            store._used += self.nbytes
-            store._lock.notify_all()
+        finally:
+            # Promote AFTER the entry is registered: relay readers that
+            # observe ``sealed`` resolve through the store entry (the
+            # lost-race arm registered the winner's — same bytes).
+            if partial is not None:
+                store._unregister_partial(self._object_id, partial)
+                partial.mark_sealed()
 
     def abort(self) -> None:
         store = self._store
+        partial = self._partial
+        if partial is not None:
+            # Fail downstream relay readers FIRST and drain raw reads:
+            # the native delete below recycles the block they would
+            # otherwise still be copying from.
+            store._unregister_partial(self._object_id, partial)
+            partial.mark_failed()
         self._view = None
         # ONE lock acquisition for reservation release, native delete
         # AND the single-writer claim release: dropping the claim first
@@ -1185,10 +1615,13 @@ class _SegmentTransferWriter:
 class _HeapTransferWriter:
     """Fallback transfer sink when no native segment is available (or
     the object exceeds it): assembles on the heap, seals via a normal
-    store put."""
+    store put.  The heap buffer is just as relayable as a segment block
+    — the partial raw-read closes over the bytearray itself, so it
+    stays valid for late relay reads even after seal hands the bytes to
+    the store."""
 
     __slots__ = ("_store", "_object_id", "nbytes", "_pin", "_buf",
-                 "_reserved")
+                 "_reserved", "_partial")
 
     def __init__(self, store: "NodeObjectStore", object_id: ObjectID,
                  nbytes: int, pin: bool):
@@ -1196,11 +1629,18 @@ class _HeapTransferWriter:
         self._object_id = object_id
         self.nbytes = nbytes
         self._pin = pin
-        self._buf = bytearray(nbytes)
+        buf = bytearray(nbytes)
+        self._buf = buf
         self._reserved = True
+        self._partial = _maybe_register_partial(
+            store, object_id, nbytes,
+            lambda s, e: memoryview(buf)[s:e])
 
     def write(self, offset: int, data) -> None:
         self._buf[offset:offset + len(data)] = data
+        if self._partial is not None:
+            self._partial.advance(
+                offset, getattr(data, "nbytes", None) or len(data))
 
     def _release(self) -> None:
         if self._reserved:
@@ -1210,6 +1650,10 @@ class _HeapTransferWriter:
 
     def seal(self) -> None:
         store = self._store
+        partial = self._partial
+        if partial is not None:
+            partial.quiesce_for_seal()
+        sealed_ok = False
         try:
             # from_bytes INSIDE the try: a corrupt payload must not
             # leak the reservation or the single-writer claim (a
@@ -1224,6 +1668,7 @@ class _HeapTransferWriter:
                 # a second transfer.
                 store._release_transfer_reservation(self.nbytes)
             store.put(self._object_id, restored, pin=self._pin)
+            sealed_ok = True
         finally:
             self._buf = None
             with store._lock:
@@ -1232,8 +1677,21 @@ class _HeapTransferWriter:
                     store._transfer_reserved -= self.nbytes
                 store._active_transfers.discard(self._object_id)
                 store._lock.notify_all()
+            if partial is not None:
+                store._unregister_partial(self._object_id, partial)
+                if sealed_ok:
+                    # The bytearray lives on in the read closure: tail
+                    # relay reads stay O(chunk), not a full
+                    # re-materialization per chunk via the store.
+                    partial.mark_sealed(raw_still_valid=True)
+                else:
+                    partial.mark_failed()
 
     def abort(self) -> None:
+        partial = self._partial
+        if partial is not None:
+            self._store._unregister_partial(self._object_id, partial)
+            partial.mark_failed()
         self._buf = None
         self._release()
 

@@ -111,6 +111,12 @@ class Raylet:
             "total": self.local_resources.to_float_dict("total"),
             "load": {"queued": self.cluster_task_manager.num_queued(),
                      "dispatch": self.local_task_manager.num_queued()},
+            # Outbound-transfer load (sessions/queue/in-flight bytes):
+            # the head folds this into directory answers so pullers can
+            # spread across the least-loaded sources (load-aware source
+            # selection for collective broadcasts).
+            "transfer_load":
+                self.object_store.transfer_ledger.load_snapshot(),
         }
         # Physical stats ride the report the node already sends
         # (reference: reporter agent -> GCS), throttled to ~1 Hz.

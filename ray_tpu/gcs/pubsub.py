@@ -26,12 +26,27 @@ TIMELINE_CHANNEL = "TIMELINE"
 
 
 class Publisher:
+    """Per-subscriber MAILBOXES with coalesced delivery: a publish
+    appends to each target subscriber's queue, and a loop post is
+    scheduled only for subscribers whose drain is not already pending —
+    a burst of K messages costs O(#subscribers) loop posts, not
+    O(K x #subscribers) closures (publisher.h batching, in-process).
+    Location-churn storms (partial relay rows registering/pruning per
+    broadcast hop) made this load-bearing.  Per-subscriber FIFO order
+    is preserved; the drain runs callbacks on the loop thread, outside
+    the publisher lock."""
+
     def __init__(self, event_loop=None):
         self._lock = diag_rlock("Publisher._lock")
         # (channel, key or None) -> {subscriber_id: callback}
         self._subs: Dict[Tuple[str, Optional[bytes]], Dict[int, Callable]] = {}
         self._next_id = 0
         self._loop = event_loop
+        # subscriber_id -> [callback, [(key, message), ...]] mailboxes;
+        # _scheduled marks subscribers with a drain post in flight.
+        self._mailboxes: Dict[int, list] = {}
+        self._scheduled: set = set()
+        self.stats = {"published": 0, "drain_posts": 0}
 
     def subscribe(self, channel: str, key: Optional[bytes],
                   callback: Callable[[bytes, Any], None]) -> int:
@@ -47,17 +62,58 @@ class Publisher:
             subs = self._subs.get((channel, key))
             if subs:
                 subs.pop(sub_id, None)
+            # Queued-but-undrained messages die with the subscription
+            # (same contract as the old already-posted closures, minus
+            # the leak).
+            self._mailboxes.pop(sub_id, None)
+            self._scheduled.discard(sub_id)
+
+    def _drain(self, sid: int):
+        """One coalesced delivery for one subscriber: everything queued
+        since its drain was scheduled, run outside the lock."""
+        with self._lock:
+            self._scheduled.discard(sid)
+            box = self._mailboxes.get(sid)
+            if not box or not box[1]:
+                return
+            cb, batch = box[0], box[1]
+            box[1] = []
+        for key, message in batch:
+            try:
+                cb(key, message)
+            except Exception:
+                pass
 
     def publish(self, channel: str, key: bytes, message: Any):
-        with self._lock:
-            targets = list(self._subs.get((channel, key), {}).values())
-            targets += list(self._subs.get((channel, None), {}).values())
-        for cb in targets:
-            if self._loop is not None:
-                self._loop.post(lambda cb=cb: cb(key, message),
-                                name=f"pubsub.{channel}")
-            else:
+        if self._loop is None:
+            with self._lock:
+                targets = list(self._subs.get((channel, key), {}).values())
+                targets += list(self._subs.get((channel, None),
+                                               {}).values())
+                self.stats["published"] += 1
+            for cb in targets:
                 try:
                     cb(key, message)
                 except Exception:
                     pass
+            return
+        if getattr(self._loop, "_stopped", False):
+            return    # shutdown: posts would be dropped anyway — don't
+                      # let mailboxes grow under a dead drain
+        need_post = []
+        with self._lock:
+            self.stats["published"] += 1
+            pairs = list(self._subs.get((channel, key), {}).items())
+            pairs += list(self._subs.get((channel, None), {}).items())
+            for sid, cb in pairs:
+                box = self._mailboxes.get(sid)
+                if box is None:
+                    box = self._mailboxes[sid] = [cb, []]
+                box[1].append((key, message))
+                if sid not in self._scheduled:
+                    self._scheduled.add(sid)
+                    need_post.append(sid)
+            self.stats["drain_posts"] += len(need_post)
+        for sid in need_post:
+            self._loop.post(lambda sid=sid: self._drain(sid),
+                            name="pubsub.drain")

@@ -16,10 +16,23 @@ any size crosses as ``object_manager_chunk_size`` frames.
 Wire surface (register via :func:`serve_chunks` on any RpcServer):
 
     fetch_meta   {object_id}        -> None | {"inline": bytes}
-                                       | {"token", "size", "chunk_size"}
+                                       | {"token", "size", "chunk_size"[, "relay"]}
                                        | {"busy": True}
-    fetch_chunk  {token, index}     -> bytes
+    fetch_chunk  {token, index}     -> bytes | {"pending": True}
     fetch_close  {token}            -> True
+
+Two collective-transfer extensions ride the same surface:
+
+* **relay sessions** (``get_partial`` hook): when no sealed copy
+  exists but a transfer of the object is in flight, the sender serves
+  the already-assembled prefix of its transfer writer; a chunk past
+  the assembly watermark answers ``{"pending": True}`` (the receiver
+  re-requests) and an upstream abort answers ``None`` (the receiver
+  fails the session and re-selects another source);
+* **sender admission** (``ledger``): outbound sessions are charged to
+  the store's :class:`~ray_tpu._private.object_store.TransferLedger` —
+  a bounded FIFO queue instead of the thrash of N pullers all backing
+  off at once; ``busy`` is only returned after the bounded queue wait.
 """
 
 from __future__ import annotations
@@ -65,16 +78,20 @@ def _register_for_sweep(server: "ChunkServer") -> None:
 
 
 class _Session:
-    __slots__ = ("blob", "created", "last_access", "release")
+    __slots__ = ("blob", "created", "last_access", "release", "partial",
+                 "nbytes")
 
-    def __init__(self, blob, release=None):
+    def __init__(self, blob, release=None, partial=None, nbytes=None):
         self.blob = blob              # bytes OR a pinned memoryview
+        self.partial = partial        # relay source (in-flight transfer)
+        self.nbytes = nbytes if nbytes is not None else len(blob)
         self.created = time.monotonic()
         self.last_access = self.created
-        self.release = release        # unpin callback for view sessions
+        self.release = release        # unpin/ledger callback
 
     def close(self):
-        release, self.release, self.blob = self.release, None, b""
+        release, self.release = self.release, None
+        self.blob, self.partial = b"", None
         if release is not None:
             try:
                 release()
@@ -95,32 +112,38 @@ class ChunkServer:
     SESSION_TTL_S = 120.0
 
     def __init__(self, get_blob: Callable[[bytes], Optional[bytes]],
-                 max_sessions: int = 8, get_source=None):
+                 max_sessions: int = 8, get_source=None,
+                 get_partial=None, ledger=None):
         self._get_blob = get_blob
         self._get_source = get_source   # key -> (buf, release)|None
+        self._get_partial = get_partial  # key -> relay source|None
+        self._ledger = ledger           # store TransferLedger (admission)
         self._max_sessions = max_sessions
         self._lock = threading.Lock()
         self._sessions: Dict[str, _Session] = {}
 
     # ---- handlers ------------------------------------------------------
     def handle_meta(self, payload):
-        buf, release = None, None
+        buf, release, partial = None, None, None
         if self._get_source is not None:
             src = self._get_source(payload["object_id"])
             if src is not None:
                 buf, release = src
         if buf is None:
             buf = self._get_blob(payload["object_id"])
-        if buf is None:
+        if buf is None and self._get_partial is not None:
+            # No sealed copy, but a transfer of the object is in
+            # flight here: serve its assembled prefix (chunk relay).
+            partial = self._get_partial(payload["object_id"])
+        if buf is None and partial is None:
             return None
         chunk = get_config().object_manager_chunk_size
-        nbytes = len(buf)
-        if nbytes <= chunk:
+        if partial is None and len(buf) <= chunk:
             inline = bytes(buf)
             if release is not None:
                 release()
             return {"inline": inline}
-        meta = self._admit(buf, release)
+        meta = self._admit(buf, release, partial=partial)
         if meta is None and release is not None:
             release()
         return meta if meta is not None else {"busy": True}
@@ -131,21 +154,51 @@ class ChunkServer:
         returns the meta dict, or None when admission-full."""
         return self._admit(blob, None)
 
-    def _admit(self, buf, release) -> Optional[dict]:
+    def _admit(self, buf, release, partial=None) -> Optional[dict]:
         chunk = get_config().object_manager_chunk_size
+        nbytes = partial.nbytes if partial is not None else len(buf)
+        if self._ledger is not None:
+            # Sender admission rides the store's outbound ledger: a
+            # bounded FIFO queue wait, then busy.  NOT under
+            # self._lock — other sessions' chunk serving must never
+            # stall behind a queued admit.
+            if not self._ledger.try_acquire(
+                    nbytes,
+                    timeout=get_config()
+                    .object_transfer_admission_wait_s):
+                return None
+            released = [False]
+            user_release = release
+
+            def release(_user=user_release, _n=nbytes):
+                if not released[0]:
+                    released[0] = True
+                    self._ledger.release(_n)
+                if _user is not None:
+                    _user()
+
         with self._lock:
             self._expire_locked()
-            if len(self._sessions) >= self._max_sessions:
-                # Admission control: receiver backs off and retries
+            if self._ledger is None and \
+                    len(self._sessions) >= self._max_sessions:
+                # Legacy admission (no ledger attached — worker/client
+                # chunk servers): receiver backs off and retries
                 # (pull_manager.cc bounded active pulls).
                 return None
             token = uuid.uuid4().hex
-            self._sessions[token] = _Session(buf, release)
+            self._sessions[token] = _Session(buf, release,
+                                             partial=partial,
+                                             nbytes=nbytes)
         if release is not None:
+            # Sweep covers pinned views AND ledger slots: a receiver
+            # that dies without fetch_close must not leak either.
             _register_for_sweep(self)
-        return {"token": token, "size": len(buf), "chunk_size": chunk}
+        meta = {"token": token, "size": nbytes, "chunk_size": chunk}
+        if partial is not None:
+            meta["relay"] = True
+        return meta
 
-    def handle_chunk(self, payload) -> Optional[bytes]:
+    def handle_chunk(self, payload):
         token, index = payload["token"], payload["index"]
         with self._lock:
             session = self._sessions.get(token)
@@ -153,11 +206,34 @@ class ChunkServer:
                 return None
             session.last_access = time.monotonic()
             blob = session.blob
+            partial = session.partial
+            nbytes = session.nbytes
         chunk = get_config().object_manager_chunk_size
         start = index * chunk
+        if partial is not None:
+            # Relay serving: bounded wait for the assembly watermark to
+            # cover this chunk.  "pending" tells the receiver to
+            # re-request (the bounded server-side wait paces the loop);
+            # None fails the session — the upstream transfer died and
+            # the receiver re-selects another source.
+            end = min(start + chunk, nbytes)
+            try:
+                data = partial.read_range(
+                    start, end,
+                    timeout=get_config().object_transfer_relay_wait_s)
+            except TimeoutError:
+                return {"pending": True}
+            if data is None:
+                return None
+            if self._ledger is not None:
+                self._ledger.note_served(len(data), relay=True)
+            return data
         # bytes() also materializes memoryview slices for the wire codec
         # (the per-chunk copy IS the send serialization, not an extra).
-        return bytes(blob[start:start + chunk])
+        data = bytes(blob[start:start + chunk])
+        if self._ledger is not None:
+            self._ledger.note_served(len(data))
+        return data
 
     def handle_close(self, payload) -> bool:
         with self._lock:
@@ -176,10 +252,12 @@ class ChunkServer:
 
 def serve_chunks(server, get_blob: Callable[[bytes], Optional[bytes]],
                  max_sessions: int = 8,
-                 prefix: str = "fetch", get_source=None) -> ChunkServer:
+                 prefix: str = "fetch", get_source=None,
+                 get_partial=None, ledger=None) -> ChunkServer:
     """Register the chunk protocol on an RpcServer."""
     cs = ChunkServer(get_blob, max_sessions=max_sessions,
-                     get_source=get_source)
+                     get_source=get_source, get_partial=get_partial,
+                     ledger=ledger)
     server.register(f"{prefix}_meta", cs.handle_meta)
     server.register(f"{prefix}_chunk", cs.handle_chunk)
     server.register(f"{prefix}_close", cs.handle_close)
@@ -250,6 +328,12 @@ def fetch_session_into(client, meta: dict, sink, timeout: float = 300.0,
     deadline = time.monotonic() + timeout
     token, size, chunk = meta["token"], meta["size"], meta["chunk_size"]
     n_chunks = (size + chunk - 1) // chunk
+    # Relay stall bound (mirrors the in-process leg's 60 s no-progress
+    # cap): a stalled-but-alive upstream must fail this session so the
+    # receiver re-selects, not camp on it for the whole pull deadline
+    # while holding its writer reservation and the sender's slot.
+    stall_cap_s = 60.0
+    last_progress = time.monotonic()
     try:
         next_index = 0
         inflight = {}
@@ -268,9 +352,20 @@ def fetch_session_into(client, meta: dict, sink, timeout: float = 300.0,
             if remaining <= 0:
                 return False
             data = fut.result(timeout=remaining)
+            if isinstance(data, dict) and data.get("pending"):
+                # Relay source hasn't assembled this chunk yet: the
+                # sender already parked the request for its bounded
+                # watermark wait (which paces this loop) — re-request
+                # the same chunk; ordered assembly waits on it again.
+                if time.monotonic() - last_progress > stall_cap_s:
+                    return False  # frozen upstream: caller re-selects
+                inflight[index] = client.call_future(
+                    f"{prefix}_chunk", {"token": token, "index": index})
+                continue
             if data is None:
                 return False      # session expired sender-side
             sink(index * chunk, data)
+            last_progress = time.monotonic()
             received += 1
             if on_chunk is not None:
                 on_chunk(len(data), len(inflight))

@@ -210,7 +210,8 @@ def cmd_memory(args) -> int:
         client.close()
     print(f"{'NODE':18} {'OBJECTS':>8} {'USED':>12} {'CAPACITY':>12} "
           f"{'SPILLED':>10} {'RESTORED':>9} {'EVICTED':>8} "
-          f"{'QUEUED':>7} {'QWAIT_MS':>9}")
+          f"{'QUEUED':>7} {'QWAIT_MS':>9} "
+          f"{'OUT_SESS':>8} {'ADM_Q':>6} {'RELAY_MB':>9}")
     for r in rows:
         stats = r.get("stats", {})
         print(f"{r['node']:18} {r['num_objects']:>8} "
@@ -219,7 +220,10 @@ def cmd_memory(args) -> int:
               f"{stats.get('restored_objects', 0):>9} "
               f"{stats.get('evicted_objects', 0):>8} "
               f"{stats.get('queued_creates', 0):>7} "
-              f"{stats.get('create_queue_wait_ms', 0.0):>9.1f}")
+              f"{stats.get('create_queue_wait_ms', 0.0):>9.1f} "
+              f"{stats.get('outbound_sessions_active', 0):>8} "
+              f"{stats.get('transfer_admission_queue_depth', 0):>6} "
+              f"{stats.get('relay_served_bytes', 0) / 2**20:>9.1f}")
     return 0
 
 

@@ -316,6 +316,95 @@ def test_heartbeat_fault_declares_live_process_dead():
 
 
 # ---------------------------------------------------------------------------
+# transfer.relay — SIGKILL a mid-chain relay node during a broadcast
+# ---------------------------------------------------------------------------
+
+def test_sigkill_relay_node_mid_broadcast_reroutes():
+    """SIGKILL the mid-chain RELAY node while a downstream puller is
+    streaming the assembled prefix from it: the downstream pull
+    reroutes via the remaining full location (the origin), every
+    object lands bit-identical, and the dead node's partial directory
+    rows are pruned.
+
+    Deterministic by construction: the relay node's chunk RECEIVE and
+    its relay SERVING are both slowed through env-inherited fault
+    points (``transfer.chunk`` / ``transfer.relay``), and the kill only
+    fires after ``transfer.relay`` provably fired on the relay node —
+    the downstream was streaming from it at kill time."""
+    cfg = dict(_WIRE_CONFIG)
+    cfg["object_manager_chunk_size"] = _MB    # 12 chunks: a real chain
+    ray_tpu.init(num_cpus=2, _system_config=cfg)
+    try:
+        cluster = global_worker().cluster
+        os.environ["RAY_TPU_FAULT_POINTS"] = \
+            "transfer.chunk:delay:-1:0.15,transfer.relay:delay:-1:0.05"
+        try:
+            relay_host = cluster.add_remote_node(
+                num_cpus=1, resources={"relay": 4.0},
+                object_store_memory=64 * _MB)
+        finally:
+            del os.environ["RAY_TPU_FAULT_POINTS"]
+        cluster.add_remote_node(num_cpus=1, resources={"dest": 4.0},
+                                object_store_memory=64 * _MB)
+
+        data = np.arange(12 * _MB, dtype=np.uint8) % 241
+        expect_head = int(data[:16].sum())
+        expect_tail = int(data[-16:].sum())
+        ref = ray_tpu.put(data)        # origin copy: the head's store
+        oid = ref.object_id()
+
+        @ray_tpu.remote(num_cpus=0, max_retries=4)
+        def digest(a):
+            return int(a[:16].sum()), int(a[-16:].sum()), a.nbytes
+
+        # 1) The relay host starts pulling (slow: ~0.15 s/chunk), and
+        #    registers its partial row at the head's directory.
+        r_relay = digest.options(resources={"relay": 1.0}).remote(ref)
+        assert _wait_until(
+            lambda: any(row.get("partial")
+                        and row["node_id"] == relay_host.node_id
+                        for row in cluster.object_directory
+                        .get_candidates(oid)),
+            timeout=30), "relay host never registered its partial row"
+
+        # 2) The dest node pulls; load-aware selection must route it to
+        #    the relay host (the origin is busy serving the relay
+        #    host's session) — proven by transfer.relay firing THERE.
+        r_dest = digest.options(resources={"dest": 1.0}).remote(ref)
+        proxy = cluster.gcs.raylet(relay_host.node_id)
+        assert _wait_until(
+            lambda: proxy.client.call(
+                "fault_fired", {"point": "transfer.relay"},
+                timeout=5.0) > 0,
+            timeout=60), "dest never streamed from the relay host"
+
+        relay_host.kill()              # SIGKILL, mid-relay
+
+        # Replacement capacity so the relay-resource task can re-lease.
+        cluster.add_remote_node(num_cpus=1, resources={"relay": 4.0},
+                                object_store_memory=64 * _MB)
+
+        # Downstream rerouted via the origin and reconstructed
+        # bit-identical state.
+        assert ray_tpu.get(r_dest, timeout=240) == \
+            (expect_head, expect_tail, 12 * _MB)
+        assert ray_tpu.get(r_relay, timeout=240) == \
+            (expect_head, expect_tail, 12 * _MB)
+        out = ray_tpu.get(ref, timeout=60)
+        np.testing.assert_array_equal(out, data)
+
+        # The dead node's rows — partial AND full — are pruned with it.
+        assert _wait_until(
+            lambda: not any(row["node_id"] == relay_host.node_id
+                            for row in cluster.object_directory
+                            .get_candidates(oid)),
+            timeout=30), "dead relay node's directory rows not pruned"
+        assert relay_host.proc.poll() is not None
+    finally:
+        ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
 # the acceptance scenario
 # ---------------------------------------------------------------------------
 

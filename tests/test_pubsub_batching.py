@@ -97,6 +97,57 @@ class TestWireProtocol:
         assert got == ["before"]
 
 
+class TestInProcessCoalescing:
+    def test_burst_drains_in_few_loop_posts(self):
+        """A K-message burst to a loop-backed Publisher costs O(1) drain
+        posts per subscriber, not K closures — and loses/reorders
+        nothing.  The loop is blocked during the burst so coalescing is
+        deterministic, not timing-dependent."""
+        from ray_tpu._private.event_loop import EventLoop
+        loop = EventLoop("pubsub-coalesce-test")
+        try:
+            pub = Publisher(event_loop=loop)
+            a_got, b_got = [], []
+            pub.subscribe("CH", None, lambda k, m: a_got.append(m))
+            pub.subscribe("CH", b"k", lambda k, m: b_got.append(m))
+            gate = threading.Event()
+            loop.post(gate.wait, name="block")      # park the loop
+            n = 300
+            for i in range(n):
+                pub.publish("CH", b"k", i)
+            gate.set()
+            assert _wait_until(
+                lambda: len(a_got) == n and len(b_got) == n)
+            assert a_got == list(range(n)), "lost/reordered (wildcard)"
+            assert b_got == list(range(n)), "lost/reordered (keyed)"
+            # The whole parked burst drained as ONE post per subscriber
+            # (a handful more may fire for messages racing the drain).
+            drains = loop.handler_stats.get("pubsub.drain",
+                                            {}).get("count", 0)
+            assert 0 < drains <= 8, \
+                f"{drains} drain posts for {n} messages x 2 subscribers"
+            assert pub.stats["drain_posts"] == drains
+        finally:
+            loop.stop()
+
+    def test_unsubscribe_drops_queued_mailbox(self):
+        from ray_tpu._private.event_loop import EventLoop
+        loop = EventLoop("pubsub-unsub-test")
+        try:
+            pub = Publisher(event_loop=loop)
+            got = []
+            gate = threading.Event()
+            sid = pub.subscribe("CH", None, lambda k, m: got.append(m))
+            loop.post(gate.wait, name="block")
+            pub.publish("CH", b"k", "queued")
+            pub.unsubscribe("CH", None, sid)
+            gate.set()
+            time.sleep(0.2)
+            assert got == [], "unsubscribed mailbox still delivered"
+        finally:
+            loop.stop()
+
+
 class TestClusterLogSpam:
     @pytest.mark.slow
     def test_spoke_log_spam_batched_no_drops(self):

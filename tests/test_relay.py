@@ -1,0 +1,340 @@
+"""Collective object transfer: partial-prefix relay correctness.
+
+The data-plane invariants behind the chain/tree broadcast path:
+
+* a relay read NEVER crosses the assembly watermark (no torn chunks);
+* abort of the upstream transfer fails downstream relay sessions
+  cleanly (they re-select another source);
+* the duplicate-writer adoption (single transfer writer per
+  (object, store)) composes with relay — late writers adopt the
+  winner's copy while relay sessions keep serving;
+* an in-process 1->N broadcast forms a chain: the origin serves O(size)
+  with the rest of the bytes relayed node-to-node;
+* the wire protocol's ``{"pending": True}`` chunk replies pace a
+  receiver behind a slower upstream without burning the session.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import fault_injection
+from ray_tpu._private.config import get_config
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.object_store import NodeObjectStore, entry_value
+from ray_tpu._private.serialization import serialize
+
+_MB = 1024 * 1024
+
+
+def _wait_until(pred, timeout=15.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture(autouse=True)
+def _fault_isolation():
+    fault_injection.reset()
+    yield
+    fault_injection.reset()
+
+
+def _blob_and_array(n_chunks, chunk):
+    """A serialized payload spanning ``n_chunks`` transfer chunks, plus
+    the array it decodes back to."""
+    arr = (np.arange(n_chunks * chunk + chunk // 2, dtype=np.uint8)
+           % 251)
+    blob = serialize(arr).to_bytes()
+    assert len(blob) > (n_chunks - 1) * chunk
+    return blob, arr
+
+
+class TestPartialPrefix:
+    def test_relay_read_never_crosses_watermark(self, tmp_path):
+        cfg = get_config()
+        cfg.object_manager_chunk_size = chunk = 64 * 1024
+        store = NodeObjectStore(ObjectID.from_random(), 64 * _MB,
+                                str(tmp_path))
+        oid = ObjectID.from_random()
+        blob, arr = _blob_and_array(6, chunk)
+        nbytes = len(blob)
+        writer = store.create_transfer_writer(oid, nbytes)
+        relay = store.open_relay_source(oid)
+        assert relay is not None and relay.nbytes == nbytes
+
+        # Nothing assembled: any read pends (never returns garbage).
+        with pytest.raises(TimeoutError):
+            relay.read_range(0, chunk, timeout=0.05)
+
+        writer.write(0, blob[:chunk])
+        writer.write(chunk, blob[chunk:2 * chunk])
+        assert relay.watermark == 2 * chunk
+        assert relay.read_range(0, chunk, timeout=2.0) == blob[:chunk]
+        assert relay.read_range(chunk, 2 * chunk, timeout=2.0) == \
+            blob[chunk:2 * chunk]
+        # A read crossing the watermark pends — no torn chunk, ever.
+        with pytest.raises(TimeoutError):
+            relay.read_range(2 * chunk, 3 * chunk, timeout=0.05)
+        assert fault_injection.fired("transfer.relay") == 0  # unarmed
+
+        for off in range(2 * chunk, nbytes, chunk):
+            writer.write(off, blob[off:off + chunk])
+        writer.seal()
+        # Registry pruned at seal; late reads resolve via the sealed
+        # entry, still byte-exact.
+        assert store.open_relay_source(oid) is None
+        tail = relay.read_range(nbytes - chunk, nbytes, timeout=2.0)
+        assert tail == blob[nbytes - chunk:]
+        np.testing.assert_array_equal(entry_value(store.get(oid)), arr)
+
+    def test_upstream_abort_fails_downstream_cleanly(self, tmp_path):
+        cfg = get_config()
+        cfg.object_manager_chunk_size = chunk = 64 * 1024
+        store = NodeObjectStore(ObjectID.from_random(), 64 * _MB,
+                                str(tmp_path))
+        oid = ObjectID.from_random()
+        blob, _ = _blob_and_array(4, chunk)
+        writer = store.create_transfer_writer(oid, len(blob))
+        relay = store.open_relay_source(oid)
+        writer.write(0, blob[:chunk])
+        assert relay.read_range(0, chunk, timeout=2.0) == blob[:chunk]
+
+        # A reader parked past the watermark while the upstream dies
+        # must unblock with the failure, not hang or read garbage.
+        got = {}
+
+        def parked_read():
+            try:
+                got["data"] = relay.read_range(chunk, 2 * chunk,
+                                               timeout=10.0)
+            except TimeoutError:
+                got["data"] = "timeout"
+
+        t = threading.Thread(target=parked_read, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        writer.abort()
+        t.join(timeout=5.0)
+        assert not t.is_alive(), "relay reader hung across the abort"
+        assert got["data"] is None, \
+            "aborted upstream must fail the relay read with None"
+        assert relay.read_range(0, chunk, timeout=0.2) is None
+        assert store.open_relay_source(oid) is None
+        assert not store.contains(oid)
+
+    def test_duplicate_writer_adoption_composes_with_relay(
+            self, tmp_path):
+        cfg = get_config()
+        cfg.object_manager_chunk_size = chunk = 64 * 1024
+        store = NodeObjectStore(ObjectID.from_random(), 64 * _MB,
+                                str(tmp_path))
+        oid = ObjectID.from_random()
+        blob, arr = _blob_and_array(4, chunk)
+        nbytes = len(blob)
+        writer = store.create_transfer_writer(oid, nbytes)
+        relay = store.open_relay_source(oid)
+        writer.write(0, blob[:chunk])
+
+        # A racing pull's writer blocks on the single-writer claim and
+        # must adopt the winner's copy (None) once it seals.
+        second = {}
+
+        def racing_writer():
+            second["writer"] = store.create_transfer_writer(oid, nbytes)
+
+        t = threading.Thread(target=racing_writer, daemon=True)
+        t.start()
+        time.sleep(0.1)
+        assert t.is_alive(), "second writer should block on the claim"
+        assert relay.read_range(0, chunk, timeout=2.0) == blob[:chunk]
+        for off in range(chunk, nbytes, chunk):
+            writer.write(off, blob[off:off + chunk])
+        writer.seal()
+        t.join(timeout=10.0)
+        assert not t.is_alive()
+        assert second["writer"] is None, \
+            "late writer must adopt the sealed copy, not re-stream"
+        # Relay sessions opened against the winner keep serving.
+        assert relay.read_range(0, nbytes, timeout=2.0) == blob
+        np.testing.assert_array_equal(entry_value(store.get(oid)), arr)
+
+
+class TestChainBroadcast:
+    def _broadcast(self, cluster, nodes, oid):
+        events = []
+        results = []
+        fault_injection.arm("transfer.chunk", "delay", count=-1,
+                            delay_s=0.02)
+        try:
+            for node in nodes:
+                ev = threading.Event()
+                res = {}
+
+                def cb(ok, ev=ev, res=res):
+                    res["ok"] = ok
+                    ev.set()
+
+                node.object_manager.pull_async(oid, cb)
+                events.append(ev)
+                results.append(res)
+                # Stagger just enough for the chain to observe the
+                # predecessor's in-flight transfer.
+                _wait_until(lambda n=node, e=ev:
+                            n.object_store.num_partials() > 0
+                            or e.is_set(), timeout=20)
+            for ev in events:
+                assert ev.wait(timeout=120), "broadcast pull timed out"
+        finally:
+            fault_injection.disarm("transfer.chunk")
+        assert all(r.get("ok") for r in results), results
+
+    def test_chain_broadcast_origin_serves_fair_share(
+            self, ray_start_cluster):
+        cluster = ray_start_cluster(num_cpus=1)
+        # AFTER init: ray_tpu.init re-initializes the config singleton.
+        cfg = get_config()
+        cfg.object_transfer_max_outbound_sessions = 1
+        cfg.object_manager_chunk_size = 256 * 1024
+        nodes = [cluster.add_node(num_cpus=0,
+                                  object_store_memory=64 * _MB)
+                 for _ in range(4)]
+        arr = (np.arange(4 * _MB, dtype=np.uint8) % 251)
+        ref = ray_tpu.put(arr)
+        oid = ref.object_id()
+        head = cluster.head_node
+        size = head.object_store.get(oid).size
+        origin_before = head.object_store.stats["outbound_served_bytes"]
+
+        self._broadcast(cluster, nodes, oid)
+
+        for node in nodes:
+            e = node.object_store.get(oid)
+            assert e is not None, "broadcast copy missing"
+            np.testing.assert_array_equal(entry_value(e), arr)
+        origin_served = head.object_store.stats["outbound_served_bytes"] \
+            - origin_before
+        assert 0 < origin_served <= 2 * size, \
+            (f"origin served {origin_served} bytes for a {size}-byte "
+             f"object — the broadcast did not chain")
+        relayed = sum(n.object_store.stats["relay_served_bytes"]
+                      for n in nodes)
+        relay_pulls = sum(n.object_manager.stats["relay_pulls"]
+                          for n in nodes)
+        assert relayed > 0 and relay_pulls >= 2, \
+            (relayed, relay_pulls)
+        # Partial rows all pruned once the broadcast settled.
+        assert all(not row.get("partial")
+                   for row in
+                   cluster.object_directory.get_candidates(oid))
+
+    def test_naive_arm_still_correct(self, ray_start_cluster):
+        cluster = ray_start_cluster(num_cpus=1)
+        cfg = get_config()
+        cfg.object_transfer_source_selection = "first"
+        cfg.object_transfer_relay_enabled = False
+        nodes = [cluster.add_node(num_cpus=0,
+                                  object_store_memory=64 * _MB)
+                 for _ in range(3)]
+        arr = np.arange(2 * _MB, dtype=np.uint8) % 239
+        ref = ray_tpu.put(arr)
+        oid = ref.object_id()
+        done = []
+        for node in nodes:
+            ev = threading.Event()
+            node.object_manager.pull_async(oid, lambda ok, e=ev: e.set())
+            done.append(ev)
+        for ev in done:
+            assert ev.wait(timeout=60)
+        for node in nodes:
+            np.testing.assert_array_equal(
+                entry_value(node.object_store.get(oid)), arr)
+            assert node.object_store.stats["relay_served_bytes"] == 0
+            assert node.object_store.num_partials() == 0
+
+
+class TestRelayWireProtocol:
+    class _FakePartial:
+        """Duck-typed relay source driven by the test."""
+
+        def __init__(self, payload):
+            self.payload = payload
+            self.nbytes = len(payload)
+            self.watermark = 0
+            self.fail = False
+            self.pendings = 0
+
+        def read_range(self, start, end, timeout=None):
+            if self.fail:
+                return None
+            if self.watermark < end:
+                self.pendings += 1
+                raise TimeoutError("past watermark")
+            return self.payload[start:end]
+
+    def _serve_partial(self, fake, chunk):
+        from ray_tpu.rpc import RpcServer
+        from ray_tpu.rpc.chunked import serve_chunks
+        get_config().object_manager_chunk_size = chunk
+        get_config().object_transfer_relay_wait_s = 0.05
+        server = RpcServer(name="relay-wire-test")
+        serve_chunks(server, lambda key: None,
+                     get_partial=lambda key: fake)
+        return server
+
+    def test_pending_replies_pace_receiver_to_completion(self):
+        from ray_tpu.rpc import RpcClient
+        from ray_tpu.rpc.chunked import fetch_chunked
+        chunk = 64 * 1024
+        rng = np.random.default_rng(3)
+        payload = rng.integers(0, 256, 5 * chunk + 100,
+                               dtype=np.uint8).tobytes()
+        fake = self._FakePartial(payload)
+        server = self._serve_partial(fake, chunk)
+        try:
+            client = RpcClient(server.address)
+
+            def advance():
+                while fake.watermark < fake.nbytes:
+                    time.sleep(0.1)
+                    fake.watermark = min(fake.watermark + chunk,
+                                         fake.nbytes)
+
+            t = threading.Thread(target=advance, daemon=True)
+            t.start()
+            blob = fetch_chunked(client, b"k", timeout=60.0, pipeline=4)
+            assert blob == payload
+            assert fake.pendings > 0, \
+                "receiver never saw a pending reply — nothing was paced"
+            client.close()
+        finally:
+            server.stop()
+
+    def test_upstream_death_fails_wire_session(self):
+        from ray_tpu.rpc import RpcClient
+        from ray_tpu.rpc.chunked import fetch_chunked
+        chunk = 64 * 1024
+        payload = bytes(range(256)) * (3 * chunk // 256)
+        fake = self._FakePartial(payload)
+        fake.watermark = chunk
+        server = self._serve_partial(fake, chunk)
+        try:
+            client = RpcClient(server.address)
+
+            def die_soon():
+                time.sleep(0.3)
+                fake.fail = True
+
+            threading.Thread(target=die_soon, daemon=True).start()
+            blob = fetch_chunked(client, b"k", timeout=30.0, pipeline=2)
+            assert blob is None, \
+                "a dead upstream must fail the session, not hang"
+            client.close()
+        finally:
+            server.stop()
