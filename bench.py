@@ -185,6 +185,36 @@ def _dispatch_latency_rows():
     return {"rows": rows}
 
 
+def _introspection_overhead_row():
+    """Run bench_runtime.py --introspection-bench in a subprocess (the
+    contention arming must exist before any lock is created, hence a
+    fresh process) and return the armed dispatch-latency row with its
+    contention summary, or a structured skip dict."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench_runtime.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, path, "--introspection-bench"],
+            env=env, capture_output=True, text=True, timeout=600)
+    except subprocess.TimeoutExpired:
+        return {"skipped": True,
+                "reason": "introspection bench timed out"}
+    if proc.returncode != 0:
+        return {"skipped": True,
+                "reason": f"introspection bench rc={proc.returncode}: "
+                          f"{(proc.stderr or '')[-400:]}"}
+    for line in proc.stdout.strip().splitlines():
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if row.get("metric") == "dispatch_latency_introspection_armed":
+            return row
+    return {"skipped": True,
+            "reason": "no introspection row in output"}
+
+
 def _broadcast_relay_row():
     """Run bench_runtime.py --broadcast-only in a subprocess (CPU-side
     runtime, never touches the chip) and return the parsed
@@ -387,6 +417,30 @@ def main():
             {k: row.get(k) for k in ("n", "value", "p50_ms",
                                      "lease_rpcs", "stages")}
             for row in rows]
+
+    # Introspection-plane overhead bound (ISSUE 13): the same n=500
+    # dispatch row with flight recorder + lock-contention profiling
+    # armed, compared against the unarmed headline row above; the
+    # armed run's contention summary (top-5 lock wait, max loop lag)
+    # rides the JSON so BENCH rows carry attribution data.
+    armed = _introspection_overhead_row()
+    if armed.get("skipped"):
+        res["introspection_overhead"] = armed
+    else:
+        print(json.dumps(armed))
+        baseline_p99 = res.get("dispatch_p99_ms")
+        ratio = (round(armed["value"] / baseline_p99, 3)
+                 if baseline_p99 else None)
+        res["introspection_overhead"] = {
+            "armed_p99_ms": armed["value"],
+            "baseline_p99_ms": baseline_p99,
+            "ratio": ratio,
+            # Target: within 10% (note this 1-core runner's p99
+            # varies run-to-run on identical code — see BENCH_r07 —
+            # so the honest record is both numbers, not just a bit).
+            "within_10pct": (ratio is not None and ratio <= 1.10),
+        }
+        res["contention_summary"] = armed.get("introspection")
     print(json.dumps(res))
 
 

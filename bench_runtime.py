@@ -142,6 +142,37 @@ def bench_dispatch_latency(n, warm=True, reset_window=True):
                     for stage, row in stages.items()})
 
 
+def introspection_summary():
+    """Contention rollup from THIS process's debug plane: top-5 locks
+    by total sampled acquire-wait, max event-loop post-to-run lag, and
+    the flight-recorder counters — folded into bench JSON so BENCH
+    rows carry the attribution data alongside the latency numbers."""
+    from ray_tpu._private.debug import flight_recorder, watchdog
+    from ray_tpu._private.debug.report import top_locks
+    loops = watchdog.loops_snapshot()
+    return {
+        "top_locks": top_locks(5),
+        "max_loop_lag_ms": round(
+            max((lp.get("lag_max_s", 0.0) for lp in loops),
+                default=0.0) * 1000.0, 3),
+        "recorder": flight_recorder.stats(),
+    }
+
+
+def bench_introspection_overhead(n=500):
+    """Overhead bound for the introspection plane (ISSUE 13): the
+    dispatch-latency row with flight recorder + lock-contention
+    profiling armed.  bench.py compares this against the unarmed
+    --dispatch-only row from the same invocation; the acceptance
+    target is p99 within 10% of the BENCH_r07 configuration."""
+    row = bench_dispatch_latency(n, warm=True, reset_window=True)
+    return emit("dispatch_latency_introspection_armed",
+                row["value"], "ms", n=n, p50_ms=row.get("p50_ms"),
+                stages=row.get("stages"),
+                lease_rpcs=row.get("lease_rpcs"),
+                introspection=introspection_summary())
+
+
 def bench_dispatch_sweep(levels=(500, 2_000, 5_000)):
     """Concurrency sweep of the dispatch-latency row: one row per burst
     size, same warm worker pool, fresh sample window per level — the
@@ -788,7 +819,18 @@ def main():
                         help="run only the relay-vs-naive broadcast "
                              "sweep (bench.py folds this into its "
                              "JSON)")
+    parser.add_argument("--introspection-bench", action="store_true",
+                        help="run the dispatch-latency row with the "
+                             "flight recorder + lock-contention "
+                             "profiling armed (the ISSUE-13 overhead "
+                             "bound; bench.py folds this in)")
     args = parser.parse_args()
+
+    if args.introspection_bench:
+        # Must land before ray_tpu import: contention arming is read
+        # at lock CREATION time (module-level locks are created at
+        # import).  The flight recorder is on by default.
+        os.environ["RAY_TPU_LOCK_CONTENTION"] = "1"
 
     import jax
     jax.config.update("jax_platforms", "cpu")
@@ -806,6 +848,10 @@ def main():
     })
 
     quick = args.quick
+    if args.introspection_bench:
+        bench_introspection_overhead(500)
+        ray_tpu.shutdown()
+        return 0
     if args.dispatch_only:
         bench_dispatch_sweep((500, 2_000, 5_000))
         ray_tpu.shutdown()

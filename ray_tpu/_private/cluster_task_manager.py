@@ -26,7 +26,7 @@ import time
 
 from ray_tpu._private import fault_injection
 from ray_tpu._private.config import get_config
-from ray_tpu._private.debug import diag_rlock, loop_only
+from ray_tpu._private.debug import diag_rlock, flight_recorder, loop_only
 from ray_tpu._private.task_spec import TaskSpec
 from ray_tpu.scheduler import policy as policy_mod
 
@@ -59,7 +59,8 @@ class _LeaseBatch:
         self.results: list = [None] * n
         self._remaining = n
         self._reply = reply
-        self._lock = threading.Lock()
+        from ray_tpu._private.debug import diag_lock
+        self._lock = diag_lock("_LeaseBatch._lock")
 
     def resolve(self, idx: int, result: dict) -> None:
         with self._lock:
@@ -69,6 +70,22 @@ class _LeaseBatch:
             self._remaining -= 1
             done = self._remaining == 0
         if done:
+            # Flight recorder: the grant/backlog vector this batch
+            # resolved to — the lease-protocol decision the metrics
+            # plane only counts.
+            flight_recorder.record(
+                "lease.batch_reply", n=len(self.results),
+                grants=sum(1 for r in self.results
+                           if r and "worker" in r),
+                spillbacks=sum(1 for r in self.results
+                               if r and "retry_at" in r),
+                backlog=sum(1 for r in self.results
+                            if r and r.get("backlog")
+                            and not r.get("infeasible")),
+                infeasible=sum(1 for r in self.results
+                               if r and r.get("infeasible")),
+                rejected=sum(1 for r in self.results
+                             if r and r.get("rejected")))
             self._reply({"results": self.results})
 
 
@@ -267,6 +284,21 @@ class ClusterTaskManager:
             dt = time.perf_counter() - t0
             self.tick_stats["ticks"] += 1
             if depth:
+                # Flight recorder: one record per WORKING tick — the
+                # solve summary (batch shape + spillback split) behind
+                # every grant/spill decision this tick made.
+                ts = self.tick_stats
+                flight_recorder.record(
+                    "sched.tick", node=self._node_label, queued=depth,
+                    dur_ms=round(dt * 1000.0, 3),
+                    batch_tasks=ts["last_batch_tasks"],
+                    batch_classes=ts["last_batch_classes"],
+                    spillbacks=ts["spillbacks"],
+                    no_capacity=ts["spillbacks_no_capacity"],
+                    locality_override=ts[
+                        "spillbacks_locality_override"],
+                    jnp_fallbacks=ts["jnp_fallbacks"],
+                    dispatch_errors=ts["dispatch_errors"])
                 # Working ticks only (same gate as the span): idle
                 # no-op ticks fire every event_loop_tick_ms and their
                 # microsecond latencies would drown the signal the
@@ -339,6 +371,10 @@ class ClusterTaskManager:
         try:
             self.tick_stats["spillbacks"] += 1
             self.tick_stats[f"spillbacks_{reason}"] += 1
+            flight_recorder.record(
+                "sched.spillback", node=self._node_label,
+                task=spec.task_id.hex()[:12], reason=reason,
+                target=getattr(target, "hex", lambda: str(target))()[:12])
             reply({"retry_at": target})
         except Exception:
             self.tick_stats["dispatch_errors"] += 1

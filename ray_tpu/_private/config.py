@@ -18,6 +18,8 @@ import os
 import threading
 from typing import Optional
 
+from ray_tpu._private.debug.lock_order import diag_lock
+
 
 @dataclasses.dataclass
 class Config:
@@ -241,6 +243,26 @@ class Config:
     #: Enable OpenTelemetry-style span capture (tracing_helper.py parity).
     tracing_enabled: bool = False
 
+    # ------ introspection plane (flight recorder / watchdog) ------
+    #: Always-on per-process decision ring (debug.flight_recorder):
+    #: scheduler tick summaries, lease-batch vectors, transfer source
+    #: selections, spill/restore/reconstruction attempts, create-queue
+    #: admits, fault firings.  Dumped by `ray-tpu doctor`, wedge
+    #: reports and crash paths.
+    flight_recorder_enabled: bool = True
+    #: Ring capacity in fixed slots (overwrites oldest; O(slots) memory).
+    flight_recorder_slots: int = 512
+    #: Stall watchdog over event loops and pump threads: emits wedge
+    #: reports (thread stacks + held locks + recorder tail) to a crash
+    #: file and to the head.  Report-only — never kills anything.
+    watchdog_enabled: bool = True
+    #: A loop handler running longer than this (or queued work making
+    #: no progress for this long) is a wedge.  0 disables detection
+    #: while keeping the beat bookkeeping.
+    loop_stall_budget_s: float = 10.0
+    #: Watchdog poll cadence (clamped to budget/4).
+    watchdog_poll_interval_s: float = 0.5
+
     @classmethod
     def from_env(cls, system_config: Optional[dict] = None) -> "Config":
         cfg = cls()
@@ -273,7 +295,7 @@ def _parse(raw: str, ftype, default):
 
 
 _global_config: Optional[Config] = None
-_lock = threading.Lock()
+_lock = diag_lock("config._lock")
 
 
 def get_config() -> Config:

@@ -581,6 +581,10 @@ class CoreWorker:
             if not self._object_available(arg_id):
                 self.recover_object(arg_id, _depth=_depth + 1)
         from ray_tpu.gcs import task_events
+        from ray_tpu._private.debug import flight_recorder
+        flight_recorder.record(
+            "lineage.reconstruct", obj=object_id.hex()[:12],
+            task=spec.task_id.hex()[:12], attempt=attempt, depth=_depth)
         self.metrics["lineage_reconstructions"] += 1
         # Attempt rides above the retry band (prior retries never
         # exceed max_retries) so the task-event manager rewinds the

@@ -11,14 +11,32 @@ Three tools, all zero-cost unless armed by env var:
 * :mod:`.swallow` — accounted exception swallowing for pump loops
   (always on; it is bookkeeping, not a probe).
 
-The tier-1 conftest arms both probes for the whole suite; the static
-side lives in ``tools/graftcheck``.
+plus the always-on introspection plane (ISSUE 13):
+
+* :mod:`.flight_recorder` — per-process bounded structured ring over
+  the runtime's decision points (tick solves, lease batches, transfer
+  source selection, spill/restore/reconstruction, create-queue admits,
+  fault firings); dumped by ``ray-tpu doctor``, on watchdog trip, and
+  by tests;
+* :mod:`.watchdog` — stall watchdog over every event loop and pump
+  thread: wedge reports (all thread stacks, held diag-lock sets,
+  recorder tail) to a crash file and to the head;
+* contention profiling (``RAY_TPU_LOCK_CONTENTION=1``) inside
+  :mod:`.lock_order` — sampled per-named-lock acquire-wait and
+  hold-time histograms at /metrics, without the witness's cycle
+  checks;
+* :mod:`.report` — the per-process ``debug_dump`` report the doctor
+  CLI renders.
+
+The tier-1 conftest arms the probes AND the watchdog for the whole
+suite; the static side lives in ``tools/graftcheck``.
 """
 
 from ray_tpu._private.debug.lock_order import (  # noqa: F401
     DiagLock, DiagRLock, LockHoldBudgetExceeded, LockOrderViolation,
     diag_condition, diag_lock, diag_rlock)
 from ray_tpu._private.debug import swallow  # noqa: F401
+from ray_tpu._private.debug import flight_recorder  # noqa: F401
 from ray_tpu._private.debug.thread_registry import (  # noqa: F401
     LoopAffinityError, current_loop_kind, loop_only, register_current,
     unregister_current)

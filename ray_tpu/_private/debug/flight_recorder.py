@@ -1,0 +1,151 @@
+"""Per-process flight recorder: a bounded structured ring over the
+runtime's DECISION points.
+
+The metrics plane (PR 8) counts what happened; this ring remembers the
+last N decisions verbatim — scheduler tick solve summaries, lease-batch
+grant/backlog vectors, transfer source selections and relay-chain
+choices, spill/restore/reconstruction attempts, create-queue admits,
+fault firings — so "why is it stuck / why did it go THERE" is
+answerable after the fact without re-running under tracing.  Parity:
+the reference's ``RAY_EVENT`` ring + ``ray debug`` dump of recent
+scheduler events (event.h bounded in-memory sink).
+
+Design constraints, in order:
+
+* **cheap on the hot path** — one non-blocking lock attempt and three
+  slot writes per record; a contended recorder DROPS the record and
+  bumps a counter rather than ever making a caller wait;
+* **bounded** — fixed slot count (``flight_recorder_slots``), the ring
+  overwrites oldest; slot payloads are replaced, never accumulated;
+* **always on** — recording is the default (``flight_recorder_enabled``)
+  because the whole point is having the tail when something wedges
+  unexpectedly; ``record()`` degrades to one dict read when disabled.
+
+Dumped on demand (``debug_dump`` RPC / ``ray-tpu doctor``), on watchdog
+trip (the wedge report carries :func:`tail`), and by tests.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+_DEFAULT_SLOTS = 512
+
+# The ring: preallocated fixed-size slot list.  Each slot is a 3-list
+# [wall_ts, category, fields_dict] mutated in place — steady state
+# allocates only the caller's kwargs dict.
+_lock = threading.Lock()        # debug-plane internal; exempt from R8
+_slots: List[list] = [[0.0, "", None] for _ in range(_DEFAULT_SLOTS)]
+_next = 0                       # next slot index to overwrite
+_written = 0                    # total records accepted
+_dropped = 0                    # records lost to recorder contention
+_enabled: Optional[bool] = None  # lazily read from config
+_sized = False                   # ring sized (from config or configure())
+
+
+def _peek_config():
+    """The config singleton WITHOUT get_config(): get_config takes
+    config._lock, which is itself a diag lock — a record() fired from
+    inside a lock acquire (the ``lock.hold`` fault hook) re-entering
+    get_config would self-deadlock on the non-reentrant inner lock.
+    A racy unlocked read is exactly right here: worst case None, and
+    we stay on defaults until the singleton exists."""
+    try:
+        from ray_tpu._private import config as config_mod
+        return config_mod._global_config
+    except Exception:
+        return None
+
+
+def _is_enabled() -> bool:
+    global _enabled, _sized
+    if _enabled is None:
+        cfg = _peek_config()
+        if cfg is None:
+            return True         # default-on until config initializes
+        _enabled = bool(cfg.flight_recorder_enabled)
+        if _enabled and not _sized:
+            try:
+                configure(slots=cfg.flight_recorder_slots)
+            except Exception:
+                pass
+    return _enabled
+
+
+def configure(enabled: Optional[bool] = None,
+              slots: Optional[int] = None) -> None:
+    """Resize / toggle the ring (tests, bench arms).  Resizing clears
+    it — slot records are positional, not copyable across sizes.  An
+    explicit size wins over the lazy config-derived one."""
+    global _enabled, _slots, _next, _sized
+    with _lock:
+        if enabled is not None:
+            _enabled = bool(enabled)
+        if slots is not None and slots > 0:
+            _sized = True
+            if slots != len(_slots):
+                global _written, _dropped
+                _slots = [[0.0, "", None] for _ in range(int(slots))]
+                _next = 0
+                # Resizing clears the ring — the counters must follow,
+                # or tail() walks never-written slots as phantom rows.
+                _written = 0
+                _dropped = 0
+
+
+def record(category: str, **fields) -> None:
+    """Append one decision record.  Never blocks, never raises: on
+    recorder contention the record is dropped and counted."""
+    global _next, _written, _dropped
+    if _enabled is False or (_enabled is None and not _is_enabled()):
+        return
+    if not _lock.acquire(blocking=False):
+        _dropped += 1           # GIL-atomic enough for a diagnostic
+        return
+    try:
+        slot = _slots[_next]
+        slot[0] = time.time()
+        slot[1] = category
+        slot[2] = fields
+        _next = (_next + 1) % len(_slots)
+        _written += 1
+    finally:
+        _lock.release()
+
+
+def tail(n: Optional[int] = None) -> List[Dict]:
+    """Last ``n`` records (default: whole ring), oldest first."""
+    with _lock:
+        size = len(_slots)
+        count = min(_written, size)
+        if n is not None:
+            count = min(count, max(0, int(n)))
+        out = []
+        idx = (_next - count) % size
+        for _ in range(count):
+            ts, cat, fields = _slots[idx]
+            row = {"ts": ts, "cat": cat}
+            if fields:
+                row.update(fields)
+            out.append(row)
+            idx = (idx + 1) % size
+        return out
+
+
+def stats() -> Dict[str, int]:
+    with _lock:
+        return {"written": _written, "dropped": _dropped,
+                "capacity": len(_slots)}
+
+
+def reset() -> None:
+    """Clear ring + counters (test isolation)."""
+    global _next, _written, _dropped
+    with _lock:
+        for slot in _slots:
+            slot[0], slot[1], slot[2] = 0.0, "", None
+        _next = 0
+        _written = 0
+        _dropped = 0

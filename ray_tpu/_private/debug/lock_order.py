@@ -56,6 +56,15 @@ def _armed() -> bool:
     return os.environ.get("RAY_TPU_LOCK_DIAG", "") == "1"
 
 
+def _contention_armed() -> bool:
+    """Contention profiling (``RAY_TPU_LOCK_CONTENTION=1``): the
+    always-cheap mode — per-named-lock sampled acquire-wait and
+    hold-time histograms, plus live held-lock sets for wedge reports,
+    WITHOUT the witness's acquisition-graph cycle checks.  Arms at lock
+    creation time, like the witness."""
+    return os.environ.get("RAY_TPU_LOCK_CONTENTION", "") == "1"
+
+
 # One-entry memo for the hold budget: releases are a hot path, so the
 # float parse runs only when the env string actually changes (tests
 # monkeypatch it; production sets it once).
@@ -91,12 +100,186 @@ _violations: List[str] = []
 
 _tls = threading.local()
 
+#: thread ident -> that thread's live held-lock stack (the SAME list
+#: object the thread mutates, so reads see current state).  Written
+#: once per thread; read by the watchdog's wedge reports.  Dead
+#: threads' idents are pruned by readers against live idents.
+_stacks_lock = threading.Lock()
+_all_stacks: Dict[int, list] = {}
+
 
 def _stack() -> list:
     st = getattr(_tls, "stack", None)
     if st is None:
         st = _tls.stack = []
+        with _stacks_lock:
+            _all_stacks[threading.get_ident()] = st
     return st
+
+
+def held_locks_by_thread() -> Dict[int, List[tuple]]:
+    """Live held-lock sets: thread ident -> [(lock_name, held_for_s,
+    depth), ...] outermost first.  Diagnostic snapshot — entries are
+    read racily against the owning threads (fine for a wedge report;
+    a torn row is at worst one stale lock line)."""
+    import sys
+    live = set(sys._current_frames())
+    now = time.monotonic()
+    out: Dict[int, List[tuple]] = {}
+    with _stacks_lock:
+        items = [(ident, st) for ident, st in _all_stacks.items()
+                 if ident in live]
+        for ident in list(_all_stacks):
+            if ident not in live:
+                del _all_stacks[ident]
+    for ident, st in items:
+        rows = []
+        for entry in list(st):
+            try:
+                rows.append((entry[0], now - entry[1], entry[2]))
+            except Exception:
+                continue
+        if rows:
+            out[ident] = rows
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Contention profiling: per-named-lock sampled wait/hold histograms.
+# Bounded by construction (#named locks is small and fixed; the
+# histograms are fixed-bucket accumulators).
+
+#: Histogram bucket bounds (seconds) for acquire-wait and hold times.
+CONTENTION_BUCKETS = (1e-5, 1e-4, 1e-3, 0.01, 0.1, 1.0)
+
+
+class _LockContention:
+    __slots__ = ("acquires", "contended", "wait_counts", "wait_sum",
+                 "wait_max", "hold_counts", "hold_sum", "hold_max",
+                 "holds")
+
+    def __init__(self):
+        self.acquires = 0
+        self.contended = 0      # waits that exceeded the first bucket
+        self.wait_counts = [0] * (len(CONTENTION_BUCKETS) + 1)
+        self.wait_sum = 0.0
+        self.wait_max = 0.0
+        self.holds = 0
+        self.hold_counts = [0] * (len(CONTENTION_BUCKETS) + 1)
+        self.hold_sum = 0.0
+        self.hold_max = 0.0
+
+
+def _bucket_index(value: float) -> int:
+    for i, b in enumerate(CONTENTION_BUCKETS):
+        if value <= b:
+            return i
+    return len(CONTENTION_BUCKETS)
+
+
+_contention_lock = threading.Lock()
+_contention: Dict[str, _LockContention] = {}
+
+# Sample 1-in-N acquires (default every acquire: two perf_counter
+# calls; the knob exists for pathological hot locks).
+try:
+    _SAMPLE_N = max(1, int(os.environ.get("RAY_TPU_LOCK_SAMPLE_N", "1")))
+except ValueError:
+    _SAMPLE_N = 1
+
+
+def _contention_stats(name: str) -> _LockContention:
+    st = _contention.get(name)
+    if st is None:
+        with _contention_lock:
+            st = _contention.setdefault(name, _LockContention())
+    return st
+
+
+# The per-operation stat updates below run WITHOUT the registry lock,
+# deliberately: this is the "always-cheap" mode and a process-global
+# lock taken on every armed acquire AND release would itself be a
+# convoy point — one the profiler could never attribute (its own lock
+# is bare).  Under the GIL each individual += / compare is close
+# enough to atomic that a rare lost increment is noise in a sampled
+# diagnostic; _contention_lock guards only dict insertion and
+# snapshot copies.
+
+
+def _note_wait(name: str, wait_s: float) -> None:
+    st = _contention_stats(name)
+    st.acquires += 1
+    st.wait_counts[_bucket_index(wait_s)] += 1
+    st.wait_sum += wait_s
+    if wait_s > st.wait_max:
+        st.wait_max = wait_s
+    if wait_s > CONTENTION_BUCKETS[0]:
+        st.contended += 1
+
+
+def _note_hold(name: str, hold_s: float) -> None:
+    st = _contention_stats(name)
+    st.holds += 1
+    st.hold_counts[_bucket_index(hold_s)] += 1
+    st.hold_sum += hold_s
+    if hold_s > st.hold_max:
+        st.hold_max = hold_s
+
+
+def contention_snapshot() -> Dict[str, dict]:
+    """Per-named-lock contention stats: acquire counts, contended
+    counts, wait/hold histogram counts (``CONTENTION_BUCKETS`` + +Inf),
+    sums and maxima.  Empty unless contention (or witness) mode armed
+    locks have been exercised."""
+    with _contention_lock:
+        items = list(_contention.items())
+    return {name: {
+        "acquires": st.acquires,
+        "contended": st.contended,
+        "wait_counts": list(st.wait_counts),
+        "wait_sum_s": st.wait_sum,
+        "wait_max_s": st.wait_max,
+        "holds": st.holds,
+        "hold_counts": list(st.hold_counts),
+        "hold_sum_s": st.hold_sum,
+        "hold_max_s": st.hold_max,
+    } for name, st in items}
+
+
+def reset_contention() -> None:
+    with _contention_lock:
+        _contention.clear()
+
+
+_sample_tick = 0
+
+
+def _sampled() -> bool:
+    """1-in-``RAY_TPU_LOCK_SAMPLE_N`` acquire-wait sampling gate
+    (default: every acquire).  The counter bump is racy under threads —
+    harmless: sampling only needs to be approximately 1-in-N."""
+    if _SAMPLE_N == 1:
+        return True
+    global _sample_tick
+    _sample_tick += 1
+    return _sample_tick % _SAMPLE_N == 0
+
+
+_fi_hook = None
+
+
+def _fault_hook():
+    """Lazily-bound ``fault_injection.hook`` (imported on first armed
+    acquire: fault_injection imports ray_tpu.exceptions, which must not
+    be pulled in while this module bootstraps the debug package)."""
+    global _fi_hook
+    if _fi_hook is None:
+        try:
+            from ray_tpu._private import fault_injection
+            _fi_hook = fault_injection.hook
+        except Exception:
+            _fi_hook = False
+    return _fi_hook or None
 
 
 def _site(skip: int = 2) -> str:
@@ -250,11 +433,14 @@ class _DiagBase:
     (the witness never *masks* behavior).
     """
 
-    __slots__ = ("_inner", "name")
+    __slots__ = ("_inner", "name", "_witness", "_contend")
 
-    def __init__(self, inner, name: str):
+    def __init__(self, inner, name: str, witness: bool = True,
+                 contend: bool = False):
         self._inner = inner
         self.name = name
+        self._witness = witness
+        self._contend = contend
 
     # -- bookkeeping ----------------------------------------------------
     # Stack entries: [name, t_acquired, depth, lock_instance_id].
@@ -265,7 +451,7 @@ class _DiagBase:
             if entry[3] == me:
                 entry[2] += 1          # true reentrancy: same instance
                 return
-        if st:
+        if st and self._witness:
             if st[-1][0] == self.name:
                 # A DIFFERENT instance of the same name while one is
                 # held: hierarchical same-class nesting.  Recorded as a
@@ -287,6 +473,8 @@ class _DiagBase:
                 if st[i][2] == 0:
                     held_for = time.monotonic() - st[i][1]
                     del st[i]
+                    if self._contend:
+                        _note_hold(self.name, held_for)
                     budget = _hold_budget_s()
                     if budget > 0 and held_for > budget:
                         raise LockHoldBudgetExceeded(
@@ -297,7 +485,13 @@ class _DiagBase:
 
     # -- lock protocol ---------------------------------------------------
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
-        got = self._inner.acquire(blocking, timeout)
+        if self._contend and _sampled():
+            t0 = time.perf_counter()
+            got = self._inner.acquire(blocking, timeout)
+            if got:
+                _note_wait(self.name, time.perf_counter() - t0)
+        else:
+            got = self._inner.acquire(blocking, timeout)
         if got:
             try:
                 self._note_acquired()
@@ -306,6 +500,22 @@ class _DiagBase:
                 # never runs, so nothing would ever release it.
                 self._inner.release()
                 raise
+            # Fault point ``lock.hold``: delay mode extends THIS
+            # acquisition's hold window — the deterministic way to
+            # manufacture attributable contention in tests.  An
+            # error/kill-mode arming raises OUT of acquire(): the
+            # caller's `with` body never runs, so the inner lock and
+            # the held-set bookkeeping must be unwound here (same
+            # discipline as the LockOrderViolation branch above) or
+            # the lock leaks held forever.
+            hook = _fault_hook()
+            if hook is not None:
+                try:
+                    hook("lock.hold")
+                except BaseException:
+                    self._inner.release()
+                    self._note_released()
+                    raise
         return got
 
     def release(self) -> None:
@@ -355,6 +565,8 @@ class DiagRLock(_DiagBase):
         for i in range(len(st) - 1, -1, -1):
             if st[i][3] == me:
                 depth = st[i][2]
+                if self._contend:
+                    _note_hold(self.name, time.monotonic() - st[i][1])
                 del st[i]
                 break
         saved = (self._inner._release_save()
@@ -374,7 +586,7 @@ class DiagRLock(_DiagBase):
         # internal state corrupts.  The cycle still lands in
         # ``violations()`` and will raise at the next normal-path hit.
         st = _stack()
-        if st and st[-1][0] != self.name:
+        if self._witness and st and st[-1][0] != self.name:
             _record_edge(st[-1][0], self.name, raise_on_cycle=False)
         st.append([self.name, time.monotonic(), max(1, depth), id(self)])
 
@@ -392,17 +604,23 @@ class DiagRLock(_DiagBase):
 
 
 def diag_lock(name: Optional[str] = None) -> "threading.Lock | DiagLock":
-    """A ``threading.Lock``, wrapped by the witness when armed."""
-    if not _armed():
+    """A ``threading.Lock``, wrapped when the witness OR contention
+    profiling is armed (plain primitive otherwise)."""
+    witness, contend = _armed(), _contention_armed()
+    if not witness and not contend:
         return threading.Lock()
-    return DiagLock(threading.Lock(), name or f"lock@{_site()}")
+    return DiagLock(threading.Lock(), name or f"lock@{_site()}",
+                    witness=witness, contend=contend)
 
 
 def diag_rlock(name: Optional[str] = None) -> "threading.RLock | DiagRLock":
-    """A ``threading.RLock``, wrapped by the witness when armed."""
-    if not _armed():
+    """A ``threading.RLock``, wrapped when the witness OR contention
+    profiling is armed."""
+    witness, contend = _armed(), _contention_armed()
+    if not witness and not contend:
         return threading.RLock()
-    return DiagRLock(threading.RLock(), name or f"rlock@{_site()}")
+    return DiagRLock(threading.RLock(), name or f"rlock@{_site()}",
+                     witness=witness, contend=contend)
 
 
 def diag_condition(lock=None, name: Optional[str] = None) -> threading.Condition:
@@ -411,10 +629,13 @@ def diag_condition(lock=None, name: Optional[str] = None) -> threading.Condition
     ``with cond: ... cond.wait()`` keeps exact held-set bookkeeping —
     the wait's full release/re-acquire goes through the wrapper's
     ``_release_save``/``_acquire_restore``."""
-    if not _armed():
+    witness, contend = _armed(), _contention_armed()
+    if not witness and not contend:
         return threading.Condition(lock)
     if lock is None:
-        lock = DiagRLock(threading.RLock(), name or f"cond@{_site()}")
+        lock = DiagRLock(threading.RLock(), name or f"cond@{_site()}",
+                         witness=witness, contend=contend)
     elif not isinstance(lock, _DiagBase):
-        lock = DiagRLock(lock, name or f"cond@{_site()}")
+        lock = DiagRLock(lock, name or f"cond@{_site()}",
+                         witness=witness, contend=contend)
     return threading.Condition(lock)

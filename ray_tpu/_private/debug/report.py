@@ -1,0 +1,70 @@
+"""Per-OS-process introspection report — the ``debug_dump`` RPC body.
+
+One dict per process, assembled from the always-on debug plane:
+watchdog loop snapshots (busy/idle, current handler, queue depth, wedge
+state), wedge reports, lock-contention rollup, flight-recorder tail,
+swallowed-exception counts and (optionally) every thread's stack.  The
+head's ``debug_dump`` handler fans this out across the cluster and
+``ray-tpu doctor`` renders it.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, Optional
+
+from ray_tpu._private.debug import (flight_recorder, lock_order, swallow,
+                                    watchdog)
+
+
+def top_locks(n: int = 5) -> list:
+    """The ``n`` hottest locks by total sampled acquire-wait time."""
+    snap = lock_order.contention_snapshot()
+    rows = []
+    for name, st in snap.items():
+        rows.append({
+            "lock": name,
+            "acquires": st["acquires"],
+            "contended": st["contended"],
+            "wait_total_s": round(st["wait_sum_s"], 6),
+            "wait_max_s": round(st["wait_max_s"], 6),
+            "hold_max_s": round(st["hold_max_s"], 6),
+            "hold_total_s": round(st["hold_sum_s"], 6),
+        })
+    rows.sort(key=lambda r: r["wait_total_s"], reverse=True)
+    return rows[:n]
+
+
+def build_debug_report(include_stacks: bool = True,
+                       tail: int = 50,
+                       top_n_locks: int = 8) -> Dict:
+    """Assemble this process's introspection report (cheap: snapshot
+    reads only — safe to serve from an RPC handler while wedged,
+    because none of the sources below take runtime locks)."""
+    loops = watchdog.loops_snapshot()
+    loops.sort(key=lambda s: (not s["wedged"], -s["busy_for_s"]))
+    report = {
+        "pid": os.getpid(),
+        "ts": time.time(),
+        "stall_budget_s": watchdog.stall_budget_s(),
+        "loops": loops,
+        "wedges": watchdog.wedge_reports(),
+        "locks": top_locks(top_n_locks),
+        "recorder_tail": flight_recorder.tail(tail),
+        "recorder_stats": flight_recorder.stats(),
+        "swallowed": swallow.counts(),
+    }
+    if include_stacks:
+        report["stacks"] = watchdog.thread_stacks()
+        report["held_locks"] = watchdog.held_locks()
+    return report
+
+
+def handle_debug_dump(payload: Optional[dict]) -> Dict:
+    """RPC-handler shape shared by node hosts and the head's own
+    process: payload keys ``stacks`` (bool) and ``tail`` (int)."""
+    payload = payload or {}
+    return build_debug_report(
+        include_stacks=bool(payload.get("stacks", True)),
+        tail=int(payload.get("tail", 50)))

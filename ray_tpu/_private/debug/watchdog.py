@@ -1,0 +1,433 @@
+"""Per-process stall watchdog over event loops and pump threads.
+
+Every :class:`~ray_tpu._private.event_loop.EventLoop` (and long-lived
+pump thread — spill io, task-event flusher) registers a
+:class:`LoopBeat` and stamps it around each unit of work.  One daemon
+watchdog thread per process polls the beats: a loop whose current
+handler has been running past the stall budget
+(``loop_stall_budget_s``), or that has queued work but made no progress
+for the budget, is WEDGED — the watchdog builds a wedge report (every
+thread's stack via ``sys._current_frames``, each thread's held
+diag-lock set, the flight-recorder tail, swallowed-exception counts),
+writes it to a crash file under ``<temp_dir>/wedges/`` and hands it to
+registered listeners (node_host ships it to the head, which downgrades
+the node's internal-loop liveness).  Recovery is reported too — the
+report list keeps the evidence.
+
+Parity: the reference raylet's ``DumpDebugState`` + the
+``RAY_event_stats`` deadline detector ("handler X ran for Ys") — made
+an active detector instead of a post-hoc log line, because PR 6/7's
+hardest bugs (wedged loops, lock convoys) were only root-caused with
+ad-hoc thread dumps.
+
+The watchdog only ever REPORTS — it never kills, unwinds, or releases
+anything; an over-budget handler that eventually finishes shows up as
+wedge + recovery, which is exactly the evidence a tail-latency hunt
+needs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Dict, List, Optional
+
+from ray_tpu._private.debug import flight_recorder, lock_order, swallow
+
+_MAX_REPORTS = 32
+
+
+class LoopBeat:
+    """One monitored loop/pump thread's heartbeat cell.  The stamping
+    methods are the hot path (called around every handler): plain
+    attribute writes + one ``time.monotonic`` — no locks."""
+
+    __slots__ = ("name", "kind", "thread_ident", "last_beat",
+                 "busy_since", "handler", "wedged", "wedge_count",
+                 "_queue_depth_fn", "_stats_fn")
+
+    def __init__(self, name: str, kind: str,
+                 queue_depth: Optional[Callable[[], int]] = None,
+                 stats: Optional[Callable[[], dict]] = None):
+        self.name = name
+        self.kind = kind
+        self.thread_ident: Optional[int] = None
+        self.last_beat = time.monotonic()
+        self.busy_since: Optional[float] = None
+        self.handler: Optional[str] = None
+        self.wedged = False
+        self.wedge_count = 0
+        self._queue_depth_fn = queue_depth
+        self._stats_fn = stats
+
+    # -- stamping (hot path) --------------------------------------------
+    def begin(self, handler: str) -> None:
+        """A unit of work starts on the owning thread."""
+        if self.thread_ident is None:
+            self.thread_ident = threading.get_ident()
+        self.handler = handler
+        self.busy_since = time.monotonic()
+
+    def end(self) -> None:
+        """The unit of work finished: progress."""
+        self.last_beat = time.monotonic()
+        self.busy_since = None
+        self.handler = None
+
+    def alive(self) -> None:
+        """Idle-loop heartbeat (pump threads stamp this each wakeup)."""
+        self.last_beat = time.monotonic()
+
+    # -- inspection ------------------------------------------------------
+    def queue_depth(self) -> int:
+        fn = self._queue_depth_fn
+        if fn is None:
+            return 0
+        try:
+            return int(fn())
+        except Exception:
+            return 0
+
+    def stats(self) -> dict:
+        fn = self._stats_fn
+        if fn is None:
+            return {}
+        try:
+            return dict(fn())
+        except Exception:
+            return {}
+
+    def snapshot(self) -> dict:
+        now = time.monotonic()
+        busy = self.busy_since
+        return {
+            "name": self.name,
+            "kind": self.kind,
+            "busy_for_s": round(now - busy, 4) if busy else 0.0,
+            "idle_for_s": 0.0 if busy else round(now - self.last_beat, 4),
+            "handler": self.handler,
+            "queue_depth": self.queue_depth(),
+            "wedged": self.wedged,
+            "wedge_count": self.wedge_count,
+            **self.stats(),
+        }
+
+
+_lock = threading.Lock()        # debug-plane internal; exempt from R8
+_beats: List[LoopBeat] = []
+_listeners: List[Callable] = []
+_reports: List[dict] = []
+_wedges_total = 0
+_thread: Optional[threading.Thread] = None
+_COLLECTOR_OWNER = None         # keeps the introspection collector alive
+
+
+def _config():
+    try:
+        from ray_tpu._private.config import get_config
+        return get_config()
+    except Exception:
+        return None
+
+
+def _enabled() -> bool:
+    cfg = _config()
+    return True if cfg is None else bool(cfg.watchdog_enabled)
+
+
+def stall_budget_s() -> float:
+    cfg = _config()
+    return 10.0 if cfg is None else float(cfg.loop_stall_budget_s)
+
+
+def register(name: str, kind: str = "loop",
+             queue_depth: Optional[Callable[[], int]] = None,
+             stats: Optional[Callable[[], dict]] = None) -> LoopBeat:
+    """Register a loop/pump thread for monitoring; starts the watchdog
+    thread (and the /metrics introspection collector) on first use."""
+    beat = LoopBeat(name, kind, queue_depth=queue_depth, stats=stats)
+    with _lock:
+        _beats.append(beat)
+    _ensure_started()
+    return beat
+
+
+def unregister(beat: LoopBeat) -> None:
+    with _lock:
+        try:
+            _beats.remove(beat)
+        except ValueError:
+            pass
+
+
+def add_listener(fn: Callable[[str, dict], None]) -> None:
+    """``fn(event, report)`` with event "wedge" | "recovered".  Called
+    from the watchdog thread; must not block."""
+    with _lock:
+        _listeners.append(fn)
+
+
+def remove_listener(fn: Callable) -> None:
+    with _lock:
+        try:
+            _listeners.remove(fn)
+        except ValueError:
+            pass
+
+
+def wedge_reports() -> List[dict]:
+    with _lock:
+        return list(_reports)
+
+
+def reset_reports() -> None:
+    """Clear wedge evidence (tests that wedge deliberately)."""
+    global _wedges_total
+    with _lock:
+        _reports.clear()
+        _wedges_total = 0
+        for b in _beats:
+            b.wedged = False
+
+
+def loops_snapshot() -> List[dict]:
+    with _lock:
+        beats = list(_beats)
+    return [b.snapshot() for b in beats]
+
+
+# ---------------------------------------------------------------------------
+# Wedge evidence assembly.
+
+
+def thread_stacks() -> Dict[str, List[str]]:
+    """Every live thread's current stack, keyed ``name(ident)``."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out: Dict[str, List[str]] = {}
+    for ident, frame in sys._current_frames().items():
+        label = f"{names.get(ident, '?')}({ident})"
+        out[label] = [ln.rstrip() for ln in
+                      traceback.format_stack(frame)][-24:]
+    return out
+
+
+def held_locks() -> Dict[str, List[str]]:
+    """Per-thread held diag-lock sets (needs the witness or contention
+    mode armed; empty otherwise), keyed like :func:`thread_stacks`."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out: Dict[str, List[str]] = {}
+    for ident, rows in lock_order.held_locks_by_thread().items():
+        label = f"{names.get(ident, '?')}({ident})"
+        out[label] = [f"{name} held {held_for:.3f}s (depth {depth})"
+                      for name, held_for, depth in rows]
+    return out
+
+
+def _build_wedge_report(beat: LoopBeat, stalled_for: float) -> dict:
+    return {
+        "type": "wedge",
+        "pid": os.getpid(),
+        "ts": time.time(),
+        "loop": beat.name,
+        "kind": beat.kind,
+        "handler": beat.handler,
+        "stalled_for_s": round(stalled_for, 3),
+        "budget_s": stall_budget_s(),
+        "queue_depth": beat.queue_depth(),
+        "stacks": thread_stacks(),
+        "held_locks": held_locks(),
+        "recorder_tail": flight_recorder.tail(50),
+        "recorder_stats": flight_recorder.stats(),
+        "swallowed": swallow.counts(),
+    }
+
+
+def _crash_dir() -> str:
+    cfg = _config()
+    base = cfg.temp_dir if cfg is not None else "/tmp/ray_tpu"
+    return os.path.join(base, "wedges")
+
+
+def _write_crash_file(report: dict) -> Optional[str]:
+    """Persist the wedge report to disk AT TRIP TIME — if the wedged
+    process is subsequently SIGKILLed, the evidence survives it."""
+    try:
+        d = _crash_dir()
+        os.makedirs(d, exist_ok=True)
+        safe = "".join(c if c.isalnum() or c in "-_" else "_"
+                       for c in report["loop"])
+        path = os.path.join(
+            d, f"wedge-{report['pid']}-{safe}-{int(report['ts'])}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=1, default=str)
+        os.replace(tmp, path)
+        return path
+    except Exception as e:
+        swallow.noted("watchdog.crash_file", e)
+        return None
+
+
+def _notify(event: str, report: dict) -> None:
+    with _lock:
+        listeners = list(_listeners)
+    for fn in listeners:
+        try:
+            fn(event, report)
+        except Exception as e:
+            swallow.noted("watchdog.listener", e)
+
+
+# ---------------------------------------------------------------------------
+# The watchdog thread.
+
+
+def _ensure_started() -> None:
+    global _thread
+    if not _enabled():
+        return
+    with _lock:
+        if _thread is not None and _thread.is_alive():
+            return
+        _thread = threading.Thread(target=_run, daemon=True,
+                                   name="ray_tpu::watchdog")
+        _thread.start()
+    _ensure_collector()
+
+
+def _run() -> None:
+    while True:
+        budget = stall_budget_s()
+        cfg = _config()
+        poll = cfg.watchdog_poll_interval_s if cfg is not None else 0.5
+        time.sleep(max(0.05, min(poll, budget / 4 if budget > 0 else poll)))
+        if budget <= 0:
+            continue
+        try:
+            _poll_once(budget)
+        except Exception as e:
+            swallow.noted("watchdog.poll", e)
+
+
+def _poll_once(budget: float) -> None:
+    global _wedges_total
+    now = time.monotonic()
+    with _lock:
+        beats = list(_beats)
+    for beat in beats:
+        busy = beat.busy_since
+        if busy is not None and now - busy > budget:
+            stalled = now - busy
+        elif busy is None and beat.queue_depth() > 0 \
+                and now - beat.last_beat > budget:
+            # Work queued but the loop thread is not running it: the
+            # thread died, or is parked in a wait it will never leave.
+            stalled = now - beat.last_beat
+        else:
+            if beat.wedged:
+                beat.wedged = False
+                _notify("recovered", {
+                    "type": "recovered", "pid": os.getpid(),
+                    "ts": time.time(), "loop": beat.name})
+                flight_recorder.record("watchdog.recovered",
+                                       loop=beat.name)
+            continue
+        if beat.wedged:
+            continue            # one report per wedge episode
+        beat.wedged = True
+        beat.wedge_count += 1
+        report = _build_wedge_report(beat, stalled)
+        flight_recorder.record("watchdog.wedge", loop=beat.name,
+                               handler=beat.handler,
+                               stalled_for_s=round(stalled, 3))
+        path = _write_crash_file(report)
+        if path:
+            report["crash_file"] = path
+        with _lock:
+            _reports.append(report)
+            del _reports[:-_MAX_REPORTS]
+            _wedges_total += 1
+        _notify("wedge", report)
+
+
+# ---------------------------------------------------------------------------
+# /metrics: one process-wide introspection collector exporting the
+# orphaned in-memory diagnostics — swallowed-exception counters, lock
+# contention histograms, watchdog state.  (Per-loop handler stats are
+# exported by each EventLoop's own collector.)
+
+
+class _IntrospectionOwner:
+    """Weakref-able anchor tying the process-wide introspection
+    collector's series to this module's lifetime."""
+
+
+def _ensure_collector() -> None:
+    global _COLLECTOR_OWNER
+    if _COLLECTOR_OWNER is not None:
+        return
+    try:
+        from ray_tpu._private.metrics_agent import get_metrics_registry
+    except Exception:
+        return
+    owner = _IntrospectionOwner()
+
+    def _collect(_owner):
+        _render_introspection_metrics()
+
+    _COLLECTOR_OWNER = owner
+    get_metrics_registry().register_collector(owner, _collect)
+
+
+def _render_introspection_metrics() -> None:
+    from ray_tpu._private.metrics_agent import (_Hist,
+                                                get_metrics_registry)
+    reg = get_metrics_registry()
+    # Swallowed-exception counters (debug.swallow — previously only
+    # visible in-process).
+    reg.register("ray_tpu.swallowed_exceptions", "counter",
+                 "deliberately-swallowed pump-loop exceptions per site")
+    for site, n in swallow.counts().items():
+        reg.put_series("ray_tpu.swallowed_exceptions",
+                       (("site", site),), float(n))
+    # Watchdog state.
+    with _lock:
+        wedged = sum(1 for b in _beats if b.wedged)
+        total = _wedges_total
+    reg.register("ray_tpu.watchdog.wedged_loops", "gauge",
+                 "loops currently past their stall budget")
+    reg.put_series("ray_tpu.watchdog.wedged_loops", (), float(wedged))
+    reg.register("ray_tpu.watchdog.wedge_reports", "counter",
+                 "wedge reports emitted since process start")
+    reg.put_series("ray_tpu.watchdog.wedge_reports", (), float(total))
+    # Lock contention histograms (sampled acquire-wait + hold time per
+    # named lock; empty unless contention/witness mode armed).
+    buckets = list(lock_order.CONTENTION_BUCKETS)
+    snap = lock_order.contention_snapshot()
+    if not snap:
+        return
+    reg.register("ray_tpu.lock.acquire_wait_seconds", "histogram",
+                 "sampled lock acquire-wait time per named lock",
+                 buckets=buckets)
+    reg.register("ray_tpu.lock.hold_seconds", "histogram",
+                 "lock hold time per named lock", buckets=buckets)
+    reg.register("ray_tpu.lock.contended_acquires", "counter",
+                 "sampled acquires that waited past the first bucket")
+    for name, st in snap.items():
+        labels = (("lock", name),)
+        wait = _Hist(len(buckets))
+        wait.counts[:] = st["wait_counts"][:len(buckets)]
+        wait.sum = st["wait_sum_s"]
+        wait.count = st["acquires"]
+        reg.put_series("ray_tpu.lock.acquire_wait_seconds", labels, wait)
+        hold = _Hist(len(buckets))
+        hold.counts[:] = st["hold_counts"][:len(buckets)]
+        hold.sum = st["hold_sum_s"]
+        hold.count = st["holds"]
+        reg.put_series("ray_tpu.lock.hold_seconds", labels, hold)
+        reg.put_series("ray_tpu.lock.contended_acquires", labels,
+                       float(st["contended"]))

@@ -4,6 +4,12 @@ Parity: reference ``src/ray/common/asio/`` (boost::asio io_context per daemon
 with periodic timers and post()ed handlers, instrumented with per-handler
 stats).  Here an event loop is a thread + monotonic timer heap; stats are
 kept per handler name for the debug dump (scheduler_stats.cc parity).
+
+Introspection plane (ISSUE 13): every loop registers a watchdog beat
+(stall detection + wedge reports), measures post-to-run lag and the
+slowest handler, and exports ``handler_stats`` — previously an orphaned
+in-memory dict — plus the lag/slowest gauges as /metrics series through
+a scrape-time collector.
 """
 
 from __future__ import annotations
@@ -14,27 +20,87 @@ import time
 import traceback
 from typing import Callable, Dict, Optional
 
-from ray_tpu._private.debug import diag_condition, thread_registry
+from ray_tpu._private import fault_injection
+from ray_tpu._private.debug import (diag_condition, thread_registry,
+                                    watchdog)
 
 
 class EventLoop:
     def __init__(self, name: str = "loop"):
         self.name = name
         self._cond = diag_condition(name="EventLoop._cond")
-        self._queue = []            # immediate handlers
+        self._queue = []            # (name, fn, t_posted) immediate handlers
         self._timers = []           # (deadline, seq, period, name, fn)
         self._seq = 0
         self._stopped = False
         self.handler_stats: Dict[str, dict] = {}
+        # Post-to-run lag (how long a posted handler waited for the
+        # loop thread) + slowest-handler tracking: plain attribute
+        # accumulation on the loop thread, rendered by the collector.
+        self.lag_count = 0
+        self.lag_sum_s = 0.0
+        self.lag_max_s = 0.0
+        self.slowest_handler = ""
+        self.slowest_handler_s = 0.0
+        self._beat = watchdog.register(
+            name, kind="loop",
+            queue_depth=lambda: len(self._queue),
+            stats=self._beat_stats)
+        self._register_metrics()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"ray_tpu::{name}")
         self._thread.start()
+
+    def _beat_stats(self) -> dict:
+        return {
+            "lag_max_s": round(self.lag_max_s, 6),
+            "lag_mean_s": round(self.lag_sum_s / self.lag_count, 6)
+            if self.lag_count else 0.0,
+            "slowest_handler": self.slowest_handler,
+            "slowest_handler_s": round(self.slowest_handler_s, 6),
+        }
+
+    def _register_metrics(self):
+        """Export this loop's per-handler stats + lag gauges at /metrics
+        (scrape-time collector: zero cost on the handler path, series
+        pruned when the loop is collected)."""
+        try:
+            from ray_tpu._private.metrics_agent import (
+                get_metrics_registry, record_internal)
+        except Exception:       # early-bootstrap import failure
+            return
+
+        def _collect(loop):
+            label = {"loop": loop.name}
+            for handler, st in list(loop.handler_stats.items()):
+                hl = dict(label, handler=handler)
+                # Cumulative values exported as gauges (set, not inc):
+                # a scrape-time collector re-runs per exposition and a
+                # counter-typed inc would double-count every scrape.
+                record_internal("ray_tpu.event_loop.handler_count",
+                                st["count"], **hl)
+                record_internal("ray_tpu.event_loop.handler_total_s",
+                                st["total_s"], **hl)
+                record_internal("ray_tpu.event_loop.handler_max_s",
+                                st["max_s"], **hl)
+            record_internal("ray_tpu.event_loop.queue_depth",
+                            len(loop._queue), **label)
+            record_internal("ray_tpu.event_loop.lag_max_s",
+                            loop.lag_max_s, **label)
+            record_internal(
+                "ray_tpu.event_loop.lag_mean_s",
+                loop.lag_sum_s / loop.lag_count if loop.lag_count
+                else 0.0, **label)
+            record_internal("ray_tpu.event_loop.slowest_handler_s",
+                            loop.slowest_handler_s, **label)
+
+        get_metrics_registry().register_collector(self, _collect)
 
     def post(self, fn: Callable, name: str = "anon"):
         with self._cond:
             if self._stopped:
                 return
-            self._queue.append((name, fn))
+            self._queue.append((name, fn, time.monotonic()))
             self._cond.notify()
 
     def schedule_every(self, period_s: float, fn: Callable, name: str):
@@ -71,6 +137,9 @@ class EventLoop:
         st["count"] += 1
         st["total_s"] += elapsed
         st["max_s"] = max(st["max_s"], elapsed)
+        if elapsed > self.slowest_handler_s:
+            self.slowest_handler_s = elapsed
+            self.slowest_handler = name
 
     def _run(self):
         # Loop-affinity identity (@loop_only runtime checks): this thread
@@ -83,16 +152,18 @@ class EventLoop:
             self._run_inner()
         finally:
             thread_registry.unregister_current()
+            watchdog.unregister(self._beat)
 
     def _run_inner(self):
         while True:
             fn = None
             name = None
+            posted_at = None
             with self._cond:
                 while not self._stopped:
                     now = time.monotonic()
                     if self._queue:
-                        name, fn = self._queue.pop(0)
+                        name, fn, posted_at = self._queue.pop(0)
                         break
                     if self._timers and self._timers[0][0] <= now:
                         deadline, seq, period, name, fn = heapq.heappop(
@@ -110,8 +181,23 @@ class EventLoop:
                 if self._stopped:
                     return
             t0 = time.monotonic()
+            if posted_at is not None:
+                # Post-to-run lag: how long the handler sat behind the
+                # GIL / earlier handlers — the startup-stage tail PR 11
+                # could not attribute.
+                lag = t0 - posted_at
+                self.lag_count += 1
+                self.lag_sum_s += lag
+                if lag > self.lag_max_s:
+                    self.lag_max_s = lag
+            self._beat.begin(name)
             try:
+                # Fault point ``loop.stall``: delay mode wedges THIS
+                # loop mid-handler — the deterministic watchdog drill.
+                fault_injection.hook("loop.stall")
                 fn()
             except Exception:
                 traceback.print_exc()
+            finally:
+                self._beat.end()
             self._record(name, time.monotonic() - t0)

@@ -17,6 +17,7 @@ import time
 from ray_tpu import exceptions
 from ray_tpu._private import worker_context
 from ray_tpu._private.config import get_config
+from ray_tpu._private.debug.lock_order import diag_lock
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.object_store import DeviceObject, entry_value
 from ray_tpu._private.serialization import deserialize, serialize
@@ -111,7 +112,7 @@ def execute_task(spec: TaskSpec, node, core_worker, actor_instance=None):
 
 
 _env_ctx_cache: dict = {}
-_env_ctx_lock = threading.Lock()
+_env_ctx_lock = diag_lock("executor._env_ctx_lock")
 
 
 def _applied_runtime_env(spec: TaskSpec, node):

@@ -19,6 +19,12 @@ Named points wired into the runtime (grep ``fault_injection.hook``):
 ``node.heartbeat``        before a raylet sends its GCS heartbeat
 ``worker.dispatch``       before a scheduled task is handed to local dispatch
 ``worker.lease_batch``    before a batched lease request enters scheduling
+``loop.stall``            before an EventLoop executes a handler (delay mode
+                          wedges the loop — the stall-watchdog drill)
+``lock.hold``             after a diag lock is acquired (delay mode extends
+                          the hold — attributable contention for the
+                          profiling plane; only fires on witness/contention
+                          wrapped locks)
 ========================  ====================================================
 
 Modes:
@@ -98,6 +104,15 @@ def hook(point: str) -> None:
         arming.fired += 1
         _fired[point] = _fired.get(point, 0) + 1
         mode, delay_s = arming.mode, arming.delay_s
+    # Flight recorder: fault firings are exactly the "why did THAT
+    # happen" events a post-hoc tail must contain.  Recorded before the
+    # kill so the evidence lands even when the process dies here.
+    try:
+        from ray_tpu._private.debug import flight_recorder
+        flight_recorder.record("fault.fired", point=point, mode=mode,
+                               delay_s=delay_s)
+    except Exception:
+        pass
     if mode == "delay":
         time.sleep(delay_s)
         return

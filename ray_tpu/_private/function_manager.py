@@ -11,6 +11,7 @@ import hashlib
 import threading
 from typing import Callable, Dict
 
+from ray_tpu._private.debug.lock_order import diag_lock
 from ray_tpu._private.ids import FunctionID
 from ray_tpu._private.serialization import dumps_function, loads_function
 
@@ -20,7 +21,7 @@ _KV_PREFIX = b"fn:"
 class FunctionManager:
     def __init__(self, kv):
         self._kv = kv
-        self._lock = threading.Lock()
+        self._lock = diag_lock("FunctionManager._lock")
         # id(fn) -> (FunctionID, weakref-to-fn); the weakref guards
         # against id() reuse after the original function is collected.
         self._export_cache: Dict[int, tuple] = {}

@@ -28,7 +28,7 @@ from ray_tpu._private.ids import NodeID, ObjectID, WorkerID
 from ray_tpu._private.serialization import SerializedObject
 from ray_tpu.rpc import RpcClient, RpcServer
 from ray_tpu.scheduler.resources import NodeResources
-from ray_tpu._private.debug import diag_lock
+from ray_tpu._private.debug import diag_lock, flight_recorder
 
 
 def _ignore(_result, _err):
@@ -349,12 +349,19 @@ class HeadService:
         # delta merges here under a node_id label; a dead node's series
         # are pruned with its federation entry.
         self.metrics_federation = MetricsFederation()
+        # Internal-loop liveness per node (the "why is it stuck" plane):
+        # node hosts ship wedge reports as their watchdog fires — a node
+        # whose raylet loop is wedged still HEARTBEATS, so this map sees
+        # what the heartbeat plane cannot.  node_hex -> state dict.
+        self.loop_liveness: Dict[str, dict] = {}
         self.server = RpcServer(port=port, name="head")
         s = self.server
         s.register("register_node", self._handle_register_node)
         s.register("unregister_node", self._handle_unregister_node)
         s.register("heartbeat", self._handle_heartbeat)
         s.register("metrics_report", self._handle_metrics_report)
+        s.register("wedge_report", self._handle_wedge_report)
+        s.register("debug_dump", self._handle_debug_dump)
         # Clock-sync anchor: nodes probe this to estimate their offset
         # to the head clock (timeline normalization, stage durations).
         s.register("clock_probe", _head_clock)
@@ -484,6 +491,87 @@ class HeadService:
                                        full=payload.get("full", False))
         return True
 
+    def _handle_wedge_report(self, payload) -> bool:
+        """A node's watchdog tripped (or recovered): track its internal
+        loop liveness and keep the last wedge evidence for the doctor.
+        A 'wedge' downgrades liveness immediately; 'recovered' restores
+        it but keeps the report — the evidence IS the point."""
+        node_hex = NodeID(payload["node_id"]).hex()[:12]
+        event = payload.get("event", "wedge")
+        report = payload.get("report") or {}
+        from ray_tpu._private.metrics_agent import record_internal
+        with self._lock:
+            state = self.loop_liveness.setdefault(
+                node_hex, {"degraded": False, "wedges": 0,
+                           "last_report": None, "last_event_ts": 0.0})
+            state["last_event_ts"] = report.get("ts", 0.0)
+            if event == "wedge":
+                state["degraded"] = True
+                state["wedges"] += 1
+                state["last_report"] = report
+            else:
+                state["degraded"] = False
+            degraded = state["degraded"]
+        flight_recorder.record("node.loop_liveness", node=node_hex,
+                               event=event, degraded=degraded)
+        record_internal("ray_tpu.node.internal_loop_degraded",
+                        1.0 if degraded else 0.0, node=node_hex)
+        return True
+
+    def _handle_debug_dump(self, payload):
+        """Cluster-wide introspection collection (`ray-tpu doctor`):
+        this process's own report plus a bounded parallel fan-out of
+        per-node ``debug_dump`` RPCs — a WEDGED node must not be able
+        to hang the doctor past the per-node timeout, and an
+        unreachable one is itself a finding."""
+        from ray_tpu._private.debug.report import handle_debug_dump
+        payload = payload or {}
+        timeout = float(payload.get("timeout", 10.0))
+        out = {"head": handle_debug_dump(payload), "nodes": {}}
+        with self._lock:
+            proxies = dict(self._proxies)
+            out["liveness"] = {k: {kk: vv for kk, vv in v.items()
+                                   if kk != "last_report"}
+                               for k, v in self.loop_liveness.items()}
+            wedged = {k: v["last_report"]
+                      for k, v in self.loop_liveness.items()
+                      if v.get("last_report")}
+        results: Dict[str, object] = {}
+        threads = []
+
+        def collect(node_hex, proxy):
+            try:
+                results[node_hex] = proxy.client.call(
+                    "debug_dump", payload, timeout=timeout)
+            except Exception as e:
+                results[node_hex] = {"error": f"debug_dump failed: {e}"}
+
+        for node_id, proxy in proxies.items():
+            t = threading.Thread(
+                target=collect, args=(node_id.hex()[:12], proxy),
+                daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=timeout + 2.0)
+        # Snapshot before iterating: a collector thread that outlived
+        # its join timeout may still insert its (late) result while we
+        # read — exactly the wedged-node case the fan-out exists for.
+        for node_hex, report in list(results.items()):
+            out["nodes"][node_hex] = report
+        # A node that never answered (thread still running / no result)
+        # is reported as unreachable — with the head-held wedge
+        # evidence attached if we have any, which is exactly the case
+        # where the node is too wedged to serve its own dump.
+        for node_id in proxies:
+            node_hex = node_id.hex()[:12]
+            if node_hex not in out["nodes"]:
+                entry = {"error": "unreachable within timeout"}
+                if node_hex in wedged:
+                    entry["last_wedge_report"] = wedged[node_hex]
+                out["nodes"][node_hex] = entry
+        return out
+
     def _handle_actor_worker_died(self, payload) -> bool:
         self._cluster.gcs.actor_manager.on_actor_worker_died(
             payload["actor_id"], payload["reason"])
@@ -495,6 +583,18 @@ class HeadService:
     def _drop_proxy(self, node_id: NodeID):
         with self._lock:
             proxy = self._proxies.pop(node_id, None)
+            dropped_liveness = self.loop_liveness.pop(
+                node_id.hex()[:12], None)
+        if dropped_liveness is not None:
+            # A dead node is not "internally degraded" — its death is
+            # the heartbeat plane's story, and a lingering per-node
+            # series would grow label cardinality forever under churn:
+            # delete it (same promptness as the federation prune below).
+            from ray_tpu._private.metrics_agent import \
+                get_metrics_registry
+            get_metrics_registry().remove_series(
+                "ray_tpu.node.internal_loop_degraded",
+                (("node", node_id.hex()[:12]),))
         if proxy is not None:
             proxy.client.close()
         # A dead node's federated series must vanish from /metrics now

@@ -14,6 +14,8 @@ import os
 import random
 import threading
 
+from ray_tpu._private.debug.lock_order import diag_lock
+
 _ID_SIZE = 16  # 128-bit, matches reference UniqueID size.
 
 # ID generation is on the task-submission hot path (TaskID + one
@@ -91,7 +93,7 @@ class JobID(BaseID):
     SIZE = 4
 
     _counter = 0
-    _lock = threading.Lock()
+    _lock = diag_lock("ids._lock")
 
     @classmethod
     def from_int(cls, value: int) -> "JobID":

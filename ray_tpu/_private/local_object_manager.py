@@ -69,20 +69,30 @@ class LocalObjectManager:
 
     # ---- the io thread --------------------------------------------------
     def _loop(self) -> None:
-        while not self._stopped.is_set():
-            self._wake.wait(timeout=0.5)
-            if self._stopped.is_set():
-                return
-            self._wake.clear()
-            try:
-                while self._store.spill_shortfall() > 0:
-                    if not self._spill_once():
-                        break
-            except Exception:
-                # The spiller must survive anything (disk full,
-                # injected faults): the store's inline path and queue
-                # deadline still bound callers.
-                self.stats["spill_errors"] += 1
+        from ray_tpu._private.debug import watchdog
+        beat = watchdog.register(
+            self._thread.name.replace("ray_tpu::", ""), kind="pump",
+            queue_depth=lambda: 1 if self._wake.is_set() else 0)
+        try:
+            while not self._stopped.is_set():
+                self._wake.wait(timeout=0.5)
+                if self._stopped.is_set():
+                    return
+                self._wake.clear()
+                beat.begin("spill")
+                try:
+                    while self._store.spill_shortfall() > 0:
+                        if not self._spill_once():
+                            break
+                except Exception:
+                    # The spiller must survive anything (disk full,
+                    # injected faults): the store's inline path and
+                    # queue deadline still bound callers.
+                    self.stats["spill_errors"] += 1
+                finally:
+                    beat.end()
+        finally:
+            watchdog.unregister(beat)
 
     def _spill_once(self) -> bool:
         cfg = get_config()
@@ -132,6 +142,9 @@ class LocalObjectManager:
                 pass
             return False
         n = self._store.finish_spill_batch(path, results)
+        from ray_tpu._private.debug import flight_recorder
+        flight_recorder.record("spill.batch", objects=len(batch),
+                               published=n, bytes=offset)
         if n == 0:
             # Every victim was deleted mid-copy: drop the orphan file.
             try:

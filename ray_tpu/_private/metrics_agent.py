@@ -174,6 +174,16 @@ class MetricsRegistry:
             if rec is not None:
                 rec.series[labels] = value
 
+    def remove_series(self, name: str, labels: _LabelKey) -> None:
+        """Drop one series outright (event-driven pruning for series
+        written outside any collector's ownership — e.g. a dead node's
+        head-local liveness gauge, which would otherwise accumulate
+        one permanent label value per dead node under churn)."""
+        with self._lock:
+            rec = self._metrics.get(name)
+            if rec is not None:
+                rec.series.pop(labels, None)
+
     def get_value(self, name: str, labels: _LabelKey = ()):
         with self._lock:
             rec = self._metrics.get(name)

@@ -691,6 +691,22 @@ class NodeHost:
         # (a chaos test whose fault never fired proves nothing).
         s.register("fault_fired",
                    lambda p: fault_injection.fired(p["point"]))
+        # Deterministic wire arming (chaos tests that need a fault
+        # AFTER startup, where env-var count-skipping is unpredictable
+        # — e.g. one loop.stall wedge once the node is registered).
+        s.register("arm_fault", self._handle_arm_fault)
+        # Introspection plane: this OS process's debug report (loops,
+        # wedges, lock contention, flight-recorder tail, stacks) for
+        # the head's cluster-wide `ray-tpu doctor` fan-out.
+        from ray_tpu._private.debug.report import handle_debug_dump
+        s.register("debug_dump", handle_debug_dump)
+        # Wedge reports ship to the head as they fire, so the head
+        # tracks INTERNAL loop liveness, not just node heartbeats (a
+        # node with a wedged raylet loop still heartbeats — that is
+        # precisely the failure shape heartbeats cannot see).
+        from ray_tpu._private.debug import watchdog as watchdog_mod
+        self._wedge_listener = self._make_wedge_listener()
+        watchdog_mod.add_listener(self._wedge_listener)
         s.register("stop", self._handle_stop)
         from ray_tpu._private.object_store import (partial_chunk_source,
                                                    segment_chunk_source)
@@ -938,6 +954,31 @@ class NodeHost:
     def _timeline_source(self) -> str:
         return f"node-{self.raylet.node_id.hex()[:12]}"
 
+    # ---- debug plane ---------------------------------------------------
+    def _handle_arm_fault(self, payload) -> bool:
+        fault_injection.arm(
+            payload["point"], payload.get("mode", "error"),
+            count=int(payload.get("count", 1)),
+            skip=int(payload.get("skip", 0)),
+            delay_s=float(payload.get("delay_s", 0.0)))
+        return True
+
+    def _make_wedge_listener(self):
+        def on_wedge(event: str, report: dict):
+            if self.stopped:
+                return
+            try:
+                self.client.call_async(
+                    "wedge_report",
+                    {"node_id": self.raylet.node_id.binary(),
+                     "event": event, "report": report},
+                    lambda _r, _e: None)
+            except Exception as e:
+                from ray_tpu._private.debug import swallow
+                swallow.noted("node_host.wedge_ship", e)
+
+        return on_wedge
+
     # ---- lifecycle -----------------------------------------------------
     def _handle_stop(self, _payload) -> bool:
         self._stop_event.set()
@@ -950,6 +991,11 @@ class NodeHost:
     def shutdown(self):
         self.stopped = True
         self._stop_event.set()
+        try:
+            from ray_tpu._private.debug import watchdog as watchdog_mod
+            watchdog_mod.remove_listener(self._wedge_listener)
+        except Exception:
+            pass
         try:
             self.adapter.gcs.task_events.stop()
         except Exception:

@@ -20,7 +20,7 @@ from ray_tpu._private import fault_injection
 from ray_tpu._private.config import get_config
 from ray_tpu._private.ids import NodeID, ObjectID
 from ray_tpu._private.object_store import NodeObjectStore, _NativeHandle
-from ray_tpu._private.debug import diag_lock
+from ray_tpu._private.debug import diag_lock, flight_recorder
 
 
 def fetch_object_into(client, object_id: ObjectID, local_store,
@@ -500,6 +500,10 @@ class NodeObjectManager:
             try:
                 my_seq = self._directory.add_partial_location(object_id,
                                                               local_id)
+                flight_recorder.record(
+                    "transfer.relay_register",
+                    obj=object_id.hex()[:12], seq=my_seq,
+                    node=local_id.hex()[:12])
             except Exception:
                 my_seq = None       # relay off for this pull; still safe
         try:
@@ -547,6 +551,14 @@ class NodeObjectManager:
                                             None,
                                             others_available=False)
                 target = row["node_id"]
+                # Flight recorder: the source-selection decision — which
+                # candidate won, full copy or relay link, which round.
+                flight_recorder.record(
+                    "transfer.select", obj=object_id.hex()[:12],
+                    source=target.hex()[:12],
+                    partial=bool(row.get("partial")),
+                    seq=int(row.get("seq") or 0), round=_round,
+                    tried=len(tried))
                 # Busy-patience only makes sense when somewhere else to
                 # go existed at selection time (no extra directory RPC:
                 # probed against the SAME row snapshot).

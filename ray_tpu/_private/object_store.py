@@ -26,7 +26,7 @@ from ray_tpu._private import fault_injection
 from ray_tpu._private.config import get_config
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.serialization import SerializedObject, deserialize
-from ray_tpu._private.debug import diag_condition
+from ray_tpu._private.debug import diag_condition, flight_recorder
 
 try:
     from ray_tpu.native import shm_store as _shm
@@ -1076,6 +1076,10 @@ class NodeObjectStore:
         retry_s = max(cfg.object_store_full_retry_ms, 1) / 1000.0
         self._create_waiters += 1
         self.stats["queued_creates"] += 1
+        flight_recorder.record(
+            "store.create_queued", bytes=incoming,
+            used=self._used, reserved=self._transfer_reserved,
+            capacity=self.capacity, waiters=self._create_waiters)
         t0 = time.monotonic()
         try:
             while self._used + self._transfer_reserved + incoming > \
@@ -1217,6 +1221,8 @@ class NodeObjectStore:
         self._used += e.size
         self.stats["restored_bytes"] += len(blob)
         self.stats["restored_objects"] += 1
+        flight_recorder.record("spill.restore",
+                               obj=object_id.hex()[:12], bytes=size)
         # Restores re-charge the budget without a capacity gate (a get
         # must not deadlock on its own store): hand the overshoot to
         # the async spiller so a restore-heavy read phase cannot pin

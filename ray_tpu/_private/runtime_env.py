@@ -33,6 +33,8 @@ import threading
 import zipfile
 from typing import Dict, List, Optional
 
+from ray_tpu._private.debug.lock_order import diag_lock
+
 _PKG_PREFIX = b"pkg:"
 _EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
 
@@ -105,7 +107,7 @@ def _dir_signature(path: str) -> str:
 
 
 _package_cache: Dict[tuple, str] = {}
-_package_cache_lock = threading.Lock()
+_package_cache_lock = diag_lock("runtime_env._package_cache_lock")
 
 
 def package_dir(path: str, kv) -> str:
@@ -351,7 +353,7 @@ def materialize(spec: Optional[dict], kv,
 # Thread-mode application (approximation; process mode is the real path)
 # ---------------------------------------------------------------------------
 
-_env_lock = threading.Lock()
+_env_lock = diag_lock("runtime_env._env_lock")
 
 
 @contextlib.contextmanager

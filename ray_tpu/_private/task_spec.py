@@ -17,6 +17,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ray_tpu._private.ids import (
     ActorID, FunctionID, JobID, ObjectID, PlacementGroupID, TaskID, WorkerID,
 )
+from ray_tpu._private.debug.lock_order import diag_lock
 from ray_tpu.scheduler.policy import SchedulingOptions, SchedulingType
 from ray_tpu.scheduler.resources import ResourceRequest
 
@@ -24,7 +25,7 @@ from ray_tpu.scheduler.resources import ResourceRequest
 # SchedulingClass interning (task_spec.h:297).
 # ---------------------------------------------------------------------------
 
-_sched_class_lock = threading.Lock()
+_sched_class_lock = diag_lock("task_spec._sched_class_lock")
 _sched_class_table: Dict[Tuple, int] = {}
 _sched_class_rev: Dict[int, Tuple["ResourceRequest", "SchedulingOptions"]] = {}
 _sched_class_counter = itertools.count(1)

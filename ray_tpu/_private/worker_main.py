@@ -34,6 +34,7 @@ import traceback
 from typing import Dict, Optional
 
 from ray_tpu import exceptions
+from ray_tpu._private.debug.lock_order import diag_lock
 from ray_tpu._private.serialization import (
     SerializedObject, deserialize, loads_function, serialize,
     serialize_into)
@@ -143,7 +144,7 @@ class _WorkerRuntime:
         self._sema: Optional[threading.Semaphore] = None
         # Per-concurrency-group bounds (concurrency_group_manager.cc).
         self._group_semas: Dict[str, threading.Semaphore] = {}
-        self._order_lock = threading.Lock()
+        self._order_lock = diag_lock("WorkerServer._order_lock")
         self._stop_event = threading.Event()
         # Plasma-client mapping of the node's shm segment (metadata via
         # node_client RPC, bytes through this mmap — zero-copy).

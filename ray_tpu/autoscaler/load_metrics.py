@@ -12,10 +12,12 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ray_tpu._private.debug.lock_order import diag_rlock
+
 
 class LoadMetrics:
     def __init__(self):
-        self.lock = threading.RLock()
+        self.lock = diag_rlock("LoadMetrics.lock")
         self.last_heartbeat_by_ip: Dict[str, float] = {}
         self.static_resources_by_ip: Dict[str, Dict[str, float]] = {}
         self.dynamic_resources_by_ip: Dict[str, Dict[str, float]] = {}

@@ -18,6 +18,7 @@ from typing import Dict, Optional, Tuple
 
 from ray_tpu import exceptions
 from ray_tpu._private.config import get_config
+from ray_tpu._private.debug.lock_order import diag_rlock
 from ray_tpu._private.ids import ActorID, NodeID
 from ray_tpu.gcs import pubsub as pubsub_mod
 from ray_tpu.scheduler.policy import SchedulingOptions, schedule
@@ -71,7 +72,7 @@ _MAX_CREATION_RETRIES = 20
 class GcsActorManager:
     def __init__(self, gcs):
         self._gcs = gcs
-        self._lock = threading.RLock()
+        self._lock = diag_rlock("GcsActorManager._lock")
         self._actors: Dict[ActorID, GcsActor] = {}
         # (namespace, name) -> actor_id for named actors.
         self._named: Dict[Tuple[str, str], ActorID] = {}

@@ -26,6 +26,8 @@ import time
 from typing import Dict, List, Optional
 
 from ray_tpu import exceptions
+from ray_tpu._private.debug.lock_order import (diag_condition,
+                                                diag_rlock)
 from ray_tpu._private.ids import NodeID, PlacementGroupID
 from ray_tpu.scheduler.bundle_packing import pack_bundles
 from ray_tpu.scheduler.resources import ResourceRequest
@@ -74,9 +76,9 @@ class GcsPlacementGroup:
 class GcsPlacementGroupManager:
     def __init__(self, gcs):
         self._gcs = gcs
-        self._lock = threading.RLock()
+        self._lock = diag_rlock("GcsPlacementGroupManager._lock")
         # State-change wakeups for wait_ready (no polling).
-        self._state_cond = threading.Condition(self._lock)
+        self._state_cond = diag_condition(self._lock)
         self._groups: Dict[PlacementGroupID, GcsPlacementGroup] = {}
         self._named: Dict[str, PlacementGroupID] = {}
         self._pending: List[PlacementGroupID] = []

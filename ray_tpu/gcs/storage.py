@@ -15,6 +15,8 @@ import pickle
 import threading
 from typing import Any, Dict, Iterator, Optional, Tuple
 
+from ray_tpu._private.debug.lock_order import diag_lock, diag_rlock
+
 
 class StoreClient:
     """Abstract key-value store with (table, key) namespacing."""
@@ -37,7 +39,7 @@ class StoreClient:
 
 class InMemoryStoreClient(StoreClient):
     def __init__(self):
-        self._lock = threading.RLock()
+        self._lock = diag_rlock("GcsStorage._lock")
         self._tables: Dict[str, Dict[bytes, Any]] = {}
 
     def put(self, table, key, value):
@@ -72,7 +74,7 @@ class FileStoreClient(InMemoryStoreClient):
         super().__init__()
         self._path = path
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        self._journal_lock = threading.Lock()
+        self._journal_lock = diag_lock("GcsStorage._journal_lock")
         if os.path.exists(path):
             self._replay()
         self._journal = open(path, "ab")

@@ -190,18 +190,27 @@ class TaskEventBuffer:
             self._flush_wake.set()
 
     def _flusher_loop(self):
-        from ray_tpu._private.debug import swallow
-        while not self._stopped:
-            self._flush_wake.wait(timeout=self._flush_interval)
-            if self._stopped:
-                return
-            self._flush_wake.clear()
-            try:
-                self.flush()
-            except Exception as e:
-                # Publish failures are already counted inside flush;
-                # anything else must not kill the flusher silently.
-                swallow.noted("task_events.flush", e)
+        from ray_tpu._private.debug import swallow, watchdog
+        beat = watchdog.register(
+            f"task-events-flusher-{self._buffer_id[:12]}", kind="pump",
+            queue_depth=lambda: len(self._events))
+        try:
+            while not self._stopped:
+                self._flush_wake.wait(timeout=self._flush_interval)
+                if self._stopped:
+                    return
+                self._flush_wake.clear()
+                beat.begin("flush")
+                try:
+                    self.flush()
+                except Exception as e:
+                    # Publish failures are already counted inside flush;
+                    # anything else must not kill the flusher silently.
+                    swallow.noted("task_events.flush", e)
+                finally:
+                    beat.end()
+        finally:
+            watchdog.unregister(beat)
 
     def stop(self):
         """Shut the flusher down, draining tail events first."""

@@ -29,6 +29,7 @@ from dataclasses import asdict, dataclass, field
 from typing import Dict, List, Optional
 
 from ray_tpu._private.config import get_config
+from ray_tpu._private.debug.lock_order import diag_lock
 
 _JOB_NS = b"job"
 
@@ -68,7 +69,7 @@ class JobManager:
     def __init__(self, cluster):
         self._cluster = cluster
         self._kv = cluster.gcs.kv
-        self._lock = threading.Lock()
+        self._lock = diag_lock("JobManager._lock")
         self._procs: Dict[str, subprocess.Popen] = {}
         self._stopping: set = set()
         self._log_root = os.path.join(get_config().temp_dir, "jobs")
@@ -285,6 +286,16 @@ class JobSubmissionClient:
     def latency_summary(self) -> dict:
         """Per-stage task-dispatch latency rollup (p50/p99)."""
         return self._client.call("latency_summary", None, timeout=30.0)
+
+    def debug_dump(self, stacks: bool = True, tail: int = 50,
+                   timeout: float = 10.0) -> dict:
+        """Cluster-wide introspection dump (`ray-tpu doctor`): the
+        head's per-process report plus one per node host, with
+        internal-loop liveness."""
+        return self._client.call(
+            "debug_dump",
+            {"stacks": stacks, "tail": tail, "timeout": timeout},
+            timeout=timeout * 2 + 10.0)
 
     def close(self):
         self._client.close()

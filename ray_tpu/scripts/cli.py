@@ -322,6 +322,134 @@ def cmd_latency(args) -> int:
     return 0
 
 
+def _render_process_report(label: str, report: dict,
+                           recorder_tail: int) -> None:
+    """Doctor rendering for ONE OS process's debug report: stalled
+    loops first, then hottest locks, deepest queues, swallowed
+    exceptions and the flight-recorder tail."""
+    if report.get("error"):
+        print(f"\n== {label}: UNREACHABLE ({report['error']})")
+        wedge = report.get("last_wedge_report")
+        if wedge:
+            print(f"  last wedge (head-held evidence): loop "
+                  f"{wedge.get('loop')} handler {wedge.get('handler')} "
+                  f"stalled {wedge.get('stalled_for_s')}s")
+        return
+    print(f"\n== {label} (pid {report.get('pid')}, stall budget "
+          f"{report.get('stall_budget_s')}s)")
+    loops = report.get("loops", [])
+    wedged = [lp for lp in loops if lp.get("wedged")]
+    for lp in loops:
+        mark = "WEDGED" if lp.get("wedged") else "ok"
+        busy = (f"busy {lp['busy_for_s']:.2f}s in "
+                f"{lp.get('handler') or '?'}"
+                if lp.get("busy_for_s") else
+                f"idle {lp.get('idle_for_s', 0):.2f}s")
+        print(f"  loop {lp['name']:<32} [{mark:6}] {busy}  "
+              f"queue={lp.get('queue_depth', 0)} "
+              f"lag_max={lp.get('lag_max_s', 0):.4f}s "
+              f"slowest={lp.get('slowest_handler', '')}"
+              f"({lp.get('slowest_handler_s', 0):.4f}s)")
+    for wr in report.get("wedges", []):
+        print(f"  wedge: loop {wr.get('loop')} handler "
+              f"{wr.get('handler')} stalled {wr.get('stalled_for_s')}s "
+              f"(crash file: {wr.get('crash_file', '-')})")
+        stacks = wr.get("stacks") or {}
+        loop_name = wr.get("loop", "") or ""
+        hit = next((t for t in stacks if loop_name and loop_name in t),
+                   next(iter(stacks), None))
+        if hit is not None:
+            print(f"    stack of {hit}:")
+            for ln in stacks[hit][-8:]:
+                print(f"      {ln}")
+    locks = report.get("locks", [])
+    if locks:
+        print("  hottest locks (by total sampled acquire-wait):")
+        for lk in locks[:5]:
+            print(f"    {lk['lock']:<40} acquires={lk['acquires']} "
+                  f"contended={lk['contended']} "
+                  f"wait_total={lk['wait_total_s']:.4f}s "
+                  f"wait_max={lk['wait_max_s']:.4f}s "
+                  f"hold_max={lk['hold_max_s']:.4f}s")
+    held = report.get("held_locks") or {}
+    for tname, rows in held.items():
+        print(f"  held locks [{tname}]: " + "; ".join(rows))
+    swallowed = report.get("swallowed") or {}
+    if swallowed:
+        tops = sorted(swallowed.items(), key=lambda kv: -kv[1])[:5]
+        print("  swallowed exceptions: " +
+              ", ".join(f"{site}={n}" for site, n in tops))
+    rec = report.get("recorder_tail") or []
+    stats = report.get("recorder_stats") or {}
+    if rec:
+        print(f"  flight recorder (last {min(len(rec), recorder_tail)} "
+              f"of {stats.get('written', '?')} recorded, "
+              f"{stats.get('dropped', 0)} dropped):")
+        for row in rec[-recorder_tail:]:
+            extra = {k: v for k, v in row.items()
+                     if k not in ("ts", "cat")}
+            print(f"    {row.get('ts', 0):.3f} {row.get('cat'):<24} "
+                  + " ".join(f"{k}={v}" for k, v in extra.items()))
+
+
+def cmd_doctor(args) -> int:
+    """Cluster-wide "why is it stuck" report: stalled loops, hottest
+    locks, deepest queues and the last-N flight-recorder events from
+    every OS process, plus per-node internal-loop liveness."""
+    client = _client(args)
+    try:
+        dump = client.debug_dump(stacks=True, tail=args.tail)
+    finally:
+        client.close()
+    if args.output == "json":
+        print(json.dumps(dump, default=str, indent=2))
+        return 0
+    liveness = dump.get("liveness") or {}
+    degraded = sorted(n for n, st in liveness.items()
+                      if st.get("degraded"))
+    print(f"nodes: {len(dump.get('nodes', {}))} remote + head; "
+          f"internal-loop liveness degraded: "
+          f"{', '.join(degraded) if degraded else 'none'}")
+    for node, st in sorted(liveness.items()):
+        print(f"  {node}: {'DEGRADED' if st.get('degraded') else 'ok'} "
+              f"(wedges={st.get('wedges', 0)})")
+    _render_process_report("head", dump.get("head") or {}, args.tail)
+    for node_hex, report in sorted((dump.get("nodes") or {}).items()):
+        _render_process_report(f"node {node_hex}", report or {},
+                               args.tail)
+    return 0
+
+
+def cmd_stacks(args) -> int:
+    """Every thread's current stack in every cluster OS process
+    (the ad-hoc thread dump PR 6/7 hand-rolled, as a verb)."""
+    client = _client(args)
+    try:
+        dump = client.debug_dump(stacks=True, tail=0)
+    finally:
+        client.close()
+    if args.output == "json":
+        print(json.dumps(dump, default=str, indent=2))
+        return 0
+
+    def render(label, report):
+        if report.get("error"):
+            print(f"\n== {label}: UNREACHABLE ({report['error']})")
+            return
+        print(f"\n== {label} (pid {report.get('pid')})")
+        for tname, frames in (report.get("stacks") or {}).items():
+            print(f"  thread {tname}:")
+            for ln in frames:
+                print(f"    {ln}")
+        for tname, rows in (report.get("held_locks") or {}).items():
+            print(f"  held locks [{tname}]: " + "; ".join(rows))
+
+    render("head", dump.get("head") or {})
+    for node_hex, report in sorted((dump.get("nodes") or {}).items()):
+        render(f"node {node_hex}", report or {})
+    return 0
+
+
 def cmd_timeline(args) -> int:
     """Dump the head's tracing timeline as chrome://tracing JSON
     (reference `ray timeline`)."""
@@ -516,6 +644,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--output", choices=["table", "json"], default="table")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_latency)
+
+    p = sub.add_parser("doctor", help="why-is-it-stuck report: stalled "
+                                      "loops, hottest locks, recorder "
+                                      "tails from every process")
+    p.add_argument("--output", choices=["table", "json"], default="table")
+    p.add_argument("--tail", type=int, default=20,
+                   help="flight-recorder events shown per process")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_doctor)
+
+    p = sub.add_parser("stacks", help="all thread stacks in every "
+                                      "cluster OS process")
+    p.add_argument("--output", choices=["table", "json"], default="table")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_stacks)
 
     p = sub.add_parser("timeline", help="dump chrome://tracing JSON")
     p.add_argument("--address", default=None)

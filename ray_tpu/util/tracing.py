@@ -21,7 +21,9 @@ import time
 import uuid
 from typing import Dict, List, Optional
 
-_lock = threading.Lock()
+from ray_tpu._private.debug.lock_order import diag_lock
+
+_lock = diag_lock("tracing._lock")
 _events: List[dict] = []
 # Fixed-capacity ring: a long traced run must not grow memory forever
 # (task-event buffer semantics — loss is bounded, counted, and visible).

@@ -19,6 +19,16 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 # RAY_TPU_LOCK_DIAG=0 when bisecting timing-sensitive failures.
 os.environ.setdefault("RAY_TPU_LOCK_DIAG", "1")
 os.environ.setdefault("RAY_TPU_LOOP_AFFINITY", "1")
+# Contention profiling armed suite-wide too: the whole suite proves the
+# "always-cheap" claim, and doctor/bench tests read the histograms.
+os.environ.setdefault("RAY_TPU_LOCK_CONTENTION", "1")
+# Stall watchdog armed suite-wide (watchdog_enabled defaults on): a
+# tier-1 run that wedges any event loop / pump thread past the budget
+# fails at sessionfinish WITH the wedge report attached, instead of
+# timing out opaquely.  60s is far past any legitimate handler; tests
+# that wedge deliberately lower the budget via config and
+# reset_reports() in teardown.
+os.environ.setdefault("RAY_TPU_LOOP_STALL_BUDGET_S", "60")
 
 # graftcheck (tools/graftcheck) is imported by tests/test_graftcheck.py.
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
@@ -46,6 +56,29 @@ def pytest_sessionfinish(session, exitstatus):
               flush=True)
         for (a, b), prov in sorted(lock_order.graph_edges().items()):
             print(f"  {a} -> {b}\n      {prov}", flush=True)
+    # Stall-watchdog gate: a loop wedged past the suite budget during
+    # the run is a real finding even if every test passed — surface the
+    # wedge report (stalled loop, handler, stacks) instead of letting
+    # the next run time out opaquely.  Tests that wedge deliberately
+    # call watchdog.reset_reports() in their teardown.
+    try:
+        from ray_tpu._private.debug import watchdog
+    except Exception:
+        return
+    wedges = watchdog.wedge_reports()
+    if wedges:
+        print("\nstall-watchdog wedge reports (tier-1 must be "
+              "wedge-free):", flush=True)
+        for w in wedges:
+            print(f"  loop {w.get('loop')} handler {w.get('handler')} "
+                  f"stalled {w.get('stalled_for_s')}s "
+                  f"(crash file: {w.get('crash_file', '-')})",
+                  flush=True)
+            for tname, frames in (w.get("stacks") or {}).items():
+                if w.get("loop", "") and w["loop"] in tname:
+                    for ln in frames[-6:]:
+                        print(f"    {ln}", flush=True)
+        session.exitstatus = 1
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -481,3 +481,67 @@ def test_sigkill_node_host_mid_broadcast_reconstructs():
         assert recon, "no RECONSTRUCTING task-event state recorded"
     finally:
         ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# loop.stall — wedge report survives a SIGKILL-adjacent crash
+# ---------------------------------------------------------------------------
+
+def test_wedge_crash_file_survives_sigkill():
+    """The watchdog writes its wedge report to a crash file AT TRIP
+    TIME: wedge a node host's raylet loop, SIGKILL the process while
+    it is still wedged, and the on-disk evidence (stalled loop, its
+    thread stack, the flight-recorder tail) survives the kill — the
+    post-mortem for a process that died too wedged to answer RPCs."""
+    import glob
+    import json
+    import signal
+
+    from ray_tpu._private.config import get_config
+    wedge_dir = os.path.join(get_config().temp_dir, "wedges")
+    config = dict(_WIRE_CONFIG)
+    config.update({
+        # Generous death timeout: the stall must outlive the budget
+        # without the heartbeat plane declaring the node dead first.
+        "num_heartbeats_timeout": 400,
+        "loop_stall_budget_s": 0.5,
+        "watchdog_poll_interval_s": 0.1,
+    })
+    ray_tpu.init(num_cpus=1, _system_config=config)
+    try:
+        cluster = global_worker().cluster
+        handle = cluster.add_remote_node(num_cpus=1,
+                                         resources={"wedge": 1.0})
+        pid = handle.proc.pid
+        pattern = os.path.join(wedge_dir, f"wedge-{pid}-*.json")
+        for stale in glob.glob(pattern):
+            os.unlink(stale)
+        # One long stall on the child's raylet loop, armed over the
+        # wire (deterministic: fires on the loop's next handler).
+        assert handle.proxy.client.call(
+            "arm_fault", {"point": "loop.stall", "mode": "delay",
+                          "count": 1, "delay_s": 8.0}, timeout=10.0)
+        assert _wait_until(lambda: glob.glob(pattern), timeout=20.0), \
+            "no wedge crash file appeared while the loop was stalled"
+        # SIGKILL the process WHILE wedged (poll() still None: alive).
+        assert handle.proc.poll() is None
+        os.kill(pid, signal.SIGKILL)
+        handle.proc.wait(timeout=10)
+        # The evidence survived the kill.
+        paths = glob.glob(pattern)
+        assert paths, "crash file vanished with the process"
+        with open(paths[0]) as f:
+            report = json.load(f)
+        assert report["loop"].startswith("raylet-")
+        assert report["stalled_for_s"] >= 0.5
+        wedged_stack = next(
+            (frames for tname, frames in report["stacks"].items()
+             if report["loop"] in tname), None)
+        assert wedged_stack and any("sleep" in ln or "hook" in ln
+                                    for ln in wedged_stack)
+        assert any(r.get("cat") == "fault.fired"
+                   for r in report["recorder_tail"])
+        for p in paths:
+            os.unlink(p)
+    finally:
+        ray_tpu.shutdown()

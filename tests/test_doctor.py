@@ -1,0 +1,367 @@
+"""The "why is it stuck" plane (ISSUE 13): flight recorder, stall
+watchdog, contention profiling, and the ``ray-tpu doctor`` surface.
+
+Acceptance (end-to-end wedge drill): with ``loop.stall`` armed in a
+spawned node-host OS process, the watchdog reports the stalled loop
+within its budget, the head marks the node's INTERNAL-loop liveness
+degraded (the node still heartbeats — that is the point), and
+``ray-tpu doctor`` from the head names the loop, shows its thread
+stack and held locks, and includes the flight-recorder tail from that
+process.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import fault_injection
+from ray_tpu._private.config import get_config
+from ray_tpu._private.debug import flight_recorder, lock_order, watchdog
+from ray_tpu._private.debug.report import build_debug_report
+from ray_tpu._private.worker import global_worker
+
+_WIRE_CONFIG = {
+    "scheduler_backend": "native",
+    # The wedge drill stalls the child's raylet loop for seconds; its
+    # heartbeats ride that loop, so the death timeout must comfortably
+    # exceed the stall or the drill reads as a node death.
+    "raylet_heartbeat_period_milliseconds": 100,
+    "num_heartbeats_timeout": 150,
+    "loop_stall_budget_s": 0.8,
+    "watchdog_poll_interval_s": 0.1,
+}
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder: ring bounds + drop counter.
+
+
+class TestFlightRecorder:
+    @pytest.fixture(autouse=True)
+    def _restore_ring(self):
+        yield
+        flight_recorder.configure(enabled=True,
+                                  slots=get_config().flight_recorder_slots)
+        flight_recorder.reset()
+
+    def test_ring_is_bounded_and_ordered(self):
+        flight_recorder.configure(slots=8)
+        flight_recorder.reset()
+        for i in range(30):
+            flight_recorder.record("doctor.test", i=i)
+        tail = flight_recorder.tail()
+        assert len(tail) == 8, "ring must hold exactly `slots` records"
+        # Oldest-first, and only the LAST 8 survive the overwrites.
+        assert [r["i"] for r in tail] == list(range(22, 30))
+        st = flight_recorder.stats()
+        assert st["written"] == 30 and st["capacity"] == 8
+
+    def test_tail_n_returns_newest(self):
+        flight_recorder.configure(slots=16)
+        flight_recorder.reset()
+        for i in range(10):
+            flight_recorder.record("doctor.test", i=i)
+        assert [r["i"] for r in flight_recorder.tail(3)] == [7, 8, 9]
+
+    def test_contended_record_drops_and_counts(self):
+        """The recorder never blocks a hot path: a record arriving
+        while the ring lock is held is dropped, not waited for."""
+        flight_recorder.configure(slots=8)
+        flight_recorder.reset()
+        assert flight_recorder._lock.acquire()
+        try:
+            flight_recorder.record("doctor.dropped", i=1)
+        finally:
+            flight_recorder._lock.release()
+        st = flight_recorder.stats()
+        assert st["dropped"] == 1 and st["written"] == 0
+        flight_recorder.record("doctor.kept", i=2)
+        assert flight_recorder.stats()["written"] == 1
+
+    def test_disabled_recorder_is_a_noop(self):
+        flight_recorder.configure(enabled=False, slots=8)
+        flight_recorder.reset()
+        flight_recorder.record("doctor.off", i=1)
+        assert flight_recorder.tail() == []
+        assert flight_recorder.stats()["written"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Contention profiling: attribution + the lock.hold fault point.
+
+
+class TestContentionProfiling:
+    def test_wait_and_hold_attributed_to_named_lock(self):
+        """A thread holding a named diag lock while another waits must
+        show up in the contention histograms UNDER THAT NAME."""
+        lk = lock_order.diag_lock("DoctorAttributionLock")
+        released = threading.Event()
+
+        def holder():
+            with lk:
+                time.sleep(0.12)
+            released.set()
+
+        t = threading.Thread(target=holder)
+        t.start()
+        time.sleep(0.03)            # let the holder take the lock
+        with lk:
+            pass
+        t.join()
+        snap = lock_order.contention_snapshot()
+        st = snap.get("DoctorAttributionLock")
+        assert st is not None, sorted(snap)
+        assert st["wait_max_s"] >= 0.05, st
+        assert st["hold_max_s"] >= 0.10, st
+        assert st["contended"] >= 1
+
+    def test_lock_hold_fault_point_extends_hold(self):
+        """``lock.hold`` (delay mode) manufactures an attributable long
+        hold on whatever diag lock fires it — the deterministic way to
+        drive the contention plane in tests."""
+        before = fault_injection.fired("lock.hold")
+        lk = lock_order.diag_lock("DoctorHoldFaultLock")
+        fault_injection.arm("lock.hold", "delay", count=1, delay_s=0.15)
+        try:
+            deadline = time.monotonic() + 5
+            while fault_injection.fired("lock.hold") == before and \
+                    time.monotonic() < deadline:
+                with lk:
+                    pass
+        finally:
+            fault_injection.disarm("lock.hold")
+        assert fault_injection.fired("lock.hold") >= before + 1
+        # The firing is recorded in the flight recorder too.
+        assert any(r["cat"] == "fault.fired" and r.get("point") ==
+                   "lock.hold" for r in flight_recorder.tail(200))
+
+    def test_contention_series_exported_at_metrics(self):
+        lk = lock_order.diag_lock("DoctorMetricsLock")
+        with lk:
+            pass
+        watchdog._ensure_collector()
+        from ray_tpu._private.metrics_agent import get_metrics_registry
+        text = get_metrics_registry().render_prometheus()
+        assert "ray_tpu_lock_acquire_wait_seconds" in text
+        assert 'lock="DoctorMetricsLock"' in text
+        assert "ray_tpu_lock_hold_seconds" in text
+
+
+# ---------------------------------------------------------------------------
+# Satellite: the previously-orphaned in-memory diagnostics reach
+# /metrics.
+
+
+class TestOrphanedDiagnosticsExported:
+    def test_event_loop_handler_stats_and_lag_exported(self):
+        from ray_tpu._private.event_loop import EventLoop
+        from ray_tpu._private.metrics_agent import get_metrics_registry
+        loop = EventLoop("doctor-export-loop")
+        try:
+            done = threading.Event()
+            loop.post(lambda: done.set(), name="doctor.handler")
+            assert done.wait(5)
+            time.sleep(0.05)
+            text = get_metrics_registry().render_prometheus()
+            assert "ray_tpu_event_loop_handler_count" in text
+            assert 'loop="doctor-export-loop"' in text
+            assert 'handler="doctor.handler"' in text
+            assert "ray_tpu_event_loop_lag_max_s" in text
+            assert "ray_tpu_event_loop_slowest_handler_s" in text
+        finally:
+            loop.stop()
+
+    def test_swallow_counters_exported(self):
+        from ray_tpu._private.debug import swallow
+        from ray_tpu._private.metrics_agent import get_metrics_registry
+        swallow.noted("doctor.test_site", RuntimeError("boom"))
+        watchdog._ensure_collector()
+        text = get_metrics_registry().render_prometheus()
+        assert "ray_tpu_swallowed_exceptions" in text
+        assert 'site="doctor.test_site"' in text
+
+
+# ---------------------------------------------------------------------------
+# Watchdog, in-process: detection, evidence, recovery.
+
+
+class TestWatchdogInProcess:
+    @pytest.fixture(autouse=True)
+    def _clean_reports(self):
+        yield
+        watchdog.reset_reports()
+
+    def test_stalled_handler_trips_and_recovers(self):
+        from ray_tpu._private.event_loop import EventLoop
+        cfg = get_config()
+        cfg.loop_stall_budget_s = 0.3
+        cfg.watchdog_poll_interval_s = 0.05
+        loop = EventLoop("doctor-wedge-loop")
+        try:
+            loop.post(lambda: time.sleep(1.2), name="doctor.sleeper")
+            deadline = time.monotonic() + 10
+            report = None
+            while time.monotonic() < deadline:
+                reports = [r for r in watchdog.wedge_reports()
+                           if r["loop"] == "doctor-wedge-loop"]
+                if reports:
+                    report = reports[0]
+                    break
+                time.sleep(0.05)
+            assert report is not None, "watchdog never tripped"
+            assert report["handler"] == "doctor.sleeper"
+            assert report["stalled_for_s"] >= 0.3
+            # Evidence: the wedged thread's stack shows the sleep, and
+            # the crash file landed at trip time.
+            stacks = report["stacks"]
+            wedged_stack = next(
+                frames for tname, frames in stacks.items()
+                if "doctor-wedge-loop" in tname)
+            assert any("sleep" in ln for ln in wedged_stack)
+            assert report.get("crash_file") and \
+                os.path.exists(report["crash_file"])
+            assert "recorder_tail" in report
+            # Recovery: once the handler finishes, the beat clears.
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                snap = [s for s in watchdog.loops_snapshot()
+                        if s["name"] == "doctor-wedge-loop"]
+                if snap and not snap[0]["wedged"]:
+                    break
+                time.sleep(0.05)
+            else:
+                pytest.fail("wedge never recovered")
+        finally:
+            loop.stop()
+
+    def test_debug_report_surfaces_wedges_first(self):
+        from ray_tpu._private.event_loop import EventLoop
+        cfg = get_config()
+        cfg.loop_stall_budget_s = 0.3
+        cfg.watchdog_poll_interval_s = 0.05
+        loop = EventLoop("doctor-report-loop")
+        try:
+            loop.post(lambda: time.sleep(1.0), name="doctor.sleeper")
+            deadline = time.monotonic() + 10
+            while not any(r["loop"] == "doctor-report-loop"
+                          for r in watchdog.wedge_reports()):
+                assert time.monotonic() < deadline
+                time.sleep(0.05)
+            rep = build_debug_report()
+            assert rep["loops"][0]["name"] == "doctor-report-loop"
+            assert rep["loops"][0]["wedged"]
+            assert rep["wedges"]
+            assert "stacks" in rep
+        finally:
+            loop.stop()
+
+
+# ---------------------------------------------------------------------------
+# ACCEPTANCE: end-to-end wedge drill across a real OS-process boundary.
+
+
+@pytest.fixture
+def wire_cluster():
+    os.environ.pop("RAY_TPU_FAULT_POINTS", None)
+    ray_tpu.init(num_cpus=2, _system_config=dict(_WIRE_CONFIG))
+    try:
+        yield global_worker().cluster
+    finally:
+        ray_tpu.shutdown()
+        watchdog.reset_reports()
+        fault_injection.reset()
+
+
+class TestDoctorEndToEnd:
+    def _wedge_remote(self, cluster, stall_s: float = 2.5):
+        handle = cluster.add_remote_node(num_cpus=1,
+                                         resources={"spoke": 2.0})
+        node_hex = handle.node_id.hex()[:12]
+        # Arm ONE long loop.stall over the wire (deterministic: fires
+        # on the child raylet loop's next handler).
+        assert handle.proxy.client.call(
+            "arm_fault", {"point": "loop.stall", "mode": "delay",
+                          "count": 1, "delay_s": stall_s}, timeout=10.0)
+        return handle, node_hex
+
+    def test_wedge_drill_head_marks_liveness_and_doctor_renders(
+            self, wire_cluster, capsys):
+        cluster = wire_cluster
+        handle, node_hex = self._wedge_remote(cluster)
+        # 1. The head marks the node's INTERNAL loop liveness degraded
+        #    within the budget (0.8s) + shipping latency.
+        deadline = time.monotonic() + 20
+        state = None
+        while time.monotonic() < deadline:
+            state = cluster.head_service.loop_liveness.get(node_hex)
+            if state and state.get("degraded"):
+                break
+            time.sleep(0.05)
+        assert state and state["degraded"], \
+            "head never marked internal-loop liveness degraded"
+        report = state["last_report"]
+        assert report["loop"].startswith("raylet-")
+        # 2. The node is NOT dead — it still heartbeats (the wedge is
+        #    invisible to the heartbeat plane; that is the whole point).
+        nodes = cluster.gcs.node_manager.get_all_node_info()
+        assert any(nid == handle.node_id and info.get("alive", True)
+                   for nid, info in nodes.items())
+        # 3. The fault provably fired in the CHILD process.
+        assert handle.proxy.client.call(
+            "fault_fired", {"point": "loop.stall"}, timeout=10.0) >= 1
+        # 4. `ray-tpu doctor` from the head renders the wedge: names
+        #    the loop, shows its thread stack + held locks, includes
+        #    the flight-recorder tail from that OS process.
+        host, port = cluster.head_service.address
+        from ray_tpu.scripts.cli import main as cli_main
+        rc = cli_main(["doctor", "--address", f"{host}:{port}",
+                       "--tail", "15"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert node_hex in out
+        assert "DEGRADED" in out
+        assert report["loop"] in out                  # names the loop
+        assert "stack of" in out                      # its thread stack
+        assert "flight recorder" in out               # recorder tail
+        assert "sched.tick" in out or "fault.fired" in out
+        # 5. Recovery: after the stall passes, the node reports
+        #    recovered and the head restores liveness.
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            state = cluster.head_service.loop_liveness.get(node_hex)
+            if state and not state.get("degraded"):
+                break
+            time.sleep(0.1)
+        assert state and not state["degraded"], \
+            "liveness never recovered after the stall passed"
+        assert state["wedges"] >= 1      # evidence is kept
+
+    def test_stacks_verb_renders_all_processes(self, wire_cluster,
+                                               capsys):
+        cluster = wire_cluster
+        cluster.add_remote_node(num_cpus=1, resources={"spoke": 2.0})
+        host, port = cluster.head_service.address
+        from ray_tpu.scripts.cli import main as cli_main
+        rc = cli_main(["stacks", "--address", f"{host}:{port}"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "== head" in out
+        assert "== node" in out
+        assert "thread " in out and "_run_inner" in out
+
+    def test_debug_dump_tolerates_unreachable_node(self, wire_cluster):
+        """A node too wedged (or dead) to serve its own dump must not
+        hang the doctor: it reports unreachable within the timeout."""
+        cluster = wire_cluster
+        handle = cluster.add_remote_node(num_cpus=1,
+                                         resources={"spoke": 2.0})
+        node_hex = handle.node_id.hex()[:12]
+        handle.proc.kill()
+        handle.proc.wait(timeout=10)
+        dump = cluster.head_service._handle_debug_dump(
+            {"stacks": False, "tail": 5, "timeout": 2.0})
+        entry = dump["nodes"].get(node_hex)
+        assert entry is not None and "error" in entry

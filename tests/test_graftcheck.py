@@ -75,6 +75,7 @@ class TestRules:
         ("r4_loop_affinity.py", "R4"),
         ("r5_refcount.py", "R5"),
         ("r7_swallow.py", "R7"),
+        ("r8_bare_lock.py", "R8"),
     ])
     def test_fixture_trips_rule(self, fixture, rule):
         path = os.path.join(FIXTURES, fixture)
@@ -84,7 +85,7 @@ class TestRules:
 
     @pytest.mark.parametrize("fixture", [
         "r1_lock_order.py", "r2_blocking.py", "r3_aliasing.py",
-        "r4_loop_affinity.py", "r5_refcount.py",
+        "r4_loop_affinity.py", "r5_refcount.py", "r8_bare_lock.py",
     ])
     def test_cli_exits_nonzero_on_fixture(self, fixture):
         proc = subprocess.run(
@@ -93,6 +94,26 @@ class TestRules:
             cwd=REPO, capture_output=True, text=True, timeout=60)
         assert proc.returncode == 1, \
             f"{fixture}: rc={proc.returncode}\n{proc.stdout}{proc.stderr}"
+
+    def test_r8_exempts_the_debug_package_itself(self, tmp_path):
+        """The witness/contention plane is built FROM plain primitives
+        (wrapping them would recurse) — R8 must not flag its own
+        substrate, nor fault_injection (whose hook runs inside armed
+        acquires)."""
+        d = tmp_path / "ray_tpu" / "_private" / "debug"
+        d.mkdir(parents=True)
+        p = d / "some_witness.py"
+        p.write_text("import threading\n_lock = threading.Lock()\n")
+        fi = tmp_path / "ray_tpu" / "_private" / "fault_injection.py"
+        fi.write_text("import threading\n_lock = threading.Lock()\n")
+        findings = _run_on([str(p), str(fi)], select={"R8"})
+        assert not findings, findings
+
+    def test_r8_flags_aliased_threading_import(self, tmp_path):
+        p = tmp_path / "mod.py"
+        p.write_text("import threading as t\n_lock = t.Lock()\n")
+        findings = _run_on([str(p)], select={"R8"})
+        assert len(findings) == 1 and findings[0].rule == "R8"
 
     def test_r1_reports_the_cycle_participants(self):
         findings = _run_on([os.path.join(FIXTURES, "r1_lock_order.py")],
@@ -280,10 +301,22 @@ class TestLockWitness:
         slow.release()
 
     def test_unarmed_factories_return_plain_primitives(self, monkeypatch):
+        # Either arming (witness OR contention profiling) wraps; with
+        # BOTH off the factories must be zero-cost pass-throughs.
         monkeypatch.setenv("RAY_TPU_LOCK_DIAG", "0")
+        monkeypatch.setenv("RAY_TPU_LOCK_CONTENTION", "0")
         from ray_tpu._private.debug import lock_order
         lk = lock_order.diag_lock("t_plain")
         assert type(lk).__module__ == "_thread", type(lk)
+
+    def test_contention_only_arming_wraps_without_witness(self,
+                                                          monkeypatch):
+        monkeypatch.setenv("RAY_TPU_LOCK_DIAG", "0")
+        monkeypatch.setenv("RAY_TPU_LOCK_CONTENTION", "1")
+        from ray_tpu._private.debug import lock_order
+        lk = lock_order.diag_lock("t_contend_only")
+        assert isinstance(lk, lock_order.DiagLock)
+        assert lk._contend and not lk._witness
 
 
 class TestLoopAffinity:

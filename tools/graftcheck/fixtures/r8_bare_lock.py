@@ -1,0 +1,20 @@
+"""R8 fixture: bare threading primitives instead of the diag_*
+factories — invisible to the lock-order witness and to contention
+profiling.
+
+Never imported — parsed only by graftcheck.
+"""
+
+import threading
+
+_MODULE_LOCK = threading.Lock()        # R8: bare module-level Lock
+
+
+class Manager:
+    def __init__(self):
+        self._lock = threading.RLock()              # R8: bare RLock
+        self._cond = threading.Condition(self._lock)  # R8: bare Condition
+
+    def work(self):
+        with self._lock:
+            return True

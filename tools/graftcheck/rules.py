@@ -20,6 +20,11 @@ R6        no compiled-only code: a .pyc under __pycache__ whose source
           .py is gone is an orphan (this PR replaced two such packages)
 R7        no silent exception swallowing in daemon pump loops — use
           ray_tpu._private.debug.swallow.noted(site, exc)
+R8        no bare ``threading.Lock/RLock/Condition`` in ray_tpu modules
+          — use the ``diag_*`` factories, so every lock joins the
+          lock-order witness AND the contention-profiling plane
+          (ISSUE 13: a bare lock is invisible to both; new code must
+          not silently opt out)
 ========  ==============================================================
 """
 
@@ -33,7 +38,7 @@ from typing import Dict, List, Optional, Set, Tuple
 from graftcheck.analyzer import (LOOP_POST_METHODS, Finding, FunctionModel,
                                  Program, _call_tail, _is_self_attr)
 
-ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7")
+ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8")
 
 RULE_TITLES = {
     "R1": "lock-order graph must be acyclic",
@@ -43,6 +48,7 @@ RULE_TITLES = {
     "R5": "terminal-transition idempotency / refcount floor hygiene",
     "R6": "no pyc-without-source orphan packages",
     "R7": "no silent exception swallowing in pump loops",
+    "R8": "bare threading primitives bypass the diag_* witness plane",
 }
 
 
@@ -576,6 +582,83 @@ def _is_silent_body(body: List[ast.stmt]) -> bool:
 
 
 # ---------------------------------------------------------------------------
+# R8 — bare threading primitives outside the diag_* witness plane.
+
+_R8_PRIMITIVES = {"Lock", "RLock", "Condition"}
+#: The witness/contention plane itself (and the fault-injection hook it
+#: calls into) cannot be built FROM wrapped locks — wrapping would
+#: recurse.  Everything else in ray_tpu must route through diag_*.
+_R8_EXEMPT_RE = re.compile(
+    r"(^|/)_private/debug/|(^|/)_private/fault_injection\.py$")
+
+
+def check_bare_threading(prog: Program) -> List[Finding]:
+    """A ray_tpu module creating ``threading.Lock()/RLock()/
+    Condition()`` directly instead of ``diag_lock/diag_rlock/
+    diag_condition``: the lock is invisible to the lock-order witness
+    AND to contention profiling (ISSUE 13).  Baseline-ratcheted —
+    pre-R8 modules are grandfathered with a why; new code cannot
+    silently opt out of the plane."""
+    findings: List[Finding] = []
+    for mod in prog.modules:
+        path = mod.path.replace(os.sep, "/")
+        if _R8_EXEMPT_RE.search(path):
+            continue
+        # `from threading import Lock [as L]` — the analyzer's flat
+        # alias table loses the source module, so collect the names
+        # imported FROM threading here: a bare `Lock()` call through
+        # such an import is the trivial R8 bypass.
+        from_threading: Dict[str, str] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom) \
+                    and node.module == "threading":
+                for alias in node.names:
+                    if alias.name in _R8_PRIMITIVES:
+                        from_threading[alias.asname or alias.name] = \
+                            alias.name
+
+        def _bare_kind(call: ast.Call, mod=mod,
+                       from_threading=from_threading) -> Optional[str]:
+            func = call.func
+            if isinstance(func, ast.Attribute) \
+                    and func.attr in _R8_PRIMITIVES \
+                    and isinstance(func.value, ast.Name) \
+                    and mod.import_aliases.get(
+                        func.value.id) == "threading":
+                return func.attr
+            if isinstance(func, ast.Name):
+                return from_threading.get(func.id)
+            return None
+
+        def visit(node: ast.AST, qual: List[str], mod=mod):
+            for child in ast.iter_child_nodes(node):
+                nxt = qual
+                if isinstance(child, (ast.ClassDef, ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    nxt = qual + [child.name]
+                if isinstance(child, ast.Call):
+                    kind = _bare_kind(child)
+                    if kind is not None:
+                        symbol = ".".join(qual[-2:]) or "<module>"
+                        factory = {"Lock": "diag_lock",
+                                   "RLock": "diag_rlock",
+                                   "Condition": "diag_condition"}[kind]
+                        findings.append(Finding(
+                            rule="R8", path=mod.path, line=child.lineno,
+                            symbol=symbol,
+                            message=(f"bare threading.{kind}() — "
+                                     f"invisible to the lock-order "
+                                     f"witness and the contention-"
+                                     f"profiling plane; use "
+                                     f"debug.{factory}(name)"),
+                            detail=f"bare:{kind}"))
+                visit(child, nxt)
+
+        visit(mod.tree, [])
+    return findings
+
+
+# ---------------------------------------------------------------------------
 
 
 def run_all(prog: Program, paths: List[str], repo_root: str,
@@ -599,6 +682,8 @@ def run_all(prog: Program, paths: List[str], repo_root: str,
         findings += check_pyc_orphans([repo_root], repo_root)
     if "R7" in selected:
         findings += check_silent_swallow(prog)
+    if "R8" in selected:
+        findings += check_bare_threading(prog)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     # Two identical defects in one function (e.g. two unfloored
     # decrements of the same attr) must not collapse to one
