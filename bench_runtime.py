@@ -806,6 +806,86 @@ ray_tpu.shutdown()
                 seconds=round(res["seconds"], 2))
 
 
+def bench_partition_recovery():
+    """Partition-tolerance row (ISSUE 14): a sub-grace network flap
+    around a live node-host OS process must cost a PLACEMENT PAUSE and
+    nothing else — zero actor restarts, zero lineage reconstructions,
+    no fencing — and the row records how fast scheduling converges
+    after the heal (first spoke-targeted task completion).  Runs in a
+    subprocess: failure detection needs its own (fast) heartbeat
+    config, and a wedged run must not take the envelope down."""
+    import subprocess
+    script = """
+import os, time, json
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import ray_tpu
+from ray_tpu._private import fault_injection
+from ray_tpu._private.worker import global_worker
+
+ray_tpu.init(num_cpus=2, _system_config={
+    "scheduler_backend": "native",
+    "raylet_heartbeat_period_milliseconds": 50,
+    "num_heartbeats_suspect": 6,
+    "num_heartbeats_timeout": 200,
+    "gcs_resource_broadcast_period_milliseconds": 50,
+})
+cluster = global_worker().cluster
+handle = cluster.add_remote_node(num_cpus=1, resources={"spoke": 2.0})
+nid = handle.node_id
+
+@ray_tpu.remote(resources={"spoke": 1}, num_cpus=0, max_restarts=2)
+class Probe:
+    def __init__(self):
+        self.n = 0
+    def incr(self):
+        self.n += 1
+        return self.n
+
+@ray_tpu.remote(resources={"spoke": 1}, num_cpus=0)
+def ping():
+    return "up"
+
+probe = Probe.remote()
+assert ray_tpu.get(probe.incr.remote(), timeout=30) == 1
+assert ray_tpu.get(ping.remote(), timeout=30) == "up"
+
+part = fault_injection.partition(handle.proxy.address,
+                                 outbound=True, inbound=False)
+part.arm()
+deadline = time.monotonic() + 10
+while time.monotonic() < deadline and not \
+        cluster.gcs.heartbeat_manager.is_suspect(nid):
+    time.sleep(0.01)
+assert cluster.gcs.heartbeat_manager.is_suspect(nid), "never SUSPECT"
+part.heal(); part.close()
+heal_t = time.monotonic()
+assert ray_tpu.get(ping.remote(), timeout=60) == "up"
+converged_ms = (time.monotonic() - heal_t) * 1000.0
+# Zero-restart assertion: the actor kept its in-memory state.
+assert ray_tpu.get(probe.incr.remote(), timeout=30) == 2, "actor restarted"
+assert cluster.gcs.node_manager.fenced_count(nid) == 0, "fenced in-grace"
+from ray_tpu._private.metrics_agent import get_metrics_registry
+text = get_metrics_registry().render_prometheus()
+for line in text.splitlines():
+    if line.startswith("ray_tpu_lineage_reconstructions"):
+        assert float(line.rsplit(" ", 1)[1]) == 0.0, line
+print(json.dumps({"heal_to_converged_ms": round(converged_ms, 1),
+                  "restarts": 0, "reconstructions": 0}))
+ray_tpu.shutdown()
+"""
+    out = subprocess.run([sys.executable, "-c", script],
+                         capture_output=True, text=True, timeout=300)
+    if out.returncode != 0 or not out.stdout.strip():
+        return emit("partition_recovery", -1.0, "ms", error=(
+            f"child failed rc={out.returncode}: "
+            f"{(out.stderr or out.stdout)[-500:]}"))
+    res = json.loads(out.stdout.strip().splitlines()[-1])
+    return emit("partition_recovery", res["heal_to_converged_ms"], "ms",
+                restarts=res["restarts"],
+                reconstructions=res["reconstructions"],
+                zero_restart_ok=res["restarts"] == 0)
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true",
@@ -883,6 +963,7 @@ def main():
         link_time_s=0.4 if quick else 0.8))
     rows.append(bench_process_mode_objects(8 if quick else 32,
                                            3 if quick else 10))
+    rows.append(bench_partition_recovery())
     queued = args.queued if args.queued is not None else \
         (20_000 if quick else 1_000_000)
     rows.append(bench_queued(queued, num_blockers=cpus))

@@ -85,8 +85,12 @@ class Cluster:
     def adopt_raylet(self, raylet):
         """Register an externally-constructed raylet (a RemoteNodeProxy
         mirroring a NodeHost OS process) into the membership — the
-        head-side half of NodeInfoGcsService.RegisterNode."""
+        head-side half of NodeInfoGcsService.RegisterNode.  A
+        re-registration of the same node id (a fenced node coming back
+        as a fresh incarnation) REPLACES the stale mirror."""
         with self._lock:
+            self._raylets = [r for r in self._raylets
+                             if r.node_id != raylet.node_id]
             self._raylets.append(raylet)
             self._ever_raylets.append(raylet)
         self.gcs.register_raylet(raylet)
@@ -193,11 +197,12 @@ class Cluster:
     def shutdown(self):
         with self._lock:
             everyone = list(self._ever_raylets)
+        from ray_tpu._private.debug import swallow
         for r in everyone:          # Raylet.shutdown is idempotent
             try:
                 r.shutdown()
-            except Exception:
-                pass
+            except Exception as e:
+                swallow.noted("cluster.shutdown_raylet", e)
         with self._lock:
             handles, self._remote_procs = self._remote_procs, []
         for h in handles:

@@ -108,10 +108,26 @@ class Config:
     #: Reconnect-reconcile sweep exempts lease grants younger than this
     #: (their grant reply may legitimately still be in flight).
     lease_reconcile_grace_s: float = 5.0
+    #: Per-attempt bound on head->node lease RPCs (request/return over
+    #: the wire).  A blackholed request (asymmetric partition: the node
+    #: heartbeats but cannot receive) would otherwise strand the
+    #: submitter forever — the bounded attempts retry under one dedup
+    #: token (a slow-but-delivered first attempt is replayed, never
+    #: re-granted) and exhausted attempts surface as a lease rejection
+    #: the submitter's transient re-lease machinery absorbs.  Keep WELL
+    #: above legitimate dep-wait lease holds.
+    lease_rpc_timeout_s: float = 30.0
 
     # ------ failure detection (ray_config_def.h:51-55) ------
     raylet_heartbeat_period_milliseconds: int = 100
     num_heartbeats_timeout: int = 30
+    #: Missed beats before a node is marked SUSPECT (published; the
+    #: scheduler masks suspect nodes for NEW placements while actors /
+    #: objects / placement groups stay untouched).  A transient
+    #: partition that heals between this and num_heartbeats_timeout
+    #: costs a placement pause, not a node death.  Must be below
+    #: num_heartbeats_timeout; the gap is the "suspect grace".
+    num_heartbeats_suspect: int = 15
 
     # ------ object store ------
     #: Objects larger than this are promoted to the node (plasma-equivalent)
@@ -227,6 +243,18 @@ class Config:
     #: deadlock the pool (reference: grpc server completion-queue
     #: thread pool).
     rpc_dispatch_pool_size: int = 64
+    #: Attempts for RpcClient.call on verbs classified retryable in
+    #: rpc/verbs.py (timeout / connection loss only — a remote handler
+    #: exception is deterministic and never retried).
+    rpc_retry_attempts: int = 3
+    #: Base of the exponential backoff between those retry attempts.
+    rpc_retry_backoff_s: float = 0.2
+    #: Server-side dedup window (entries) for requests carrying a
+    #: client-minted dedup token: the handler of a non-idempotent verb
+    #: runs once per token; duplicates — client retries AND duplicated
+    #: wire deliveries — get the recorded reply.  Size it well above
+    #: (concurrent in-flight mutating requests x retry attempts).
+    rpc_dedup_window_size: int = 512
 
     # ------ GCS ------
     gcs_storage_backend: str = "memory"  # "memory" | "file"

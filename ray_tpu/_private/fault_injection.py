@@ -25,32 +25,59 @@ Named points wired into the runtime (grep ``fault_injection.hook``):
                           the hold — attributable contention for the
                           profiling plane; only fires on witness/contention
                           wrapped locks)
+``rpc.send``              client side, before a framed request leaves the
+                          process (ctx: verb, peer, peer_host, peer_port)
+``rpc.recv``              server side, before an inbound request dispatches
+                          (ctx: verb, peer, peer_host, peer_port)
 ========================  ====================================================
 
 Modes:
 
-* ``error`` — raise :class:`FaultInjectedError` at the hook;
-* ``delay`` — sleep ``delay_s`` at the hook (slow-IO / slow-network);
-* ``kill``  — ``SIGKILL`` the calling process (real process death; for
-  node-host / worker OS processes).
+* ``error``     — raise :class:`FaultInjectedError` at the hook;
+* ``delay``     — sleep ``delay_s`` at the hook (slow-IO / slow-network);
+* ``kill``      — ``SIGKILL`` the calling process (real process death; for
+  node-host / worker OS processes);
+* ``drop``      — the hook RETURNS ``"drop"`` and the call site discards
+  the message (wire fault points only: a dropped send never leaves the
+  process, a dropped recv never dispatches — the asymmetric-partition
+  primitive);
+* ``duplicate`` — the hook returns ``"duplicate"`` and the call site
+  delivers the message twice (duplicate-delivery chaos; the RPC dedup
+  window is what must make it harmless).
+
+Armings can be SCOPED with a ``match`` dict compared against the
+``hook`` call's keyword context via :func:`fnmatch.fnmatchcase` — e.g.
+``arm("rpc.send", "drop", count=-1, match={"verb": "heartbeat"})`` drops
+only heartbeats, ``match={"peer": "127.0.0.1:6200"}`` drops only frames
+to one address.  Several differently-scoped armings may coexist on one
+point; the first match (arming order) wins.
 
 Arming is in-process via :func:`arm` or cross-process via the
 ``RAY_TPU_FAULT_POINTS`` env var (parsed at import in every daemon):
 
-    RAY_TPU_FAULT_POINTS="spill.write:error:2,transfer.chunk:delay:-1:0.05"
+    RAY_TPU_FAULT_POINTS="spill.write:error:2,rpc.send@verb=heartbeat:drop:-1"
 
-format per entry: ``point:mode[:count[:delay_s]]`` (count -1 = every
-hit).  Malformed entries are skipped, never fatal: this parses at
-import time in every daemon, and a typo in an env var must not take
+format per entry: ``point[@k=v[&k=v...]]:mode[:count[:delay_s]]``
+(count -1 = every hit; match values must avoid ``:``/``,``/``&`` —
+address-scoped armings go through :func:`arm` or the ``arm_fault`` wire
+verb instead).  Malformed entries are skipped, never fatal: this parses
+at import time in every daemon, and a typo in an env var must not take
 the cluster down.
+
+Spawned daemons additionally expose ``arm_fault`` / ``disarm_fault``
+RPC verbs for post-startup arming; those verbs are EXEMPT from the wire
+fault points themselves (``rpc`` module ``_CONTROL_VERBS``) so an armed
+partition can always be healed through it — that is what
+:class:`partition` builds on.
 """
 
 from __future__ import annotations
 
+import fnmatch
 import os
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from ray_tpu import exceptions
 
@@ -63,42 +90,71 @@ class FaultInjectedError(exceptions.RayTpuError):
         super().__init__(f"injected fault at {point!r}")
 
 
-class _Arming:
-    __slots__ = ("mode", "remaining", "skip", "delay_s", "fired")
+_MODES = ("error", "delay", "kill", "drop", "duplicate")
 
-    def __init__(self, mode: str, count: int, skip: int, delay_s: float):
+
+class _Arming:
+    __slots__ = ("mode", "remaining", "skip", "delay_s", "fired", "match")
+
+    def __init__(self, mode: str, count: int, skip: int, delay_s: float,
+                 match: Optional[dict]):
         self.mode = mode
         self.remaining = count     # -1 = unlimited
         self.skip = skip           # let the first N hits through
         self.delay_s = delay_s
+        self.match = dict(match) if match else None
         self.fired = 0
 
 
+def _ctx_matches(match: Optional[dict], ctx: dict) -> bool:
+    if not match:
+        return True
+    for key, pattern in match.items():
+        value = ctx.get(key)
+        if value is None or not fnmatch.fnmatchcase(str(value),
+                                                    str(pattern)):
+            return False
+    return True
+
+
 _lock = threading.Lock()
-_points: Dict[str, _Arming] = {}
+_points: Dict[str, List[_Arming]] = {}
 #: Total hits per point since arming began (kept after disarm so tests
 #: can assert "the fault actually fired" — a chaos test that passes
 #: because its fault never triggered proves nothing).
 _fired: Dict[str, int] = {}
 
 
-def hook(point: str) -> None:
-    """Failure-point call site.  No-op unless ``point`` is armed.
+def hook(point: str, **ctx) -> Optional[str]:
+    """Failure-point call site.  No-op unless ``point`` is armed AND the
+    arming's ``match`` accepts the keyword context.
 
-    The disarmed fast path is one dict read with no lock — cheap enough
-    for per-chunk and per-heartbeat sites.
+    Returns ``"drop"`` / ``"duplicate"`` for those modes (the call site
+    implements the semantics), ``None`` otherwise.  The disarmed fast
+    path is one dict read with no lock — cheap enough for per-chunk,
+    per-heartbeat and per-RPC sites.
     """
     if not _points:
-        return
+        return None
     with _lock:
-        arming = _points.get(point)
+        armings = _points.get(point)
+        if not armings:
+            return None
+        arming = None
+        for a in armings:
+            # An EXHAUSTED arming must not shadow later armings on the
+            # same point: a spent count=1 verb-scoped drop would
+            # otherwise silently neuter a partition armed afterwards.
+            if a.remaining == 0:
+                continue
+            if _ctx_matches(a.match, ctx):
+                arming = a
+                break
         if arming is None:
-            return
+            return None
         if arming.skip > 0:
             arming.skip -= 1
-            return
-        if arming.remaining == 0:
-            return
+            return None
         if arming.remaining > 0:
             arming.remaining -= 1
         arming.fired += 1
@@ -110,12 +166,14 @@ def hook(point: str) -> None:
     try:
         from ray_tpu._private.debug import flight_recorder
         flight_recorder.record("fault.fired", point=point, mode=mode,
-                               delay_s=delay_s)
+                               delay_s=delay_s, **ctx)
     except Exception:
         pass
     if mode == "delay":
         time.sleep(delay_s)
-        return
+        return None
+    if mode in ("drop", "duplicate"):
+        return mode
     if mode == "kill":
         import signal
         os.kill(os.getpid(), signal.SIGKILL)
@@ -123,23 +181,40 @@ def hook(point: str) -> None:
 
 
 def arm(point: str, mode: str = "error", count: int = 1, skip: int = 0,
-        delay_s: float = 0.0) -> None:
+        delay_s: float = 0.0, match: Optional[dict] = None) -> None:
     """Arm ``point``: the next ``count`` hits (after ``skip`` free
-    passes) inject ``mode``.  Re-arming replaces the previous arming."""
-    if mode not in ("error", "delay", "kill"):
+    passes) whose context matches ``match`` inject ``mode``.  Re-arming
+    with the SAME match replaces that arming; a different match adds a
+    second, independently-counted arming on the point."""
+    if mode not in _MODES:
         raise ValueError(f"unknown fault mode {mode!r}")
+    new = _Arming(mode, count, skip, delay_s, match)
     with _lock:
-        _points[point] = _Arming(mode, count, skip, delay_s)
+        armings = _points.setdefault(point, [])
+        for i, a in enumerate(armings):
+            if a.match == new.match:
+                armings[i] = new
+                return
+        armings.append(new)
 
 
-def disarm(point: Optional[str] = None) -> None:
-    """Disarm one point, or every point when ``point`` is None (test
-    teardown).  Fired counts are kept."""
+def disarm(point: Optional[str] = None,
+           match: Optional[dict] = None) -> None:
+    """Disarm one point (optionally only the arming with exactly
+    ``match``), or every point when ``point`` is None (test teardown).
+    Fired counts are kept."""
     with _lock:
         if point is None:
             _points.clear()
-        else:
+            return
+        if match is None:
             _points.pop(point, None)
+            return
+        armings = _points.get(point)
+        if armings:
+            armings[:] = [a for a in armings if a.match != match]
+            if not armings:
+                _points.pop(point, None)
 
 
 def fired(point: str) -> int:
@@ -168,11 +243,116 @@ def load_from_env(env: Optional[str] = None) -> None:
             if len(fields) < 2:
                 continue
             point, mode = fields[0], fields[1]
+            match = None
+            if "@" in point:
+                point, _, spec = point.partition("@")
+                match = {}
+                for kv in spec.split("&"):
+                    k, _, v = kv.partition("=")
+                    if k and v:
+                        match[k] = v
             count = int(fields[2]) if len(fields) > 2 else 1
             delay_s = float(fields[3]) if len(fields) > 3 else 0.0
-            arm(point, mode, count=count, delay_s=delay_s)
+            arm(point, mode, count=count, delay_s=delay_s, match=match)
         except ValueError:
             continue
+
+
+# ---------------------------------------------------------------------------
+# Wire partitions: asymmetric drop-sets armed ACROSS processes.
+# ---------------------------------------------------------------------------
+
+def arm_over_wire(client, point: str, mode: str = "error", count: int = 1,
+                  skip: int = 0, delay_s: float = 0.0,
+                  match: Optional[dict] = None,
+                  timeout: float = 10.0) -> None:
+    """Arm a fault point in a REMOTE daemon over its ``arm_fault`` verb
+    (exempt from the wire fault points, so this works mid-partition)."""
+    client.call("arm_fault", {"point": point, "mode": mode, "count": count,
+                              "skip": skip, "delay_s": delay_s,
+                              "match": match}, timeout=timeout)
+
+
+def disarm_over_wire(client, point: str, match: Optional[dict] = None,
+                     timeout: float = 10.0) -> None:
+    client.call("disarm_fault", {"point": point, "match": match},
+                timeout=timeout)
+
+
+class partition:
+    """Asymmetric wire partition around one spawned daemon.
+
+    Arms drop-mode wire faults IN the daemon's process over the
+    fault-exempt ``arm_fault``/``disarm_fault`` verbs, so the partition
+    can always be healed no matter which directions are cut:
+
+    * ``outbound`` — the daemon's client-side ``rpc.send`` drops every
+      request it originates (heartbeats, metrics reports, location
+      rows, wedge reports never reach the head; peer pulls never reach
+      peers), scoped by ``peer`` (default every peer);
+    * ``inbound`` — the daemon's server-side ``rpc.recv`` drops every
+      request arriving at it (lease pushes, resource broadcasts, chunk
+      fetches die on its doorstep; their replies are implicitly never
+      sent).
+
+    One direction alone is the classic ASYMMETRIC partition: e.g.
+    ``partition(client, inbound=False)`` makes the node look dead to
+    the head (no heartbeats arrive) while the node itself still hears
+    everything — the zombie-producing shape.  Context manager: arms on
+    enter, heals on exit; or call :meth:`arm`/:meth:`heal` explicitly.
+    """
+
+    def __init__(self, target, outbound: bool = True, inbound: bool = True,
+                 peer: str = "*"):
+        """``target`` is an RpcClient to the daemon's server, or its
+        (host, port) address — the helper then dials its OWN client, so
+        healing still works after the head declared the node dead and
+        closed the proxy's connection."""
+        if hasattr(target, "call"):
+            self._client = target
+            self._own_client = False
+        else:
+            from ray_tpu.rpc import RpcClient
+            self._client = RpcClient(tuple(target))
+            self._own_client = True
+        self._outbound = outbound
+        self._inbound = inbound
+        self._peer = peer
+        self._armed = False
+
+    def arm(self) -> "partition":
+        if self._outbound:
+            arm_over_wire(self._client, "rpc.send", "drop", count=-1,
+                          match={"peer": self._peer})
+        if self._inbound:
+            arm_over_wire(self._client, "rpc.recv", "drop", count=-1,
+                          match={"peer": self._peer} if self._peer != "*"
+                          else None)
+        self._armed = True
+        return self
+
+    def heal(self) -> None:
+        if not self._armed:
+            return
+        if self._outbound:
+            disarm_over_wire(self._client, "rpc.send",
+                             match={"peer": self._peer})
+        if self._inbound:
+            disarm_over_wire(self._client, "rpc.recv",
+                             match={"peer": self._peer}
+                             if self._peer != "*" else None)
+        self._armed = False
+
+    def close(self) -> None:
+        if self._own_client:
+            self._client.close()
+
+    def __enter__(self) -> "partition":
+        return self.arm()
+
+    def __exit__(self, *_exc) -> None:
+        self.heal()
+        self.close()
 
 
 load_from_env()

@@ -53,6 +53,7 @@ def register_operator_handlers(cluster, job_manager):
             nodes.append({"node_id": node_id.hex(),
                           "name": info.get("node_name", ""),
                           "state": info.get("state"),
+                          "incarnation": info.get("incarnation", 0),
                           "resources": info.get("resources", {})})
         view = cluster.gcs.resource_manager.view
         return {

@@ -169,6 +169,18 @@ class RemoteNodeProxy:
         self.client = RpcClient(tuple(address))
         self.object_store = _ProxyObjectStore(self)
         self.is_remote_proxy = True
+        #: Minted by GcsNodeManager.register_node when this proxy is
+        #: adopted (incarnation fencing); returned to the node in its
+        #: registration reply.
+        self.incarnation = None
+        #: Set by the head when this proxy's node is declared dead /
+        #: superseded: a LATE lease reply arriving afterwards must not
+        #: wrap a worker handle — the zombie's grant is rejected and
+        #: counted as a fenced lease reply.
+        self.fenced = False
+        #: Callable(verb) the head installs to count fenced rejections
+        #: against the GCS node manager.
+        self.fence_notify = None
         self._last_report = {
             "available": dict(resources),
             "total": dict(resources),
@@ -182,6 +194,15 @@ class RemoteNodeProxy:
         self._held_tokens: set = set()
         self._tokens_lock = diag_lock("RemoteNodeProxy._tokens_lock")
         self.client.on_reconnect = self._reconcile_leases
+        # Periodic reconcile, not just on-reconnect: a lease the
+        # client's bounded retry loop gave up on (the node's grant
+        # landed after rpc_retry_attempts x lease_rpc_timeout_s) is
+        # held by NOBODY while the connection stayed up — without a
+        # clock-driven sweep that worker slot leaks until some
+        # unrelated reconnect happens.
+        self._stopped = False
+        self._reconcile_timer = None
+        self._schedule_periodic_reconcile()
 
     # ---- GCS-facing (register / resource sync) -------------------------
     def node_info(self) -> dict:
@@ -211,6 +232,24 @@ class RemoteNodeProxy:
         self.client.call_async("update_resource_usage", batch, _ignore)
 
     # ---- lease protocol ------------------------------------------------
+    def _fence_grant(self, result: dict, token) -> bool:
+        """A lease reply landing AFTER this proxy was fenced (node
+        declared dead, or superseded by a newer incarnation) must not
+        produce a usable worker handle: the zombie's grant is converted
+        to a rejection and counted — the lease-reply resurrection
+        vector of the fencing acceptance."""
+        if not self.fenced:
+            return False
+        if token is not None and self.fence_notify is not None:
+            try:
+                self.fence_notify("lease_reply")
+            except Exception:
+                pass
+        result.clear()
+        result.update({"rejected": True,
+                       "reason": "node fenced (stale incarnation)"})
+        return True
+
     def request_worker_lease(self, spec, reply):
         def on_reply(result, err):
             if err is not None:
@@ -218,6 +257,9 @@ class RemoteNodeProxy:
                        "reason": f"node connection lost: {err}"})
                 return
             token = result.pop("worker_token", None)
+            if self._fence_grant(result, token):
+                reply(result)
+                return
             if token is not None:
                 with self._tokens_lock:
                     self._held_tokens.add(token)
@@ -225,7 +267,9 @@ class RemoteNodeProxy:
                 result["raylet"] = self
             reply(result)
 
-        self.client.call_async("request_worker_lease", spec, on_reply)
+        from ray_tpu._private.config import get_config
+        self.client.call_async("request_worker_lease", spec, on_reply,
+                               timeout=get_config().lease_rpc_timeout_s)
 
     def request_worker_lease_batch(self, specs, reply):
         """Batched lease protocol over the wire: N same-class lease
@@ -244,6 +288,8 @@ class RemoteNodeProxy:
             results = (result or {}).get("results") or []
             for r in results:
                 token = r.pop("worker_token", None)
+                if self._fence_grant(r, token):
+                    continue
                 if token is not None:
                     with self._tokens_lock:
                         self._held_tokens.add(token)
@@ -251,8 +297,10 @@ class RemoteNodeProxy:
                     r["raylet"] = self
             reply({"results": results})
 
+        from ray_tpu._private.config import get_config
         self.client.call_async("request_worker_lease_batch",
-                               {"specs": specs}, on_reply)
+                               {"specs": specs}, on_reply,
+                               timeout=get_config().lease_rpc_timeout_s)
 
     def return_worker(self, worker, disconnect: bool = False):
         token = worker.worker_id.binary()
@@ -261,10 +309,11 @@ class RemoteNodeProxy:
         if disconnect or getattr(worker, "state", "") != "ACTOR":
             with self._tokens_lock:
                 self._held_tokens.discard(token)
+        from ray_tpu._private.config import get_config
         self.client.call_async(
             "return_worker",
             {"worker_token": token, "disconnect": disconnect},
-            _ignore)
+            _ignore, timeout=get_config().lease_rpc_timeout_s)
 
     def _reconcile_leases(self):
         """on_reconnect hook: tell the node which lease tokens this head
@@ -284,6 +333,28 @@ class RemoteNodeProxy:
         timer.daemon = True
         timer.start()
 
+    def _schedule_periodic_reconcile(self):
+        from ray_tpu._private.config import get_config
+        if self._stopped or self.fenced:
+            return
+        period = max(5.0, get_config().lease_reconcile_grace_s * 3.0)
+        timer = threading.Timer(period, self._periodic_reconcile)
+        timer.daemon = True
+        self._reconcile_timer = timer
+        timer.start()
+
+    def _periodic_reconcile(self):
+        if self._stopped or self.fenced:
+            return
+        self._send_reconcile()
+        self._schedule_periodic_reconcile()
+
+    def _stop_reconcile(self):
+        self._stopped = True
+        timer = self._reconcile_timer
+        if timer is not None:
+            timer.cancel()
+
     def _send_reconcile(self):
         with self._tokens_lock:
             held = list(self._held_tokens)
@@ -291,7 +362,7 @@ class RemoteNodeProxy:
             self.client.call("reconcile_leases", {"held": held},
                              timeout=30.0)
         except Exception:
-            pass   # next reconnect retries
+            pass   # the periodic sweep retries
 
     # ---- placement-group 2PC (node_manager.proto:319-330) --------------
     def prepare_bundle_resources(self, pg_id, idx: int, req) -> bool:
@@ -314,6 +385,7 @@ class RemoteNodeProxy:
 
     # ---- lifecycle -----------------------------------------------------
     def shutdown(self):
+        self._stop_reconcile()
         try:
             self.client.call("stop", None, timeout=5.0)
         except Exception:
@@ -323,6 +395,7 @@ class RemoteNodeProxy:
     def kill(self):
         """Head-side bookkeeping only — hard node death is the process
         dying; heartbeat timeout does the declaring."""
+        self._stop_reconcile()
         self.client.close()
 
     def debug_string(self) -> str:
@@ -444,19 +517,55 @@ class HeadService:
         return core
 
     # ---- membership ----------------------------------------------------
-    def _handle_register_node(self, payload) -> bool:
+    def _fence_gate(self, payload, verb: str) -> Optional[dict]:
+        """Incarnation fencing admission check for node-originated wire
+        messages.  None = admitted.  A payload stamped with a
+        non-current ``(node_id, incarnation)`` — a zombie's heartbeat,
+        metrics report, location row, wedge report, inline return — is
+        rejected with ``{"fenced": True, ...}``; the sender drains and
+        re-registers when it sees it.  Payloads WITHOUT an incarnation
+        stamp pass (driver-side/internal senders are not node-bound)."""
+        if not isinstance(payload, dict):
+            return None
+        inc = payload.get("incarnation")
+        if inc is None or "node_id" not in payload:
+            return None
+        node_id = NodeID(payload["node_id"])
+        nm = self._cluster.gcs.node_manager
+        if nm.check_incarnation(node_id, inc):
+            return None
+        nm.note_fenced(node_id, verb)
+        return {"fenced": True, "rejected": int(inc),
+                "incarnation": nm.current_incarnation(node_id)}
+
+    def _handle_register_node(self, payload):
         node_id = NodeID(payload["node_id"])
         proxy = RemoteNodeProxy(
             node_id, payload.get("node_name", ""),
             payload["resources"], payload.get("labels") or {},
             (payload.get("host", "127.0.0.1"), payload["port"]))
+        proxy.fence_notify = \
+            lambda verb, _nid=node_id: \
+            self._cluster.gcs.node_manager.note_fenced(_nid, verb)
         with self._lock:
+            old = self._proxies.get(node_id)
             self._proxies[node_id] = proxy
             token = payload.get("reg_token")
             if token:
                 self._reg_tokens[token] = node_id
+        if old is not None:
+            # Re-registration while the prior proxy still exists (a
+            # fenced node coming back before/without a death prune):
+            # the old mirror is superseded — fence its late replies and
+            # tear its connection down.
+            old.fenced = True
+            old.client.close()
+        # A re-registering node id must be able to federate metrics
+        # again: lift the death-prune tombstone for it (the incarnation
+        # gate on metrics_report is what now keeps zombies out).
+        self.metrics_federation.revive(node_id.hex()[:12])
         self._cluster.adopt_raylet(proxy)
-        return True
+        return {"ok": True, "incarnation": proxy.incarnation}
 
     def node_id_for_token(self, reg_token: str) -> Optional[NodeID]:
         """Resolve a spawner's one-shot registration token to the node
@@ -470,19 +579,36 @@ class HeadService:
         self._drop_proxy(node_id)
         return True
 
-    def _handle_heartbeat(self, payload) -> bool:
-        self._cluster.gcs.heartbeat_manager.heartbeat(
-            NodeID(payload["node_id"]))
+    def _handle_heartbeat(self, payload):
+        fenced = self._fence_gate(payload, "heartbeat")
+        if fenced is not None:
+            return fenced
+        node_id = NodeID(payload["node_id"])
+        known = self._cluster.gcs.heartbeat_manager.heartbeat(node_id)
+        if not known and payload.get("incarnation") is not None:
+            # Stamped but unknown to the beat tracker: membership raced
+            # out from under the gate (death between gate and here).
+            # Tell the sender rather than ACK a beat nobody counted —
+            # an ACKed-but-dropped beat is a zombie that never learns.
+            # Unstamped (pre-registration) beats stay silently ignored.
+            nm = self._cluster.gcs.node_manager
+            nm.note_fenced(node_id, "heartbeat")
+            return {"fenced": True,
+                    "rejected": int(payload["incarnation"]),
+                    "incarnation": nm.current_incarnation(node_id)}
         return True
 
-    def _handle_metrics_report(self, payload) -> bool:
+    def _handle_metrics_report(self, payload):
         """Federation ingest: merge one node's registry delta under its
         node_id label (reporter.py precedent — per-node samples riding
         an existing channel up to the head).  Reports from nodes this
-        head no longer mirrors are REJECTED: a straggling report from a
-        declared-dead (or wedged-but-alive) node would resurrect its
-        federation entry after the death-prune, leaving stale gauges at
-        /metrics forever."""
+        head no longer mirrors are REJECTED — the incarnation fence is
+        the general mechanism (subsuming the PR-8 tombstone special
+        case): a straggling report from a declared-dead node cannot
+        resurrect its federation entry after the death-prune."""
+        fenced = self._fence_gate(payload, "metrics_report")
+        if fenced is not None:
+            return fenced
         node_id = NodeID(payload["node_id"])
         if self._proxy_for(node_id) is None:
             return False
@@ -491,7 +617,13 @@ class HeadService:
                                        full=payload.get("full", False))
         return True
 
-    def _handle_wedge_report(self, payload) -> bool:
+    def _handle_wedge_report(self, payload):
+        fenced = self._fence_gate(payload, "wedge_report")
+        if fenced is not None:
+            return fenced
+        return self._handle_wedge_report_admitted(payload)
+
+    def _handle_wedge_report_admitted(self, payload) -> bool:
         """A node's watchdog tripped (or recovered): track its internal
         loop liveness and keep the last wedge evidence for the doctor.
         A 'wedge' downgrades liveness immediately; 'recovered' restores
@@ -528,6 +660,19 @@ class HeadService:
         payload = payload or {}
         timeout = float(payload.get("timeout", 10.0))
         out = {"head": handle_debug_dump(payload), "nodes": {}}
+        # Membership rollup: liveness state + incarnation + fencing
+        # evidence per node (the doctor's partition-tolerance column).
+        nm = self._cluster.gcs.node_manager
+        membership = {}
+        for node_id, info in nm.get_all_node_info().items():
+            membership[node_id.hex()[:12]] = {
+                "state": info.get("state"),
+                "incarnation": info.get("incarnation", 0),
+                "fenced_rejections": nm.fenced_count(node_id),
+                "fenced_by_verb":
+                    dict(nm.fence_rejections.get(node_id, {})),
+            }
+        out["membership"] = membership
         with self._lock:
             proxies = dict(self._proxies)
             out["liveness"] = {k: {kk: vv for kk, vv in v.items()
@@ -572,7 +717,10 @@ class HeadService:
                 out["nodes"][node_hex] = entry
         return out
 
-    def _handle_actor_worker_died(self, payload) -> bool:
+    def _handle_actor_worker_died(self, payload):
+        fenced = self._fence_gate(payload, "actor_worker_died")
+        if fenced is not None:
+            return fenced
         self._cluster.gcs.actor_manager.on_actor_worker_died(
             payload["actor_id"], payload["reason"])
         return True
@@ -585,6 +733,12 @@ class HeadService:
             proxy = self._proxies.pop(node_id, None)
             dropped_liveness = self.loop_liveness.pop(
                 node_id.hex()[:12], None)
+        if proxy is not None:
+            # Fence the dead mirror BEFORE closing: a lease reply racing
+            # the death prune must convert to a rejection, not a worker
+            # handle held by nobody.
+            proxy.fenced = True
+            proxy._stop_reconcile()
         if dropped_liveness is not None:
             # A dead node is not "internally degraded" — its death is
             # the heartbeat plane's story, and a lingering per-node
@@ -699,7 +853,10 @@ class HeadService:
         with self._lock:
             return self._proxies.get(node_id)
 
-    def _handle_put_inline(self, payload) -> bool:
+    def _handle_put_inline(self, payload):
+        fenced = self._fence_gate(payload, "put_inline")
+        if fenced is not None:
+            return fenced
         core = self._cluster.core_worker
         if core is None:
             return False
@@ -708,15 +865,21 @@ class HeadService:
             SerializedObject.from_bytes(payload["blob"]))
         return True
 
-    def _handle_add_location(self, payload) -> bool:
+    def _handle_add_location(self, payload):
+        fenced = self._fence_gate(payload, "add_location")
+        if fenced is not None:
+            return fenced
         self._cluster.object_directory.add_location(
             ObjectID(payload["object_id"]), NodeID(payload["node_id"]),
             size=payload.get("size") or None)
         return True
 
-    def _handle_remove_location(self, payload) -> bool:
+    def _handle_remove_location(self, payload):
         """A node healed a vanished/stale copy: drop its directory row
         so fetch_value/get_locations stop redirecting pulls to it."""
+        fenced = self._fence_gate(payload, "remove_location")
+        if fenced is not None:
+            return fenced
         self._cluster.object_directory.remove_location(
             ObjectID(payload["object_id"]), NodeID(payload["node_id"]))
         return True
@@ -725,6 +888,9 @@ class HeadService:
         """Register a spoke's in-flight pull as a relayable PARTIAL
         directory row; replies with the row's seq (the cycle-free
         ordering relay chains rely on)."""
+        fenced = self._fence_gate(payload, "add_partial_location")
+        if fenced is not None:
+            return None   # partial registration protocol: None = refuse
         directory = self._cluster.object_directory
         if not hasattr(directory, "add_partial_location"):
             return None

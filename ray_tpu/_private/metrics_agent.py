@@ -437,6 +437,14 @@ class MetricsFederation:
         if entry is not None:
             self._registry.drop_collector(entry)
 
+    def revive(self, node_id: str) -> None:
+        """Lift a death-prune tombstone: a fenced node RE-REGISTERING
+        under the same node id (fresh incarnation) must federate again.
+        Safe because admission is now incarnation-gated upstream — only
+        a current registration's reports reach ``ingest`` at all."""
+        with self._lock:
+            self._dropped.pop(node_id, None)
+
     def node_ids(self) -> List[str]:
         with self._lock:
             return list(self._nodes)

@@ -41,7 +41,8 @@ class _RemoteHeartbeats:
 
     def heartbeat(self, node_id: NodeID):
         self._host.client.call_async(
-            "heartbeat", {"node_id": node_id.binary()}, lambda _r, _e: None)
+            "heartbeat", self._host.stamp({"node_id": node_id.binary()}),
+            self._host.fence_watch())
         # The emitter buffer only flushes from emit(): piggyback on the
         # raylet's heartbeat loop so the tail of events after the LAST
         # emit on this node (e.g. the final task's RUNNING) still
@@ -115,8 +116,9 @@ class _RemoteActorManager:
 
     def on_actor_worker_died(self, actor_id, reason: str):
         self._host.client.call_async(
-            "actor_worker_died", {"actor_id": actor_id, "reason": reason},
-            lambda _r, _e: None)
+            "actor_worker_died",
+            self._host.stamp({"actor_id": actor_id, "reason": reason}),
+            self._host.fence_watch())
 
 
 class _RemotePublisher:
@@ -347,9 +349,11 @@ class _RemoteDirectory:
                      size: Optional[int] = None):
         self._host.client.call_async(
             "add_location",
-            {"object_id": object_id.binary(), "node_id": node_id.binary(),
-             "size": int(size or 0)},
-            lambda _r, _e: None)
+            self._host.stamp(
+                {"object_id": object_id.binary(),
+                 "node_id": node_id.binary(),
+                 "size": int(size or 0)}),
+            self._host.fence_watch())
 
     # NOTE no size_hint here, deliberately: spoke-side schedulers have
     # no local size table (the head's directory, where the batched
@@ -364,9 +368,10 @@ class _RemoteDirectory:
         # a copy-less node (the row never "ages out" for a live node).
         self._host.client.call_async(
             "remove_location",
-            {"object_id": object_id.binary(),
-             "node_id": node_id.binary()},
-            lambda _r, _e: None)
+            self._host.stamp(
+                {"object_id": object_id.binary(),
+                 "node_id": node_id.binary()}),
+            self._host.fence_watch())
 
     def add_partial_location(self, object_id: ObjectID,
                              node_id: NodeID) -> int:
@@ -376,8 +381,9 @@ class _RemoteDirectory:
         lower-seq rows), so the pull cannot proceed without it."""
         seq = self._host.client.call(
             "add_partial_location",
-            {"object_id": object_id.binary(),
-             "node_id": node_id.binary()},
+            self._host.stamp(
+                {"object_id": object_id.binary(),
+                 "node_id": node_id.binary()}),
             timeout=10.0)
         if seq is None:
             raise RuntimeError("head rejected partial registration")
@@ -572,22 +578,30 @@ class _RemoteCoreWorker:
     def put_serialized_return(self, object_id: ObjectID, serialized,
                               node):
         """Owner lives on the head: ship small returns to its memory
-        store (inline reply), register big ones in the directory."""
+        store (inline reply), register big ones in the directory.  Both
+        paths are incarnation-stamped: a fenced (zombie) worker's
+        return must not land in the owner's store or the directory."""
         from ray_tpu._private.config import get_config
         if serialized.total_bytes <= get_config().max_direct_call_object_size:
-            self._host.client.call(
+            result = self._host.client.call(
                 "put_inline",
-                {"object_id": object_id.binary(),
-                 "blob": serialized.to_bytes()},
+                self._host.stamp(
+                    {"object_id": object_id.binary(),
+                     "blob": serialized.to_bytes()}),
                 timeout=60.0)
         else:
             node.object_store.put(object_id, serialized)
-            self._host.client.call(
+            result = self._host.client.call(
                 "add_location",
-                {"object_id": object_id.binary(),
-                 "node_id": node.node_id.binary(),
-                 "size": int(serialized.total_bytes)},
+                self._host.stamp(
+                    {"object_id": object_id.binary(),
+                     "node_id": node.node_id.binary(),
+                     "size": int(serialized.total_bytes)}),
                 timeout=30.0)
+        if isinstance(result, dict) and result.get("fenced"):
+            self._host.on_fenced(result)
+            raise exceptions.WorkerCrashedError(
+                "return rejected: node incarnation fenced")
 
     def recover_object(self, object_id) -> bool:
         return False
@@ -646,6 +660,13 @@ class NodeHost:
         self.stopped = False
         self.client = RpcClient(tuple(head_address))
         self.peers = PeerPool(self)
+        #: Registration incarnation minted by the head; every head-bound
+        #: message is stamped with it, and a ``{"fenced": True}`` reply
+        #: (this incarnation was declared dead) triggers drain +
+        #: re-register as a fresh incarnation.
+        self.incarnation: Optional[int] = None
+        self._fence_lock = diag_lock("NodeHost._fence_lock")
+        self._refencing = False
         # Observability plane (before the adapter: the task-event
         # buffer's ts normalization closes over clock_sync).
         from ray_tpu._private.metrics_agent import MetricsDeltaShipper
@@ -693,8 +714,12 @@ class NodeHost:
                    lambda p: fault_injection.fired(p["point"]))
         # Deterministic wire arming (chaos tests that need a fault
         # AFTER startup, where env-var count-skipping is unpredictable
-        # — e.g. one loop.stall wedge once the node is registered).
+        # — e.g. one loop.stall wedge once the node is registered, or a
+        # partition armed mid-workload).  Both verbs are EXEMPT from
+        # the rpc.send/rpc.recv fault points (rpc.verbs CONTROL_VERBS)
+        # so an armed partition can always be healed through them.
         s.register("arm_fault", self._handle_arm_fault)
+        s.register("disarm_fault", self._handle_disarm_fault)
         # Introspection plane: this OS process's debug report (loops,
         # wedges, lock contention, flight-recorder tail, stacks) for
         # the head's cluster-wide `ray-tpu doctor` fan-out.
@@ -723,7 +748,16 @@ class NodeHost:
         self._stop_event = threading.Event()
 
         # Join the cluster (NodeInfoGcsService RegisterNode parity).
-        self.client.call("register_node", {
+        # The reply carries the incarnation the head minted for this
+        # registration — the fencing identity of everything we send.
+        self._register(reg_token)
+
+    # ---- incarnation fencing -------------------------------------------
+    def _register(self, reg_token: str = ""):
+        """(Re-)register with the head; one payload builder for both
+        the initial join and the post-fence rebirth so their fields can
+        never drift apart."""
+        reply = self.client.call("register_node", {
             "node_id": self.raylet.node_id.binary(),
             "node_name": self.raylet.node_name,
             "resources": self.raylet.local_resources.to_float_dict("total"),
@@ -732,6 +766,83 @@ class NodeHost:
             "port": self.server.address[1],
             "reg_token": reg_token,
         }, timeout=30.0)
+        if isinstance(reply, dict) and reply.get("incarnation"):
+            self.incarnation = reply["incarnation"]
+        self.raylet.incarnation = self.incarnation
+    def stamp(self, payload: dict) -> dict:
+        """Stamp a head-bound payload with this registration's fencing
+        identity.  ``node_id`` defaults to self (location rows carry
+        their own).  Callable mid-construction (the raylet's heartbeat
+        thread starts before NodeHost.__init__ finishes): before the
+        incarnation arrives the payload goes out unstamped, which the
+        head admits — registration itself is what mints the fence."""
+        if "node_id" not in payload and hasattr(self, "raylet"):
+            payload["node_id"] = self.raylet.node_id.binary()
+        if self.incarnation is not None:
+            payload["incarnation"] = self.incarnation
+        return payload
+
+    def fence_watch(self, cb=None):
+        """Async-reply callback that spots ``{"fenced": True}``
+        rejections and routes them into :meth:`on_fenced` before
+        delegating to ``cb`` (if any)."""
+        def on_done(result, err):
+            if err is None and isinstance(result, dict) and \
+                    result.get("fenced"):
+                self.on_fenced(result)
+            if cb is not None:
+                cb(result, err)
+
+        return on_done
+
+    def on_fenced(self, rejection: dict):
+        """The head rejected a message from this incarnation: we are a
+        ZOMBIE — declared dead during a partition, now healed.  Drain
+        every lease the dead incarnation held (the head's submitters
+        already treat them as lost) and re-register as a fresh
+        incarnation; the raylet, stores and workers live on."""
+        if self.stopped:
+            return
+        rejected = rejection.get("rejected")
+        with self._fence_lock:
+            if self._refencing:
+                return
+            if rejected is not None and self.incarnation is not None and \
+                    int(rejected) != int(self.incarnation):
+                return   # stale rejection aimed at a previous incarnation
+            self._refencing = True
+        threading.Thread(
+            target=self._drain_and_reregister, daemon=True,
+            name=f"ray_tpu::refence::{self.raylet.node_id.hex()[:6]}"
+        ).start()
+
+    def _drain_and_reregister(self):
+        from ray_tpu._private.debug import flight_recorder, swallow
+        try:
+            flight_recorder.record(
+                "node.fenced", node=self.raylet.node_id.hex()[:12],
+                incarnation=self.incarnation)
+            with self._workers_lock:
+                workers = list(self._workers.values())
+                self._workers.clear()
+                self._grant_times.clear()
+            for worker in workers:
+                try:
+                    self.raylet.return_worker(worker, disconnect=True)
+                except Exception as e:
+                    swallow.noted("node_host.fence_drain", e)
+            self._register()
+            # The head pruned this node's federation entry at death and
+            # our diff base is stale relative to it: resync fully.
+            self._metrics_shipper.force_full()
+            flight_recorder.record(
+                "node.reregistered", node=self.raylet.node_id.hex()[:12],
+                incarnation=self.incarnation)
+        except Exception as e:
+            swallow.noted("node_host.refence", e)
+        finally:
+            with self._fence_lock:
+                self._refencing = False
 
     # ---- lease / execute ----------------------------------------------
     def _handle_lease(self, spec, reply):
@@ -926,14 +1037,14 @@ class NodeHost:
                     # Lost or rejected report: the diff base already
                     # counts it as shipped — resync fully next time so
                     # settled series can't stay stale at the head.
-                    if err is not None or result is False:
+                    if err is not None or result is not True:
                         self._metrics_shipper.force_full()
 
                 self.client.call_async(
                     "metrics_report",
-                    {"node_id": self.raylet.node_id.binary(),
-                     "snapshot": delta, "full": full},
-                    on_report)
+                    self.stamp({"node_id": self.raylet.node_id.binary(),
+                                "snapshot": delta, "full": full}),
+                    self.fence_watch(on_report))
         if now - self._last_timeline_ship >= 0.5:
             self._last_timeline_ship = now
             from ray_tpu.util import tracing
@@ -960,7 +1071,13 @@ class NodeHost:
             payload["point"], payload.get("mode", "error"),
             count=int(payload.get("count", 1)),
             skip=int(payload.get("skip", 0)),
-            delay_s=float(payload.get("delay_s", 0.0)))
+            delay_s=float(payload.get("delay_s", 0.0)),
+            match=payload.get("match"))
+        return True
+
+    def _handle_disarm_fault(self, payload) -> bool:
+        fault_injection.disarm(payload.get("point"),
+                               match=payload.get("match"))
         return True
 
     def _make_wedge_listener(self):
@@ -970,9 +1087,9 @@ class NodeHost:
             try:
                 self.client.call_async(
                     "wedge_report",
-                    {"node_id": self.raylet.node_id.binary(),
-                     "event": event, "report": report},
-                    lambda _r, _e: None)
+                    self.stamp({"node_id": self.raylet.node_id.binary(),
+                                "event": event, "report": report}),
+                    self.fence_watch())
             except Exception as e:
                 from ray_tpu._private.debug import swallow
                 swallow.noted("node_host.wedge_ship", e)

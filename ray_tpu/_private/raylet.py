@@ -39,6 +39,10 @@ class Raylet:
         self.cluster = cluster
         self.node_id = NodeID.from_random()
         self.node_name = node_name or f"node-{self.node_id.hex()[:8]}"
+        #: Monotonic registration incarnation, minted by the GCS node
+        #: manager at register time (incarnation fencing).  None until
+        #: registered; preserved across GCS restarts via reconcile.
+        self.incarnation: Optional[int] = None
         self.local_resources = NodeResources(resources, labels=labels)
         self.cluster_view = ClusterResourceView()   # local (dirty) view
         self.loop = EventLoop(f"raylet-{self.node_id.hex()[:6]}")
@@ -166,6 +170,13 @@ class Raylet:
             gone |= known - set(rows.keys()) - {self.node_id}
         for node_id in gone:
             self.cluster_view.remove_node(node_id)
+        # Suspect membership (suspect-before-dead): mask those nodes in
+        # the local scheduling view — no NEW placements there until
+        # their beats resume.  Includes self: a node the GCS suspects
+        # (e.g. its outbound link is cut) stops self-placing too.
+        suspect = batch.get("suspect")
+        if suspect is not None:
+            self.cluster_view.set_masked(set(suspect))
         self.cluster_task_manager.on_cluster_changed()
 
     def _record_spilled_url(self, object_id, url: str):
@@ -186,16 +197,21 @@ class Raylet:
         def record():
             try:
                 core.reference_counter.set_spilled_url(object_id, url)
-            except Exception:
-                pass
+            except Exception as e:
+                # A lost spilled_url silently breaks restore-from-disk
+                # for this object later — count it (graftcheck R7).
+                from ray_tpu._private.debug import swallow
+                swallow.noted("raylet.record_spilled_url", e)
         self.loop.post(record, "raylet.record_spilled_url")
 
     def _heartbeat(self):
         if not self._dead:
             # Chaos point: an injected error/delay here simulates a
             # partitioned or wedged node (missed beats -> declared
-            # dead) without killing the process.
-            fault_injection.hook("node.heartbeat")
+            # dead) without killing the process.  ctx carries the node
+            # so in-process multi-node tests can cut ONE node's beats.
+            fault_injection.hook("node.heartbeat",
+                                 node=self.node_id.hex()[:12])
             self.cluster.gcs.heartbeat_manager.heartbeat(self.node_id)
 
     def _heartbeat_loop(self, period_s: float):

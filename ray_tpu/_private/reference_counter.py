@@ -293,5 +293,8 @@ class ReferenceCounter:
         for cb in callbacks:
             try:
                 cb(object_id)
-            except Exception:
-                pass
+            except Exception as e:
+                # A failed delete subscriber silently leaks its copy of
+                # the object — count it (graftcheck R7 fan-out rule).
+                from ray_tpu._private.debug import swallow
+                swallow.noted("refcount.delete_subscriber", e)

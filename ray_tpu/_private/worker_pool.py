@@ -408,13 +408,15 @@ class WorkerHostService:
         store, native = self._native_store()
         with self._lock:
             oids = self._shm_pins.pop(worker_id_hex, [])
+        from ray_tpu._private.debug import swallow
         for oid in oids:
             try:
                 store.unpin(oid)
                 if native is not None:
                     native.unpin(oid.binary())
-            except Exception:
-                pass
+            except Exception as e:
+                # A lost unpin wedges eviction of that object forever.
+                swallow.noted("worker_pool.release_shm_pin", e)
 
     def _core(self):
         core = self._node.core_worker

@@ -128,10 +128,12 @@ class Dashboard:
         node's psutil sample rides its resource report; remote
         node-hosts' latest reports are cached on their proxies."""
         out = []
+        from ray_tpu._private.debug import swallow
         for raylet in self._cluster.raylets():
             try:
                 report = raylet.get_resource_report()
-            except Exception:
+            except Exception as e:
+                swallow.noted("dashboard.node_stats", e)
                 continue
             out.append({
                 "node_id": raylet.node_id.hex(),

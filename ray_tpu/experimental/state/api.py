@@ -152,11 +152,17 @@ def objects_from_cluster(cluster, filters=None, limit: Optional[int] = None,
 
 def nodes_from_cluster(cluster, filters=None, limit: Optional[int] = None,
                        offset: int = 0) -> List[dict]:
+    """Node liveness rows: ALIVE/SUSPECT/DEAD state, registration
+    incarnation, and the fencing evidence (how many messages from stale
+    incarnations of this node id the head rejected, by verb)."""
+    nm = cluster.gcs.node_manager
     rows = []
-    for node_id, info in \
-            cluster.gcs.node_manager.get_all_node_info().items():
+    for node_id, info in nm.get_all_node_info().items():
         row = dict(info)
         row["node_id"] = node_id.hex()
+        row.setdefault("incarnation", 0)
+        row["fenced_rejections"] = nm.fenced_count(node_id)
+        row["fenced_by_verb"] = dict(nm.fence_rejections.get(node_id, {}))
         rows.append(row)
     return _paginate(_apply_filters(rows, filters), limit, offset)
 

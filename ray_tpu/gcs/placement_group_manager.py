@@ -217,8 +217,10 @@ class GcsPlacementGroupManager:
         for cb in callbacks:
             try:
                 cb(pg)
-            except Exception:
-                pass
+            except Exception as e:
+                # A dropped ready-callback strands its pg.ready() waiter.
+                from ray_tpu._private.debug import swallow
+                swallow.noted("pg.ready_callback", e)
         return True
 
     # ---- GCS-restart reconciliation (gcs_init_data.cc +
@@ -278,8 +280,10 @@ class GcsPlacementGroupManager:
                 if not keep:
                     try:
                         raylet.cancel_resource_reserve(pg_id, idx)
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        # A leaked bundle permanently shrinks the node.
+                        from ray_tpu._private.debug import swallow
+                        swallow.noted("pg.reconcile_cancel", e)
         self._gcs.loop.post(self._schedule_pending, "pg.reconcile")
 
     # ---- failure handling ----------------------------------------------

@@ -94,8 +94,11 @@ class Publisher:
             for cb in targets:
                 try:
                     cb(key, message)
-                except Exception:
-                    pass
+                except Exception as e:
+                    # Per-subscriber loss: the fan-out continues but the
+                    # drop must be visible (graftcheck R7 fan-out rule).
+                    from ray_tpu._private.debug import swallow
+                    swallow.noted("pubsub.subscriber", e)
             return
         if getattr(self._loop, "_stopped", False):
             return    # shutdown: posts would be dropped anyway — don't

@@ -30,7 +30,13 @@ from ray_tpu._private.debug import diag_lock, diag_rlock, loop_only
 
 
 class GcsNodeManager:
-    """Node registry + death publishing (gcs_node_manager.cc parity)."""
+    """Node registry + death publishing (gcs_node_manager.cc parity),
+    plus INCARNATION FENCING: every registration of a node id mints a
+    monotonic incarnation (persisted in the node table), and any
+    head-bound message stamped with a non-current ``(node_id,
+    incarnation)`` is rejected — a node declared dead that comes back
+    talking (the zombie) can no longer resurrect pruned state; it
+    learns it was fenced from the rejection and re-registers fresh."""
 
     def __init__(self, storage: GcsTableStorage, publisher: Publisher):
         self._storage = storage
@@ -38,12 +44,96 @@ class GcsNodeManager:
         self._lock = diag_rlock("GcsNodeManager._lock")
         self.alive_nodes: Dict[NodeID, dict] = {}
         self.dead_nodes: Dict[NodeID, dict] = {}
+        #: node_id -> latest minted incarnation (cache over the durable
+        #: node-table rows; survives the node's death so a re-register
+        #: of the same id always moves FORWARD).
+        self._incarnations: Dict[NodeID, int] = {}
+        #: node_id -> {message class -> rejected count} — the fencing
+        #: evidence surfaced by ``list nodes`` / ``ray-tpu doctor``.
+        self.fence_rejections: Dict[NodeID, Dict[str, int]] = {}
 
-    def register_node(self, node_id: NodeID, info: dict):
+    def register_node(self, node_id: NodeID, info: dict,
+                      incarnation: Optional[int] = None) -> int:
         with self._lock:
-            info = dict(info, state="ALIVE", start_time=time.time())
+            if incarnation is None:
+                prev = self._incarnations.get(node_id)
+                if prev is None:
+                    stored = self._storage.node_table.get(node_id)
+                    prev = int((stored or {}).get("incarnation", 0))
+                incarnation = prev + 1
+            incarnation = int(incarnation)
+            self._incarnations[node_id] = incarnation
+            info = dict(info, state="ALIVE", start_time=time.time(),
+                        incarnation=incarnation)
             self.alive_nodes[node_id] = info
+            # A re-registration (fenced node coming back) revives the id.
+            self.dead_nodes.pop(node_id, None)
             self._storage.node_table.put(node_id, info)
+        self._publisher.publish(pubsub_mod.NODE_CHANNEL, node_id.binary(),
+                                {"state": "ALIVE", "info": info})
+        return incarnation
+
+    # ---- incarnation fencing -------------------------------------------
+    def current_incarnation(self, node_id: NodeID) -> int:
+        with self._lock:
+            return self._incarnations.get(node_id, 0)
+
+    def check_incarnation(self, node_id: NodeID, incarnation) -> bool:
+        """True iff ``(node_id, incarnation)`` is the CURRENT, LIVE
+        registration — the admission check every fenced verb runs."""
+        with self._lock:
+            if node_id not in self.alive_nodes:
+                return False
+            return int(incarnation) == self._incarnations.get(node_id, 0)
+
+    def note_fenced(self, node_id: NodeID, verb: str) -> None:
+        """Count + record one fenced-message rejection (the acceptance
+        evidence: every resurrection vector is provably rejected)."""
+        with self._lock:
+            per = self.fence_rejections.setdefault(node_id, {})
+            per[verb] = per.get(verb, 0) + 1
+        from ray_tpu._private.debug import flight_recorder
+        from ray_tpu._private.metrics_agent import record_internal
+        record_internal("ray_tpu.fencing.rejected_total", 1.0,
+                        mtype="counter", verb=verb,
+                        node=node_id.hex()[:12])
+        flight_recorder.record("fence.rejected", verb=verb,
+                               node=node_id.hex()[:12])
+
+    def fenced_count(self, node_id: NodeID) -> int:
+        with self._lock:
+            return sum(self.fence_rejections.get(node_id, {}).values())
+
+    # ---- suspect (pre-death) state -------------------------------------
+    def mark_suspect(self, node_id: NodeID):
+        """Missed-beats grace state: published so schedulers stop NEW
+        placements on the node; actors/objects/PGs are untouched — a
+        partition that heals inside the grace costs a placement pause,
+        not a node death."""
+        from ray_tpu._private.metrics_agent import record_internal
+        with self._lock:
+            info = self.alive_nodes.get(node_id)
+            if info is None or info.get("state") == "SUSPECT":
+                return
+            info["state"] = "SUSPECT"
+            info["suspect_since"] = time.time()
+            self._storage.node_table.put(node_id, dict(info))
+        record_internal("ray_tpu.node.suspect", 1.0,
+                        node=node_id.hex()[:12])
+        self._publisher.publish(pubsub_mod.NODE_CHANNEL, node_id.binary(),
+                                {"state": "SUSPECT", "info": info})
+
+    def clear_suspect(self, node_id: NodeID):
+        from ray_tpu._private.metrics_agent import record_internal
+        with self._lock:
+            info = self.alive_nodes.get(node_id)
+            if info is None or info.get("state") != "SUSPECT":
+                return
+            info["state"] = "ALIVE"
+            info.pop("suspect_since", None)
+            self._storage.node_table.put(node_id, dict(info))
+        record_internal("ray_tpu.node.suspect", 0.0,
+                        node=node_id.hex()[:12])
         self._publisher.publish(pubsub_mod.NODE_CHANNEL, node_id.binary(),
                                 {"state": "ALIVE", "info": info})
 
@@ -58,10 +148,15 @@ class GcsNodeManager:
             info = self.alive_nodes.pop(node_id, None)
             if info is None:
                 return
+            was_suspect = info.get("state") == "SUSPECT"
             info = dict(info, state="DEAD", death_reason=reason,
                         end_time=time.time())
             self.dead_nodes[node_id] = info
             self._storage.node_table.put(node_id, info)
+        if was_suspect:
+            from ray_tpu._private.metrics_agent import record_internal
+            record_internal("ray_tpu.node.suspect", 0.0,
+                            node=node_id.hex()[:12])
         self._publisher.publish(pubsub_mod.NODE_CHANNEL, node_id.binary(),
                                 {"state": "DEAD", "info": info})
 
@@ -93,32 +188,75 @@ class GcsNodeManager:
 
 
 class GcsHeartbeatManager:
-    """Declares nodes dead after missed heartbeats
+    """Suspect-before-dead failure detection over missed heartbeats
     (gcs_heartbeat_manager.h:31-60; raylet_heartbeat_period x
-    num_heartbeats_timeout, ray_config_def.h:51-55)."""
+    num_heartbeats_timeout, ray_config_def.h:51-55).
 
-    def __init__(self, loop: EventLoop, on_node_death: Callable[[NodeID], None]):
+    Two thresholds instead of the reference's one: at
+    ``num_heartbeats_suspect`` missed beats the node goes SUSPECT
+    (published; schedulers mask it for NEW placements only), at
+    ``num_heartbeats_timeout`` it goes DEAD (the full death cascade:
+    actor restarts, lineage reconstruction, directory pruning).  A
+    transient partition that heals inside the gap — the suspect grace —
+    costs a placement pause and nothing else."""
+
+    def __init__(self, loop: EventLoop,
+                 on_node_death: Callable[[NodeID], None],
+                 on_node_suspect: Optional[Callable[[NodeID], None]] = None,
+                 on_node_recovered: Optional[Callable[[NodeID], None]] = None):
         cfg = get_config()
         self._period_s = cfg.raylet_heartbeat_period_milliseconds / 1000.0
         self._timeout = cfg.num_heartbeats_timeout
+        self._suspect_after = min(max(1, cfg.num_heartbeats_suspect),
+                                  self._timeout)
         self._lock = diag_lock("GcsHeartbeatManager._lock")
+        # Serializes the suspect/recovered CALLBACK pair: _tick fires
+        # _on_suspect after releasing _lock, so a racing heartbeat's
+        # _on_recovered could otherwise run first and the deferred
+        # _on_suspect would re-mask a healthy node forever (recovery
+        # only fires on a suspect->clear edge that already happened).
+        self._transition_lock = diag_lock(
+            "GcsHeartbeatManager._transition_lock")
         self._missed: Dict[NodeID, int] = {}
+        self._suspect: set = set()
         self._on_death = on_node_death
+        self._on_suspect = on_node_suspect
+        self._on_recovered = on_node_recovered
         self._paused = False
         loop.schedule_every(self._period_s, self._tick, "gcs.heartbeat_check")
 
     def register(self, node_id: NodeID):
         with self._lock:
             self._missed[node_id] = 0
+            self._suspect.discard(node_id)
 
     def unregister(self, node_id: NodeID):
         with self._lock:
             self._missed.pop(node_id, None)
+            self._suspect.discard(node_id)
 
-    def heartbeat(self, node_id: NodeID):
+    def heartbeat(self, node_id: NodeID) -> bool:
+        """Returns False for an UNKNOWN node (dead / never registered).
+        Stamped senders never legitimately hit that (the incarnation
+        gate upstream admits only live registrations) — the wire front
+        converts a stamped-but-unknown beat into a fencing rejection;
+        unstamped pre-registration beats are simply ignored."""
+        recovered = False
         with self._lock:
-            if node_id in self._missed:
-                self._missed[node_id] = 0
+            if node_id not in self._missed:
+                return False
+            self._missed[node_id] = 0
+            if node_id in self._suspect:
+                self._suspect.discard(node_id)
+                recovered = True
+        if recovered and self._on_recovered is not None:
+            with self._transition_lock:
+                self._on_recovered(node_id)
+        return True
+
+    def is_suspect(self, node_id: NodeID) -> bool:
+        with self._lock:
+            return node_id in self._suspect
 
     def pause(self, paused: bool = True):
         self._paused = paused
@@ -128,12 +266,31 @@ class GcsHeartbeatManager:
         if self._paused:
             return
         dead = []
+        suspects = []
         with self._lock:
             for node_id in list(self._missed):
                 self._missed[node_id] += 1
-                if self._missed[node_id] >= self._timeout:
+                missed = self._missed[node_id]
+                if missed >= self._timeout:
                     dead.append(node_id)
                     del self._missed[node_id]
+                    self._suspect.discard(node_id)
+                elif missed >= self._suspect_after and \
+                        node_id not in self._suspect:
+                    self._suspect.add(node_id)
+                    suspects.append(node_id)
+        for node_id in suspects:
+            if self._on_suspect is None:
+                continue
+            with self._transition_lock:
+                # A heartbeat may have cleared the suspicion (and run
+                # its recovery) between collecting this list and now —
+                # marking AFTER that recovery would mask a healthy node
+                # with nothing left to unmask it.
+                with self._lock:
+                    still_suspect = node_id in self._suspect
+                if still_suspect:
+                    self._on_suspect(node_id)
         for node_id in dead:
             self._on_death(node_id)
 
@@ -160,6 +317,12 @@ class GcsResourceManager:
         # steady-state wire traffic.
         self._period = 0
         self._full_every = 20
+        # SUSPECT membership (suspect-before-dead): masked in this
+        # view's scheduling snapshots and shipped on every broadcast so
+        # raylet-local views mask identically — suspect nodes take no
+        # NEW placements anywhere while their beats are missing.
+        self._suspect: set = set()
+        self._last_suspect_sent: set = set()
         cfg = get_config()
         loop.schedule_every(
             cfg.gcs_resource_broadcast_period_milliseconds / 1000.0,
@@ -197,7 +360,15 @@ class GcsResourceManager:
         self._last_sent.pop(node_id, None)
         self._needs_full.discard(node_id)
         self._removed_pending.add(node_id)
+        self.set_suspect(node_id, False)
         self.view.remove_node(node_id)
+
+    def set_suspect(self, node_id: NodeID, flag: bool):
+        if flag:
+            self._suspect.add(node_id)
+        else:
+            self._suspect.discard(node_id)
+        self.view.set_masked(set(self._suspect))
 
     def live_available_resources(self) -> Dict[str, float]:
         """Exact cluster availability for the debug/state API
@@ -241,10 +412,14 @@ class GcsResourceManager:
         # (grpc_based_resource_broadcaster + ray_syncer.h:37-66).
         full = {}
         delta = {}
+        from ray_tpu._private.debug import swallow
         for node_id, raylet in list(self._raylets.items()):
             try:
                 usage = raylet.get_resource_report()
-            except Exception:
+            except Exception as e:
+                # A node whose report keeps failing goes stale in the
+                # merge view unseen — count it (R7 fan-out rule).
+                swallow.noted("gcs.resource_poll", e)
                 continue
             full[node_id] = usage
             self.view.update_available(node_id, usage["available"])
@@ -256,6 +431,13 @@ class GcsResourceManager:
             list(self._removed_pending), set()
         self._period += 1
         resync = self._period % self._full_every == 0
+        # Suspect membership rides every broadcast; a CHANGE forces a
+        # send even when no availability row changed, or remote spokes
+        # would keep placing onto (or keep avoiding) a node whose
+        # suspicion flipped during a quiet period.
+        suspect = list(self._suspect)
+        suspect_changed = self._suspect != self._last_suspect_sent
+        self._last_suspect_sent = set(self._suspect)
         for node_id, raylet in list(self._raylets.items()):
             # Deltas are a WIRE optimization: remote node-hosts get
             # changed rows only (plus periodic resyncs correcting
@@ -264,16 +446,17 @@ class GcsResourceManager:
             # period (their dispatch solvers key refreshes off it).
             if not getattr(raylet, "is_remote_proxy", False) or \
                     resync or node_id in joiners:
-                batch = {"rows": full, "full": True, "removed": removed}
-            elif delta or removed:
+                batch = {"rows": full, "full": True, "removed": removed,
+                         "suspect": suspect}
+            elif delta or removed or suspect_changed:
                 batch = {"rows": delta, "full": False,
-                         "removed": removed}
+                         "removed": removed, "suspect": suspect}
             else:
                 continue
             try:
                 raylet.update_resource_usage(batch)
-            except Exception:
-                pass
+            except Exception as e:
+                swallow.noted("gcs.resource_broadcast", e)
 
 
 class GcsJobManager:
@@ -373,7 +556,9 @@ class GcsServer:
         self.kv = GcsInternalKV(self.storage)
         self.node_manager = GcsNodeManager(self.storage, self.publisher)
         self.heartbeat_manager = GcsHeartbeatManager(
-            self.loop, lambda nid: self._on_node_death(nid))
+            self.loop, lambda nid: self._on_node_death(nid),
+            on_node_suspect=self._on_node_suspect,
+            on_node_recovered=self._on_node_recovered)
         self.resource_manager = GcsResourceManager(self.loop, self.publisher)
         self.job_manager = GcsJobManager(self.storage, self.publisher)
         self.worker_manager = GcsWorkerManager(self.publisher)
@@ -401,10 +586,18 @@ class GcsServer:
     def register_raylet(self, raylet):
         node_id = raylet.node_id
         self._raylets[node_id] = raylet
-        self.node_manager.register_node(node_id, raylet.node_info())
+        # A raylet that already carries an incarnation keeps it (GCS
+        # restart reconcile: the surviving node's registration is not a
+        # NEW incarnation — bumping would fence every message the node
+        # sends until it noticed).  Fresh raylets mint the next one.
+        incarnation = self.node_manager.register_node(
+            node_id, raylet.node_info(),
+            incarnation=getattr(raylet, "incarnation", None))
+        raylet.incarnation = incarnation
         self.heartbeat_manager.register(node_id)
         self.resource_manager.register_raylet(node_id, raylet,
                                               raylet.local_resources)
+        return incarnation
 
     def unregister_raylet(self, node_id: NodeID, intentional: bool = True):
         self.heartbeat_manager.unregister(node_id)
@@ -448,14 +641,33 @@ class GcsServer:
         self._raylets.pop(node_id, None)
         self._notify_node_death(node_id)
 
+    def _on_node_suspect(self, node_id: NodeID):
+        """Missed-beats grace: mask NEW placements, touch nothing else
+        (no actor restarts, no reconstruction, no directory pruning)."""
+        from ray_tpu._private.debug import flight_recorder
+        self.node_manager.mark_suspect(node_id)
+        self.resource_manager.set_suspect(node_id, True)
+        flight_recorder.record("node.suspect", node=node_id.hex()[:12])
+
+    def _on_node_recovered(self, node_id: NodeID):
+        from ray_tpu._private.debug import flight_recorder
+        self.node_manager.clear_suspect(node_id)
+        self.resource_manager.set_suspect(node_id, False)
+        flight_recorder.record("node.recovered", node=node_id.hex()[:12])
+
     def _notify_node_death(self, node_id: NodeID):
+        from ray_tpu._private.debug import swallow
         self.actor_manager.on_node_death(node_id)
         self.placement_group_manager.on_node_death(node_id)
         for cb in list(self._node_death_listeners):
             try:
                 cb(node_id)
-            except Exception:
-                pass
+            except Exception as e:
+                # One listener's bug must not stop the fan-out, but a
+                # silently-dropped death notification is exactly how
+                # stale state survives a node death — count it
+                # (graftcheck R7 discipline).
+                swallow.noted("gcs.node_death_listener", e)
 
     def subscribe_node_death(self, cb: Callable[[NodeID], None]):
         self._node_death_listeners.append(cb)

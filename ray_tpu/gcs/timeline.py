@@ -79,7 +79,12 @@ class TimelineStore:
                     args.setdefault("node_id", node_id)
                     ev["args"] = args
                 normalized.append(ev)
-            except Exception:
+            except Exception as e:
+                # Malformed span from a peer: skip it, but visibly — a
+                # systematically-broken shipper would otherwise read as
+                # an empty timeline (R7 fan-out rule).
+                from ray_tpu._private.debug import swallow
+                swallow.noted("timeline.malformed_event", e)
                 continue
         with self._lock:
             if source:

@@ -229,8 +229,9 @@ class SubscriberClient:
             for cb in cbs:
                 try:
                     cb(item["key"], item["message"])
-                except Exception:
-                    pass
+                except Exception as e:
+                    from ray_tpu._private.debug import swallow
+                    swallow.noted("wire_pubsub.subscriber", e)
         self._poll()
 
     def _retry_later(self):

@@ -10,7 +10,7 @@ front of the identical ``Raylet``/``GcsServer`` surfaces for real
 multi-process / multi-host deployments.
 """
 
-from ray_tpu.rpc.client import RpcClient, RpcError
+from ray_tpu.rpc.client import RpcClient, RpcConnectionError, RpcError
 from ray_tpu.rpc.server import RpcServer
 
-__all__ = ["RpcClient", "RpcServer", "RpcError"]
+__all__ = ["RpcClient", "RpcServer", "RpcError", "RpcConnectionError"]

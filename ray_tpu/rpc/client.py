@@ -6,6 +6,20 @@ per-peer client pools (``core_worker_client_pool.h``).  Calls are
 correlated by ``msg_id``; a background reader resolves each reply into
 its waiting future, so any number of threads can call concurrently over
 the one socket.
+
+Robustness additions (retryable_grpc_client parity):
+
+* ``rpc.send`` fault point fires before every outbound request (modes
+  drop/delay/duplicate/error, scoped per verb/peer) — the wire half of
+  the deterministic chaos plane;
+* transport failures raise :class:`RpcConnectionError` (a subclass of
+  :class:`RpcError`) so callers — and the retry loop below — can tell
+  "the wire died" from "the remote handler raised";
+* ``call`` transparently retries timeouts and connection losses for
+  verbs classified in :mod:`ray_tpu.rpc.verbs`, minting ONE dedup token
+  per logical call for non-idempotent verbs so the server's dedup
+  window collapses the retries (and any duplicate deliveries) into a
+  single side effect.
 """
 
 from __future__ import annotations
@@ -13,15 +27,37 @@ from __future__ import annotations
 import itertools
 import socket
 import threading
+import uuid
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeoutError
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ray_tpu.rpc import verbs as verbs_mod
 from ray_tpu.rpc import wire
+
+_fault_hook = None
+
+
+def _hook(point: str, **ctx):
+    """Lazy-bound fault_injection.hook: the rpc package must stay
+    importable without dragging the full ray_tpu package in at module
+    import (fault_injection imports ray_tpu.exceptions)."""
+    global _fault_hook
+    if _fault_hook is None:
+        from ray_tpu._private import fault_injection
+        _fault_hook = fault_injection.hook
+    return _fault_hook(point, **ctx)
 
 
 class RpcError(Exception):
     """Remote handler raised (payload = remote traceback) or the
     connection failed."""
+
+
+class RpcConnectionError(RpcError):
+    """Transport-level failure (send failed, connection lost, injected
+    wire fault) — the request may never have reached the handler, so a
+    classified verb is safe to retry."""
 
 
 class RpcClient:
@@ -44,33 +80,183 @@ class RpcClient:
 
     # ---- public --------------------------------------------------------
     def call(self, method: str, payload: Any = None,
-             timeout: Optional[float] = 60.0) -> Any:
-        return self.call_future(method, payload).result(timeout=timeout)
+             timeout: Optional[float] = 60.0,
+             retry: Optional[bool] = None) -> Any:
+        """Blocking call.  Verbs classified in :mod:`ray_tpu.rpc.verbs`
+        are auto-retried with backoff on timeout / connection loss
+        (``retry=False`` opts out, ``retry=True`` forces retry for an
+        unclassified verb); non-idempotent classified verbs ride a
+        dedup token shared across the retries.  A remote handler
+        exception is NEVER retried — it is deterministic."""
+        retryable = verbs_mod.is_retryable(method) if retry is None \
+            else bool(retry)
+        if not retryable:
+            return self.call_future(method, payload).result(timeout=timeout)
+        from ray_tpu._private.config import get_config
+        cfg = get_config()
+        attempts = max(1, cfg.rpc_retry_attempts)
+        backoff = cfg.rpc_retry_backoff_s
+        token = uuid.uuid4().bytes if verbs_mod.needs_dedup(method) else None
+        last_err: Optional[Exception] = None
+        for attempt in range(attempts):
+            fut = self.call_future(method, payload, dedup_token=token)
+            try:
+                return fut.result(timeout=timeout)
+            except FutureTimeoutError:
+                last_err = RpcConnectionError(
+                    f"{method} to {self.address} timed out "
+                    f"(attempt {attempt + 1}/{attempts})")
+            except RpcConnectionError as e:
+                last_err = e
+            if self._closed:
+                break
+            if attempt + 1 < attempts:
+                import time
+                time.sleep(backoff * (2 ** attempt))
+        raise last_err
 
-    def call_future(self, method: str, payload: Any = None) -> Future:
+    def call_future(self, method: str, payload: Any = None,
+                    dedup_token: Optional[bytes] = None) -> Future:
         fut: Future = Future()
         msg_id = next(self._ids)
+        if dedup_token is None and verbs_mod.needs_dedup(method):
+            # Even one-shot sends of a mutating verb carry a token:
+            # duplicate DELIVERY (a flaky wire, an armed duplicate
+            # fault) must collapse in the server's window exactly like
+            # a client retry would.
+            dedup_token = uuid.uuid4().bytes
+        msg = (msg_id, method, payload) if dedup_token is None \
+            else (msg_id, method, payload, dedup_token)
+        action = None
+        if not verbs_mod.is_control(method):
+            try:
+                action = _hook("rpc.send", verb=method,
+                               peer=f"{self.address[0]}:{self.address[1]}",
+                               peer_host=self.address[0],
+                               peer_port=self.address[1])
+            except Exception as e:
+                fut.set_exception(RpcConnectionError(
+                    f"send to {self.address} failed: {e}"))
+                return fut
+        if action == "drop":
+            # Simulated partition: the frame never leaves the process.
+            # The future stays pending — exactly what a blackholed
+            # packet looks like to the caller (timeout, not error).
+            return fut
         try:
             sock = self._ensure_connected()
             with self._lock:
                 self._pending[msg_id] = fut
-            wire.send_msg(sock, (msg_id, method, payload),
-                          lock=self._write_lock)
+            # A future completed by anything OTHER than the reader (a
+            # per-attempt timeout, most notably) would leave its entry
+            # behind for the connection's whole lifetime — during an
+            # inbound-cut partition the retrying lease path would leak
+            # one entry per attempt, unboundedly.  Popping is safe: a
+            # late reply for a popped id is simply skipped.
+            fut.add_done_callback(
+                lambda _f, _mid=msg_id: self._discard_pending(_mid))
+            wire.send_msg(sock, msg, lock=self._write_lock)
+            if action == "duplicate":
+                wire.send_msg(sock, msg, lock=self._write_lock)
         except Exception as e:
             with self._lock:
                 self._pending.pop(msg_id, None)
-            fut.set_exception(RpcError(f"send to {self.address} failed: {e}"))
+            if not fut.done():
+                fut.set_exception(RpcConnectionError(
+                    f"send to {self.address} failed: {e}"))
         return fut
 
+    def _discard_pending(self, msg_id: int):
+        with self._lock:
+            self._pending.pop(msg_id, None)
+
     def call_async(self, method: str, payload: Any,
-                   callback: Callable[[Any, Optional[Exception]], None]):
-        fut = self.call_future(method, payload)
+                   callback: Callable[[Any, Optional[Exception]], None],
+                   timeout: Optional[float] = None):
+        """Async call.  With ``timeout`` set, each attempt is bounded
+        and — for verbs classified retryable — transport failures and
+        timeouts re-send under the SAME dedup token with backoff, so a
+        partitioned peer's blackholed request cannot strand the caller
+        forever: the server's dedup window collapses a late first
+        delivery and its retries into one handler run, and a reply the
+        first attempt already produced is simply replayed.  Exhausted
+        attempts surface :class:`RpcConnectionError` to the callback
+        (lease callers convert that to a rejection and re-lease)."""
+        if timeout is None:
+            fut = self.call_future(method, payload)
 
-        def on_done(f: Future):
-            err = f.exception()
-            callback(None if err else f.result(), err)
+            def on_done(f: Future):
+                err = f.exception()
+                callback(None if err else f.result(), err)
 
-        fut.add_done_callback(on_done)
+            fut.add_done_callback(on_done)
+            return
+        from ray_tpu._private.config import get_config
+        cfg = get_config()
+        retryable = verbs_mod.is_retryable(method)
+        attempts = max(1, cfg.rpc_retry_attempts) if retryable else 1
+        backoff = cfg.rpc_retry_backoff_s
+        token = uuid.uuid4().bytes if verbs_mod.needs_dedup(method) else None
+        state = {"done": False}
+        state_lock = threading.Lock()
+
+        def finish(result, err):
+            with state_lock:
+                if state["done"]:
+                    return
+                state["done"] = True
+                timer = state.get("timer")
+            if timer is not None:
+                timer.cancel()
+            callback(result, err)
+
+        def attempt(i: int):
+            with state_lock:
+                if state["done"]:
+                    return
+            fut = self.call_future(method, payload, dedup_token=token)
+
+            def on_timeout():
+                # Racing the reader's set_result on the same future:
+                # losing the race is fine (the reply won), it just must
+                # not crash the timer thread.
+                try:
+                    fut.set_exception(RpcConnectionError(
+                        f"{method} to {self.address} timed out "
+                        f"(attempt {i + 1}/{attempts})"))
+                except Exception:
+                    pass
+
+            timer = threading.Timer(timeout, on_timeout)
+            timer.daemon = True
+            with state_lock:
+                if state["done"]:
+                    return
+                state["timer"] = timer
+            timer.start()
+
+            def on_done(f: Future):
+                timer.cancel()
+                err = f.exception()
+                if err is None:
+                    finish(f.result(), None)
+                    return
+                if isinstance(err, RpcConnectionError) and \
+                        i + 1 < attempts and not self._closed:
+                    retry = threading.Timer(backoff * (2 ** i),
+                                            attempt, args=(i + 1,))
+                    retry.daemon = True
+                    with state_lock:
+                        if state["done"]:
+                            return
+                        state["timer"] = retry
+                    retry.start()
+                    return
+                finish(None, err)
+
+            fut.add_done_callback(on_done)
+
+        attempt(0)
 
     def close(self):
         self._closed = True
@@ -121,12 +307,25 @@ class RpcClient:
                 msg_id, ok, payload = wire.recv_msg(sock)
                 with self._lock:
                     fut = self._pending.pop(msg_id, None)
-                if fut is None:
+                if fut is None or fut.done():
+                    # done(): a per-attempt timeout already failed this
+                    # future; the late reply (replayed by the server's
+                    # dedup window to the retry attempt too) is stale.
                     continue
-                if ok:
-                    fut.set_result(payload)
-                else:
-                    fut.set_exception(RpcError(str(payload)))
+                # try/except, not check-then-act: a per-attempt timeout
+                # can complete the future BETWEEN the done() check and
+                # here, and an InvalidStateError escaping this loop
+                # would kill the reader thread without failing pending
+                # futures or clearing _sock — wedging the client for
+                # good over a benign race.
+                try:
+                    if ok:
+                        fut.set_result(payload)
+                    else:
+                        fut.set_exception(RpcError(str(payload)))
+                except Exception as e:
+                    from ray_tpu._private.debug import swallow
+                    swallow.noted("rpc.reader_stale_reply", e)
         except (wire.ConnectionClosed, OSError, EOFError) as e:
             with self._lock:
                 if self._sock is sock:
@@ -134,8 +333,8 @@ class RpcClient:
                 pending, self._pending = self._pending, {}
             for fut in pending.values():
                 if not fut.done():
-                    fut.set_exception(
-                        RpcError(f"connection to {self.address} lost: {e}"))
+                    fut.set_exception(RpcConnectionError(
+                        f"connection to {self.address} lost: {e}"))
         finally:
             try:
                 sock.close()

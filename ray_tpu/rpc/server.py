@@ -12,6 +12,19 @@ instead accept ``(payload, reply_cb)`` by registering with
 ``register_async`` — the reply is sent whenever ``reply_cb(result)``
 fires, which maps 1:1 onto the runtime's callback-style surfaces
 (``Raylet.request_worker_lease(spec, reply)``).
+
+Robustness additions:
+
+* ``rpc.recv`` fault point fires before every inbound request
+  dispatches (modes drop/delay/duplicate/error, scoped per verb/peer)
+  — a dropped recv never runs the handler and never replies, exactly
+  what a blackholed packet looks like; a duplicated recv dispatches
+  the request twice (the dedup window is what must absorb it).
+* Requests carrying a client-minted dedup token (4th frame element, see
+  ``rpc.verbs``) run through a bounded server-side dedup window: the
+  handler runs ONCE per token, duplicates get the recorded reply (or
+  park until the first run replies).  This is what makes timeouts of
+  mutating verbs safely retryable.
 """
 
 from __future__ import annotations
@@ -19,9 +32,22 @@ from __future__ import annotations
 import socket
 import threading
 import traceback
+from collections import OrderedDict
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from ray_tpu.rpc import verbs as verbs_mod
 from ray_tpu.rpc import wire
+
+_fault_hook = None
+
+
+def _hook(point: str, **ctx):
+    """Lazy-bound fault_injection.hook (see rpc/client.py)."""
+    global _fault_hook
+    if _fault_hook is None:
+        from ray_tpu._private import fault_injection
+        _fault_hook = fault_injection.hook
+    return _fault_hook(point, **ctx)
 
 
 def _shutdown_close(sock: socket.socket):
@@ -33,6 +59,71 @@ def _shutdown_close(sock: socket.socket):
         sock.close()
     except OSError:
         pass
+
+
+class _DedupWindow:
+    """Bounded at-most-once window over client-minted request tokens.
+
+    One entry per token: while the first delivery's handler runs the
+    entry is PENDING and duplicate deliveries park their repliers on
+    it; once the handler replies the entry caches ``(ok, payload)`` and
+    later duplicates get the recorded reply immediately.  Bounded FIFO:
+    past ``size`` entries the oldest is evicted — a duplicate arriving
+    after eviction re-runs the handler, which is why the window must
+    comfortably exceed (in-flight requests x retry attempts), not just
+    retry depth.
+    """
+
+    def __init__(self, size: int):
+        self._size = max(8, size)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[bytes, dict]" = OrderedDict()
+        self.hits = 0      # duplicate deliveries absorbed (tests assert)
+
+    def admit(self, token: bytes, replier: Callable[[bool, Any], None]
+              ) -> bool:
+        """True -> caller runs the handler (first delivery).  False ->
+        duplicate: the recorded reply was sent (or the replier parked
+        until the first run completes)."""
+        with self._lock:
+            entry = self._entries.get(token)
+            if entry is None:
+                self._entries[token] = {"done": False, "waiters": []}
+                if len(self._entries) > self._size:
+                    # Evict oldest COMPLETED entries only.  A pending
+                    # entry's handler is still running: evicting it
+                    # would drop its parked repliers AND let a retry of
+                    # the same token re-run the mutating handler
+                    # concurrently — the double side effect the window
+                    # exists to prevent.  If everything is pending the
+                    # window grows past size (bounded by in-flight
+                    # requests) rather than break at-most-once.
+                    for tok in list(self._entries):
+                        if len(self._entries) <= self._size:
+                            break
+                        if self._entries[tok]["done"]:
+                            del self._entries[tok]
+                return True
+            self.hits += 1
+            if not entry["done"]:
+                entry["waiters"].append(replier)
+                return False
+            ok, payload = entry["ok"], entry["payload"]
+        replier(ok, payload)
+        return False
+
+    def complete(self, token: bytes, ok: bool, payload: Any) -> None:
+        with self._lock:
+            entry = self._entries.get(token)
+            if entry is None or entry["done"]:
+                waiters = []
+            else:
+                entry["done"] = True
+                entry["ok"] = ok
+                entry["payload"] = payload
+                waiters, entry["waiters"] = entry["waiters"], []
+        for w in waiters:
+            w(ok, payload)
 
 
 class RpcServer:
@@ -56,9 +147,11 @@ class RpcServer:
         # handler stuck in a long wait must not hang interpreter exit.
         from ray_tpu._private.config import get_config
         from ray_tpu._private.daemon_pool import DaemonPool
-        self._pool_size = get_config().rpc_dispatch_pool_size
+        cfg = get_config()
+        self._pool_size = cfg.rpc_dispatch_pool_size
         self._pool = DaemonPool(self._pool_size,
                                 name=f"ray_tpu::rpc::{name}::pool")
+        self.dedup_window = _DedupWindow(cfg.rpc_dedup_window_size)
         self._active = 0
         self._active_lock = threading.Lock()
         self._accept_thread = threading.Thread(
@@ -113,6 +206,10 @@ class RpcServer:
     def _reader_loop(self, conn: socket.socket):
         write_lock = threading.Lock()
         try:
+            peer = conn.getpeername()
+        except OSError:
+            peer = ("?", 0)
+        try:
             try:
                 wire.expect_preamble(conn)
             except wire.WireVersionMismatch:
@@ -121,11 +218,33 @@ class RpcServer:
                 return
             while not self._stopped.is_set():
                 try:
-                    msg_id, method, payload = wire.recv_msg(conn)
+                    msg = wire.recv_msg(conn)
                 except (wire.ConnectionClosed, OSError, EOFError):
                     return
+                msg_id, method, payload = msg[0], msg[1], msg[2]
+                token = msg[3] if len(msg) > 3 else None
+                if not verbs_mod.is_control(method):
+                    # Wire chaos point, receive side.  delay runs here
+                    # on the reader thread deliberately: a slow link
+                    # delays everything behind the frame, exactly like
+                    # real queueing.  error replies like a torn wire;
+                    # drop never dispatches (and so never replies).
+                    try:
+                        action = _hook(
+                            "rpc.recv", verb=method,
+                            peer=f"{peer[0]}:{peer[1]}",
+                            peer_host=peer[0], peer_port=peer[1])
+                    except Exception as e:
+                        self._reply(conn, write_lock, msg_id, False,
+                                    f"injected wire fault: {e}")
+                        continue
+                    if action == "drop":
+                        continue
+                    if action == "duplicate":
+                        self._submit_dispatch(conn, write_lock, msg_id,
+                                              method, payload, token)
                 self._submit_dispatch(conn, write_lock, msg_id, method,
-                                      payload)
+                                      payload, token)
         finally:
             with self._conns_lock:
                 self._conns.discard(conn)
@@ -135,7 +254,7 @@ class RpcServer:
                 pass
 
     def _submit_dispatch(self, conn, write_lock, msg_id, method,
-                         payload):
+                         payload, token=None):
         with self._active_lock:
             pooled = self._active < self._pool_size
             if pooled:
@@ -144,7 +263,7 @@ class RpcServer:
             def run():
                 try:
                     self._dispatch(conn, write_lock, msg_id, method,
-                                   payload)
+                                   payload, token)
                 finally:
                     with self._active_lock:
                         self._active -= 1
@@ -157,23 +276,34 @@ class RpcServer:
                     self._active -= 1
         threading.Thread(
             target=self._dispatch,
-            args=(conn, write_lock, msg_id, method, payload),
+            args=(conn, write_lock, msg_id, method, payload, token),
             daemon=True,
             name=f"ray_tpu::rpc::{self._name}::call").start()
 
-    def _dispatch(self, conn, write_lock, msg_id, method, payload):
+    def _dispatch(self, conn, write_lock, msg_id, method, payload,
+                  token=None):
         entry = self._handlers.get(method)
         if entry is None:
             self._reply(conn, write_lock, msg_id, False,
                         f"no such method: {method}")
             return
         handler, is_async = entry
+        if token is not None:
+            # At-most-once: duplicates (client retries, duplicated
+            # deliveries) get the first run's recorded reply.
+            def replier(ok, result, _c=conn, _wl=write_lock, _m=msg_id):
+                self._reply(_c, _wl, _m, ok, result)
+
+            if not self.dedup_window.admit(token, replier):
+                return
         if is_async:
             replied = threading.Event()
 
             def reply_cb(result):
                 if not replied.is_set():
                     replied.set()
+                    if token is not None:
+                        self.dedup_window.complete(token, True, result)
                     self._reply(conn, write_lock, msg_id, True, result)
 
             try:
@@ -181,15 +311,21 @@ class RpcServer:
             except Exception:
                 if not replied.is_set():
                     replied.set()
-                    self._reply(conn, write_lock, msg_id, False,
-                                traceback.format_exc())
+                    tb = traceback.format_exc()
+                    if token is not None:
+                        self.dedup_window.complete(token, False, tb)
+                    self._reply(conn, write_lock, msg_id, False, tb)
             return
         try:
             result = handler(payload)
         except Exception:
-            self._reply(conn, write_lock, msg_id, False,
-                        traceback.format_exc())
+            tb = traceback.format_exc()
+            if token is not None:
+                self.dedup_window.complete(token, False, tb)
+            self._reply(conn, write_lock, msg_id, False, tb)
             return
+        if token is not None:
+            self.dedup_window.complete(token, True, result)
         self._reply(conn, write_lock, msg_id, True, result)
 
     def _reply(self, conn, write_lock, msg_id, ok, payload):

@@ -1,0 +1,91 @@
+"""Retry classification of the cluster's RPC verbs.
+
+Reference analogue: gRPC method idempotency options +
+``src/ray/rpc/retryable_grpc_client`` — the reference marks which
+core-worker/raylet RPCs may be transparently retried after a transport
+failure.  Here every verb that ``RpcClient.call`` may auto-retry on a
+timeout or connection loss is classified explicitly:
+
+* **idempotent** — re-running the handler is a no-op or a pure read;
+  retries need no extra machinery (heartbeats, KV/directory reads,
+  object fetches).
+* **dedup** — the handler MUTATES state (grants a lease, registers an
+  actor/location, stores a return) so a blind retry could double the
+  side effect.  These verbs are retried under a client-minted dedup
+  token: every send of the same logical call carries the same token,
+  and the server's bounded dedup window runs the handler once and
+  replays the recorded reply to duplicates — whether the duplicate came
+  from a client retry or from duplicate DELIVERY on a flaky wire.
+
+Unclassified verbs are never auto-retried (long-polls like
+``wait_object``, delta-shipping like ``metrics_report`` whose loss
+handling is application-level, timing probes like ``clock_probe``).
+
+``_CONTROL_VERBS`` are additionally exempt from the ``rpc.send`` /
+``rpc.recv`` fault points: they are the chaos plane's own control
+channel (arming and healing a partition must work THROUGH the
+partition).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+#: Pure reads / naturally idempotent writes: retry without a token.
+IDEMPOTENT_VERBS = frozenset({
+    "ping",
+    "heartbeat",
+    "kv_get",
+    "get_locations",
+    "get_node_address",
+    "get_resource_report",
+    "fetch_object",
+    "fault_fired",
+})
+
+#: Mutating verbs: retried only under a server-side dedup window keyed
+#: by a client-minted token (lease grant/return, actor assignment and
+#: task pushes — "exactly once" side effects — registration, location
+#: rows, inline return storage, the PG 2PC edges).
+DEDUP_VERBS = frozenset({
+    "register_node",
+    "request_worker_lease",
+    "request_worker_lease_batch",
+    "return_worker",
+    "reconcile_leases",
+    "push_task",
+    "assign_actor",
+    "push_actor_task",
+    "actor_worker_died",
+    "add_location",
+    "remove_location",
+    "put_inline",
+    "prepare_bundle",
+    "commit_bundle",
+    "cancel_bundle",
+})
+
+#: The chaos plane's own control channel: exempt from rpc.send/rpc.recv
+#: fault points so a partition can always be healed through it.
+CONTROL_VERBS = frozenset({"arm_fault", "disarm_fault", "fault_fired"})
+
+
+def needs_dedup(method: str) -> bool:
+    return method in DEDUP_VERBS
+
+
+def is_retryable(method: str) -> bool:
+    return method in IDEMPOTENT_VERBS or method in DEDUP_VERBS
+
+
+def is_control(method: str) -> bool:
+    return method in CONTROL_VERBS
+
+
+def classify(method: str) -> Optional[str]:
+    """"idempotent" | "dedup" | None (never auto-retried)."""
+    if method in DEDUP_VERBS:
+        return "dedup"
+    if method in IDEMPOTENT_VERBS:
+        return "idempotent"
+    return None

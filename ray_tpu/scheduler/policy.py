@@ -102,6 +102,16 @@ def _masks(view: ClusterResourceView, req: ResourceRequest,
     feasible = (total + eps >= demand).all(axis=1)
     available = (avail + eps >= demand).all(axis=1)
 
+    # Suspect nodes (missed-beats grace) take no NEW placements at all:
+    # excluded from BOTH masks — leaving them merely unavailable would
+    # let the feasible-fallback branch still pick them.
+    masked = view.masked_nodes()
+    if masked:
+        for i, nid in enumerate(node_ids):
+            if nid in masked:
+                feasible[i] = False
+                available[i] = False
+
     # Post-placement utilization per resource, max over demanded resources.
     with np.errstate(divide="ignore", invalid="ignore"):
         used_after = np.clip(total - avail + demand, 0.0, None)

@@ -173,6 +173,13 @@ class ClusterResourceView:
         # Row indices whose availability changed since the last
         # drain_dirty() — the delta feed for the device-resident solver.
         self._dirty: set = set()
+        # SUSPECT mask (suspect-before-dead failure detection): masked
+        # nodes read as zero-available in every scheduling snapshot —
+        # no NEW placements — while the authoritative ledgers underneath
+        # stay intact, so clearing the mask restores real availability
+        # instantly.  Mask flips dirty the affected rows so the
+        # device-resident solver's delta feed tracks them too.
+        self._masked: set = set()
 
     # ---- column management ---------------------------------------------
     def _column(self, name: str) -> int:
@@ -287,32 +294,69 @@ class ClusterResourceView:
                     self._avail[idx, col] + v / FP_SCALE)
             self._dirty.add(idx)
 
+    # ---- suspect masking ------------------------------------------------
+    def set_masked(self, node_ids) -> None:
+        """Replace the suspect mask.  Affected rows (newly masked OR
+        newly cleared) are dirtied so both the snapshot consumers and
+        the device-resident delta feed converge on the new mask."""
+        with self._lock:
+            new = set(node_ids)
+            for nid in new ^ self._masked:
+                idx = self._node_index.get(nid)
+                if idx is not None:
+                    self._dirty.add(idx)
+            self._masked = new
+
+    def masked_nodes(self) -> set:
+        with self._lock:
+            return set(self._masked)
+
+    def _masked_zero(self, avail_copy: np.ndarray) -> np.ndarray:
+        """Zero masked rows in an avail COPY (callers own the copy; the
+        authoritative matrix is never touched)."""
+        for nid in self._masked:
+            idx = self._node_index.get(nid)
+            if idx is not None:
+                avail_copy[idx, :] = 0.0
+        return avail_copy
+
     # ---- dense snapshot (the device ABI) --------------------------------
     def snapshot(self):
         """Return (node_ids, total[N,R], avail[N,R], columns) — the exact
-        matrices the TPU kernel consumes."""
+        matrices the TPU kernel consumes.  Masked (suspect) rows read
+        zero-available."""
         with self._lock:
             return (list(self._node_ids), self._total.copy(),
-                    self._avail.copy(), dict(self._columns))
+                    self._masked_zero(self._avail.copy()),
+                    dict(self._columns))
 
     def snapshot_versioned(self):
         """snapshot() plus the structural version, read atomically —
         the full-upload path of the device-resident solver."""
         with self._lock:
             return (self.version, list(self._node_ids), self._total.copy(),
-                    self._avail.copy(), dict(self._columns))
+                    self._masked_zero(self._avail.copy()),
+                    dict(self._columns))
 
     def drain_dirty(self):
         """Atomically take (version, dirty row indices, their current
         avail rows) and clear the dirty set.  Rows re-dirtied by
         concurrent mutations after this call are picked up next drain —
-        values are always read fresh, so deltas never go backwards."""
+        values are always read fresh, so deltas never go backwards.
+        Masked (suspect) rows ship as zero, like the snapshots."""
         with self._lock:
             if not self._dirty:
                 return self.version, [], None
             idx = sorted(self._dirty)
             self._dirty.clear()
-            return self.version, idx, self._avail[idx, :].copy()
+            rows = self._avail[idx, :].copy()
+            if self._masked:
+                masked_idx = {self._node_index.get(nid)
+                              for nid in self._masked}
+                for j, i in enumerate(idx):
+                    if i in masked_idx:
+                        rows[j, :] = 0.0
+            return self.version, idx, rows
 
     def num_columns(self) -> int:
         with self._lock:

@@ -255,7 +255,8 @@ def cmd_list(args) -> int:
         "actors": ("actor_id", "state", "name"),
         "objects": ("object_id", "node_id", "size_bytes", "sealed",
                     "pin_count", "spilled"),
-        "nodes": ("node_id", "node_name", "state"),
+        "nodes": ("node_id", "node_name", "state", "incarnation",
+                  "fenced_rejections"),
     }[args.resource]
     print(" ".join(f"{c.upper():20}" for c in columns))
     for row in rows:
@@ -413,6 +414,19 @@ def cmd_doctor(args) -> int:
     for node, st in sorted(liveness.items()):
         print(f"  {node}: {'DEGRADED' if st.get('degraded') else 'ok'} "
               f"(wedges={st.get('wedges', 0)})")
+    membership = dump.get("membership") or {}
+    if membership:
+        print("membership (heartbeat plane):")
+        for node, st in sorted(membership.items()):
+            fenced = st.get("fenced_rejections", 0)
+            extra = ""
+            if fenced:
+                by_verb = st.get("fenced_by_verb") or {}
+                detail = " ".join(f"{v}={n}"
+                                  for v, n in sorted(by_verb.items()))
+                extra = f" fenced_rejections={fenced} ({detail})"
+            print(f"  {node}: {st.get('state'):8} "
+                  f"incarnation={st.get('incarnation', 0)}{extra}")
     _render_process_report("head", dump.get("head") or {}, args.tail)
     for node_hex, report in sorted((dump.get("nodes") or {}).items()):
         _render_process_report(f"node {node_hex}", report or {},

@@ -134,6 +134,76 @@ class TestGcsRestartLiveState:
         assert not any(key[0] == pg.id for key in head._committed_bundles), \
             "leaked bundle must be released (ReleaseUnusedBundles parity)"
 
+    def test_head_restart_during_partition_fences_not_kills(
+            self, tmp_path):
+        """GCS restart while a LIVE remote node is unreachable (its
+        outbound link is cut): the survivor set must re-adopt the node
+        under its EXISTING incarnation — not bump it (which would fence
+        every message the node sends) and not declare it dead (which
+        would restart its actors).  When the partition heals within the
+        suspect grace the node resumes cleanly: same incarnation, zero
+        fenced rejections, the task flow continues."""
+        from ray_tpu._private import fault_injection
+        cluster = Cluster(initialize_head=True,
+                          head_node_args={"num_cpus": 2},
+                          gcs_storage_path=str(tmp_path / "gcs.bin"))
+        import ray_tpu._private.config as config_mod
+        cfg = config_mod.get_config()
+        overrides = {
+            "scheduler_backend": "native",
+            "raylet_heartbeat_period_milliseconds": 50,
+            "num_heartbeats_suspect": 8,
+            "num_heartbeats_timeout": 200,   # generous death horizon
+            "gcs_resource_broadcast_period_milliseconds": 50,
+        }
+        for key, value in overrides.items():
+            setattr(cfg, key, value)
+        ray_tpu.init(_cluster=cluster)
+        try:
+            handle = cluster.add_remote_node(num_cpus=1,
+                                             resources={"spoke": 2.0})
+            nid = handle.node_id
+
+            @ray_tpu.remote(resources={"spoke": 1}, num_cpus=0)
+            def on_spoke(x):
+                return x * 3
+
+            assert ray_tpu.get(on_spoke.remote(2), timeout=30) == 6
+            inc_before = cluster.gcs.node_manager.current_incarnation(nid)
+            assert inc_before == 1
+
+            part = fault_injection.partition(
+                handle.proxy.address, outbound=True, inbound=False)
+            part.arm()
+            try:
+                time.sleep(0.3)       # the node is now unreachable
+                cluster.restart_gcs()
+                info = cluster.gcs.node_manager.get_all_node_info() \
+                    .get(nid) or {}
+                assert info.get("state") in ("ALIVE", "SUSPECT"), \
+                    "an unreachable LIVE node must not be killed by " \
+                    f"the restart reconcile: {info.get('state')}"
+                assert cluster.gcs.node_manager \
+                    .current_incarnation(nid) == inc_before, \
+                    "reconcile must preserve the survivor's incarnation"
+            finally:
+                part.heal()
+                part.close()
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                info = cluster.gcs.node_manager.get_all_node_info() \
+                    .get(nid) or {}
+                if info.get("state") == "ALIVE":
+                    break
+                time.sleep(0.05)
+            assert info.get("state") == "ALIVE"
+            assert cluster.gcs.node_manager.fenced_count(nid) == 0, \
+                "a within-grace reconnect must not be fenced"
+            assert ray_tpu.get(on_spoke.remote(5), timeout=30) == 15
+        finally:
+            fault_injection.reset()
+            ray_tpu.shutdown()
+
     def test_tasks_flow_after_restart(self, persistent_cluster):
         @ray_tpu.remote
         def double(x):

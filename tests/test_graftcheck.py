@@ -75,6 +75,7 @@ class TestRules:
         ("r4_loop_affinity.py", "R4"),
         ("r5_refcount.py", "R5"),
         ("r7_swallow.py", "R7"),
+        ("r7_fanout.py", "R7"),
         ("r8_bare_lock.py", "R8"),
     ])
     def test_fixture_trips_rule(self, fixture, rule):
@@ -381,6 +382,32 @@ class TestDestructorContextRelease:
         assert not core.reference_counter.has_reference(oid)
         raylet = global_worker().cluster.head_node
         assert not raylet.object_store.contains(oid)
+
+
+class TestR7Fanout:
+    """The fan-out extension of R7 (ISSUE 14 satellite): ``for cb in
+    listeners: try: cb(...) except: pass`` is a finding; incidental
+    per-item try/except that never CALLS the loop variable is not."""
+
+    def test_flags_both_fanout_flavors_only(self):
+        path = os.path.join(FIXTURES, "r7_fanout.py")
+        findings = [f for f in _run_on([path], select=("R7",))
+                    if f.rule == "R7"]
+        assert len(findings) == 2, findings
+        assert all(f.detail == "silent-swallow-fanout" for f in findings)
+        symbols = {f.symbol for f in findings}
+        assert symbols == {"DeathNotifier.notify",
+                           "DeathNotifier.notify_objects"}, symbols
+
+    def test_fixed_fanouts_are_clean(self):
+        """The two sites this PR routed through swallow.noted — the GCS
+        node-death listener fan-out and the raylet spilled-url record —
+        no longer trip the rule."""
+        paths = [os.path.join(REPO, "ray_tpu", "gcs", "server.py"),
+                 os.path.join(REPO, "ray_tpu", "_private", "raylet.py")]
+        findings = [f for f in _run_on(paths, select=("R7",))
+                    if f.rule == "R7"]
+        assert not findings, [f.render() for f in findings]
 
 
 class TestSwallow:

@@ -1,0 +1,311 @@
+"""Wire-level network chaos: the ``rpc.send`` / ``rpc.recv`` fault
+points (drop / delay / duplicate / error, scoped per verb and peer),
+the verb-classified retry machinery with its server-side dedup window,
+and the :class:`fault_injection.partition` helper over real node-host
+OS processes.
+
+These are the tests PR 6's harness could not express: every prior fault
+point sat above the wire (disk, dispatch, chunk assembly), so message
+loss, duplication and asymmetric partitions were untestable.  Every
+test asserts its fault actually fired — a chaos test whose fault never
+triggered proves nothing.
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import fault_injection
+from ray_tpu._private.worker import global_worker
+from ray_tpu.rpc import (RpcClient, RpcConnectionError, RpcError,
+                         RpcServer)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _fault_isolation():
+    fault_injection.reset()
+    yield
+    fault_injection.reset()
+
+
+@pytest.fixture
+def echo_server():
+    """Raw server with one idempotent-classified, one dedup-classified
+    and one unclassified verb, each counting handler executions."""
+    server = RpcServer(name="netchaos")
+    counts = {"kv_get": 0, "add_location": 0, "echo": 0}
+
+    def make(name):
+        def handler(payload):
+            counts[name] += 1
+            return {"ran": counts[name], "payload": payload}
+        return handler
+
+    for name in counts:
+        server.register(name, make(name))
+    client = RpcClient(server.address)
+    yield server, client, counts
+    client.close()
+    server.stop()
+
+
+class TestWireFaultPoints:
+    def test_send_drop_is_scoped_by_verb(self, echo_server):
+        """A dropped send never leaves the process: the caller times
+        out exactly like a blackholed packet, while other verbs to the
+        same peer flow untouched."""
+        _server, client, counts = echo_server
+        fault_injection.arm("rpc.send", "drop", count=1,
+                            match={"verb": "echo"})
+        with pytest.raises(Exception):      # unclassified: no retry
+            client.call("echo", 1, timeout=0.5)
+        assert counts["echo"] == 0, "dropped send must not dispatch"
+        assert client.call("kv_get", None)["ran"] == 1
+        assert fault_injection.fired("rpc.send") == 1
+
+    def test_send_drop_scoped_by_peer_address(self, echo_server):
+        """Peer-address scoping: a drop-set aimed at another address
+        leaves this connection alone — the primitive asymmetric
+        partitions are built from."""
+        _server, client, counts = echo_server
+        fault_injection.arm("rpc.send", "drop", count=-1,
+                            match={"peer": "10.9.9.9:1"})
+        assert client.call("echo", 1, timeout=5.0)["ran"] == 1
+        fault_injection.disarm("rpc.send")
+        host, port = client.address
+        fault_injection.arm("rpc.send", "drop", count=-1,
+                            match={"peer": f"{host}:{port}"})
+        with pytest.raises(Exception):
+            client.call("echo", 2, timeout=0.5)
+        assert counts["echo"] == 1
+
+    def test_exhausted_arming_does_not_shadow_later_armings(self):
+        """A spent count=1 verb-scoped arming must not swallow hits
+        aimed at a LATER arming on the same point — a partition armed
+        after a one-shot fault would otherwise silently test nothing."""
+        fault_injection.arm("x.shadow", "error", count=1,
+                            match={"verb": "a"})
+        with pytest.raises(fault_injection.FaultInjectedError):
+            fault_injection.hook("x.shadow", verb="a")
+        assert fault_injection.hook("x.shadow", verb="a") is None
+        fault_injection.arm("x.shadow", "drop", count=-1)
+        assert fault_injection.hook("x.shadow", verb="a") == "drop"
+        assert fault_injection.fired("x.shadow") == 2
+
+    def test_recv_delay_slows_but_delivers(self, echo_server):
+        _server, client, _counts = echo_server
+        fault_injection.arm("rpc.recv", "delay", count=1, delay_s=0.3,
+                            match={"verb": "echo"})
+        t0 = time.monotonic()
+        assert client.call("echo", "x", timeout=10.0)["payload"] == "x"
+        assert time.monotonic() - t0 >= 0.25
+        assert fault_injection.fired("rpc.recv") == 1
+
+    def test_recv_error_replies_like_a_torn_wire(self, echo_server):
+        _server, client, counts = echo_server
+        fault_injection.arm("rpc.recv", "error", count=1,
+                            match={"verb": "echo"})
+        with pytest.raises(RpcError, match="injected wire fault"):
+            client.call("echo", 1, timeout=5.0)
+        assert counts["echo"] == 0
+        # connection survives
+        assert client.call("echo", 2, timeout=5.0)["ran"] == 1
+
+
+class TestDedupWindow:
+    def test_duplicate_delivery_of_dedup_verb_runs_once(self, echo_server):
+        """An armed duplicate delivery of a token-carrying verb
+        dispatches twice but EXECUTES once: the second dispatch gets
+        the first run's recorded reply from the window."""
+        server, client, counts = echo_server
+        fault_injection.arm("rpc.recv", "duplicate", count=1,
+                            match={"verb": "add_location"})
+        reply = client.call("add_location", {"k": 1}, timeout=10.0)
+        assert reply["ran"] == 1
+        deadline = time.monotonic() + 3
+        while time.monotonic() < deadline and \
+                server.dedup_window.hits == 0:
+            time.sleep(0.01)
+        assert counts["add_location"] == 1, \
+            "duplicate delivery must not re-run a dedup-classified verb"
+        assert server.dedup_window.hits >= 1
+
+    def test_duplicate_delivery_of_unclassified_verb_runs_twice(
+            self, echo_server):
+        """Contrast case: without a token there is no window — the
+        handler really runs twice.  This is WHY mutating verbs are
+        classified."""
+        _server, client, counts = echo_server
+        fault_injection.arm("rpc.recv", "duplicate", count=1,
+                            match={"verb": "echo"})
+        client.call("echo", 1, timeout=10.0)
+        deadline = time.monotonic() + 3
+        while time.monotonic() < deadline and counts["echo"] < 2:
+            time.sleep(0.01)
+        assert counts["echo"] == 2
+
+    def test_retry_after_dropped_delivery_single_side_effect(
+            self, echo_server):
+        """The retry loop: first delivery dropped at the receiver, the
+        client times out and retries under the SAME dedup token — the
+        handler runs exactly once across both attempts."""
+        _server, client, counts = echo_server
+        fault_injection.arm("rpc.recv", "drop", count=1,
+                            match={"verb": "add_location"})
+        reply = client.call("add_location", {"k": 2}, timeout=0.5)
+        assert reply["ran"] == 1
+        assert counts["add_location"] == 1
+        assert fault_injection.fired("rpc.recv") == 1
+
+    def test_idempotent_verb_retries_through_send_error(self, echo_server):
+        _server, client, counts = echo_server
+        fault_injection.arm("rpc.send", "error", count=1,
+                            match={"verb": "kv_get"})
+        assert client.call("kv_get", None, timeout=5.0)["ran"] == 1
+        assert counts["kv_get"] == 1
+
+    def test_remote_handler_error_is_never_retried(self):
+        """A handler exception is deterministic: retrying it would just
+        double the side effect the classification exists to prevent."""
+        server = RpcServer(name="netchaos-err")
+        runs = []
+
+        def boom(_p):
+            runs.append(1)
+            raise ValueError("deterministic kaboom")
+
+        server.register("add_location", boom)
+        client = RpcClient(server.address)
+        try:
+            with pytest.raises(RpcError, match="kaboom"):
+                client.call("add_location", {}, timeout=10.0)
+            time.sleep(0.2)
+            assert len(runs) == 1
+        finally:
+            client.close()
+            server.stop()
+
+
+class TestReconnectSemantics:
+    def test_on_reconnect_fires_exactly_once_per_reconnection(self):
+        """Two connection losses -> exactly two hook firings, none on
+        the first connect (the reconcile machinery counts on this)."""
+        server = RpcServer(name="reco")
+        server.register("ping", lambda _p: "pong")
+        host, port = server.address
+        client = RpcClient((host, port))
+        fires = []
+        client.on_reconnect = lambda: fires.append(time.monotonic())
+        assert client.call("ping", None) == "pong"
+        assert fires == [], "must not fire on first connect"
+        for expected in (1, 2):
+            server.stop()
+            deadline = time.monotonic() + 5
+            while client.is_connected() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            server = RpcServer(host=host, port=port, name="reco")
+            server.register("ping", lambda _p: "pong")
+            assert client.call("ping", None, retry=True,
+                               timeout=5.0) == "pong"
+            deadline = time.monotonic() + 5
+            while len(fires) < expected and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert len(fires) == expected, (
+                f"on_reconnect must fire exactly once per reconnection "
+                f"(cycle {expected}): {fires}")
+        client.close()
+        server.stop()
+
+
+_CONFIG = {
+    "scheduler_backend": "native",
+    "raylet_heartbeat_period_milliseconds": 50,
+    "num_heartbeats_suspect": 8,
+    "num_heartbeats_timeout": 60,    # generous: these tests never want death
+    "gcs_resource_broadcast_period_milliseconds": 50,
+    # Fast lease-RPC recovery so a blackholed push bounces to the
+    # submitter's re-lease machinery within the test budget.
+    "lease_rpc_timeout_s": 0.5,
+    "rpc_retry_backoff_s": 0.05,
+}
+
+
+@pytest.fixture
+def wire_cluster():
+    ray_tpu.init(num_cpus=2, _system_config=dict(_CONFIG))
+    cluster = global_worker().cluster
+    yield cluster
+    ray_tpu.shutdown()
+
+
+class TestPartitionHelper:
+    def test_inbound_partition_stalls_pushes_heals_clean(self, wire_cluster):
+        """Asymmetric inbound cut: the node keeps heartbeating (stays
+        ALIVE) but head->node traffic blackholes, so a task aimed at it
+        stalls; healing releases it.  The fault provably fired IN the
+        node-host OS process (fault_fired over the exempt wire)."""
+        handle = wire_cluster.add_remote_node(num_cpus=1,
+                                              resources={"spoke": 2.0})
+
+        @ray_tpu.remote(resources={"spoke": 1}, num_cpus=0)
+        def on_spoke(x):
+            return x + 1
+
+        assert ray_tpu.get(on_spoke.remote(1), timeout=30) == 2
+        part = fault_injection.partition(handle.proxy.address,
+                                         outbound=False, inbound=True)
+        part.arm()
+        try:
+            ref = on_spoke.remote(10)
+            with pytest.raises(Exception):
+                ray_tpu.get(ref, timeout=1.5)
+            # Node still ALIVE: its outbound heartbeats were never cut.
+            info = wire_cluster.gcs.node_manager.get_all_node_info() \
+                .get(handle.node_id) or {}
+            assert info.get("state") in ("ALIVE", "SUSPECT")
+        finally:
+            part.heal()
+        assert ray_tpu.get(ref, timeout=60) == 11
+        fired = handle.proxy.client.call(
+            "fault_fired", {"point": "rpc.recv"}, timeout=10.0)
+        assert fired >= 1, "the partition must have provably dropped frames"
+        part.close()
+
+    def test_duplicated_reconcile_sweep_is_harmless(self, wire_cluster):
+        """A lease-reconcile sweep delivered TWICE (armed duplicate on
+        the node) must not double-release workers: reconcile_leases is
+        dedup-classified, so the second delivery replays the first
+        reply.  The dedicated actor worker survives with its state."""
+        handle = wire_cluster.add_remote_node(num_cpus=1,
+                                              resources={"spoke": 2.0})
+
+        @ray_tpu.remote(resources={"spoke": 1}, num_cpus=0)
+        class Keeper:
+            def __init__(self):
+                self.n = 0
+
+            def incr(self):
+                self.n += 1
+                return self.n
+
+        keeper = Keeper.remote()
+        assert ray_tpu.get(keeper.incr.remote(), timeout=30) == 1
+        fault_injection.arm_over_wire(
+            handle.proxy.client, "rpc.recv", "duplicate", count=1,
+            match={"verb": "reconcile_leases"})
+        handle.proxy._send_reconcile()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if handle.proxy.client.call(
+                    "fault_fired", {"point": "rpc.recv"},
+                    timeout=10.0) >= 1:
+                break
+            time.sleep(0.05)
+        assert handle.proxy.client.call(
+            "fault_fired", {"point": "rpc.recv"}, timeout=10.0) >= 1
+        # State intact across the duplicated sweep: no restart, no leak.
+        assert ray_tpu.get(keeper.incr.remote(), timeout=30) == 2

@@ -1,0 +1,350 @@
+"""Partition tolerance: suspect-before-dead failure detection and
+incarnation fencing, driven end to end through wire-level network chaos.
+
+The acceptance scenario (ISSUE 14): an asymmetric partition around a
+live node-host OS process mid-workload — the head stops hearing beats,
+moves the node SUSPECT then DEAD, the partition heals, and the zombie's
+every resurrection vector (heartbeat, metrics report, location row,
+inline return, wedge report, lease reply) is provably rejected with a
+counter at /metrics while the lost object reconstructs bit-identical
+with exactly one re-execution; the node then drains, re-registers as a
+fresh incarnation and serves work again.  A second scenario heals
+WITHIN the suspect grace and asserts zero restarts and zero
+reconstructions — a placement pause, nothing more.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import fault_injection
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.metrics_agent import get_metrics_registry
+from ray_tpu._private.worker import global_worker
+from ray_tpu.rpc import RpcClient
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _fault_isolation():
+    fault_injection.reset()
+    yield
+    fault_injection.reset()
+
+
+_FAST_DETECT = {
+    "scheduler_backend": "native",
+    "raylet_heartbeat_period_milliseconds": 50,
+    "num_heartbeats_suspect": 6,       # SUSPECT ~0.3s into a partition
+    "num_heartbeats_timeout": 24,      # DEAD at ~1.2s
+    "gcs_resource_broadcast_period_milliseconds": 50,
+    "lease_rpc_timeout_s": 1.0,
+    "rpc_retry_backoff_s": 0.05,
+}
+
+
+@pytest.fixture
+def partition_cluster():
+    ray_tpu.init(num_cpus=2, _system_config=dict(_FAST_DETECT))
+    cluster = global_worker().cluster
+    yield cluster
+    ray_tpu.shutdown()
+
+
+def _node_state(cluster, node_id):
+    info = cluster.gcs.node_manager.get_all_node_info().get(node_id) or {}
+    return info.get("state"), info.get("incarnation", 0)
+
+
+def _wait_state(cluster, node_id, want, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        state, _ = _node_state(cluster, node_id)
+        if state == want:
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _metric_value(name, **labels):
+    """Read one series out of the prometheus exposition (0.0 when the
+    series does not exist yet)."""
+    text = get_metrics_registry().render_prometheus()
+    pname = name.replace(".", "_")
+    for line in text.splitlines():
+        if not line.startswith(pname):
+            continue
+        if all(f'{k}="{v}"' in line for k, v in labels.items()):
+            try:
+                return float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                continue
+    return 0.0
+
+
+class TestZombieAcceptance:
+    def test_partition_suspect_dead_heal_fence_rebirth(
+            self, partition_cluster, tmp_path):
+        cluster = partition_cluster
+        nm = cluster.gcs.node_manager
+        handle = cluster.add_remote_node(num_cpus=1,
+                                         resources={"spoke": 2.0})
+        nid = handle.node_id
+        node_addr = handle.proxy.address
+        old_proxy = handle.proxy
+        head_addr = cluster.head_service.address
+        exec_log = str(tmp_path / "executions.log")
+
+        @ray_tpu.remote(resources={"spoke": 1}, num_cpus=0)
+        def produce(seed):
+            with open(exec_log, "a") as f:
+                f.write(f"{seed}\n")
+            rng = np.random.default_rng(seed)
+            return rng.integers(0, 255, size=256 * 1024, dtype=np.uint8)
+
+        # Mid-workload: the object lands ONLY in the spoke's store (too
+        # big to inline; deliberately never get() before the partition,
+        # which would cache a head-side copy and nothing would be lost).
+        ref = produce.remote(7)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not \
+                cluster.object_directory.get_locations(ref.object_id()):
+            time.sleep(0.05)
+        assert cluster.object_directory.get_locations(ref.object_id()), \
+            "the produced object must be directory-registered on the spoke"
+        expected = np.random.default_rng(7).integers(
+            0, 255, size=256 * 1024, dtype=np.uint8)
+
+        # -- asymmetric partition: node keeps LISTENING but its every
+        # outbound frame (heartbeats, metrics, location rows) drops.
+        part = fault_injection.partition(node_addr, outbound=True,
+                                         inbound=False)
+        part.arm()
+        assert _wait_state(cluster, nid, "SUSPECT", 10.0), \
+            "missed beats must first mark the node SUSPECT"
+        assert _wait_state(cluster, nid, "DEAD", 10.0), \
+            "the full timeout must then declare it DEAD"
+        stale_inc = nm.current_incarnation(nid)
+        assert stale_inc == 1
+
+        # -- heal.  The zombie's own chatter (heartbeat at minimum)
+        # gets fenced, which triggers drain + re-register.
+        part.heal()
+        part.close()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            state, inc = _node_state(cluster, nid)
+            if state == "ALIVE" and inc == stale_inc + 1:
+                break
+            time.sleep(0.05)
+        state, inc = _node_state(cluster, nid)
+        assert (state, inc) == ("ALIVE", stale_inc + 1), \
+            f"zombie must re-register as a fresh incarnation: {state}/{inc}"
+        assert nm.fence_rejections.get(nid, {}).get("heartbeat", 0) >= 1
+
+        # -- every OTHER resurrection vector, sent with the stale
+        # incarnation, is provably rejected (counters at /metrics).
+        probe = RpcClient(head_addr)
+        try:
+            vectors = {
+                "heartbeat": {"node_id": nid.binary(),
+                              "incarnation": stale_inc},
+                "metrics_report": {"node_id": nid.binary(),
+                                   "incarnation": stale_inc,
+                                   "snapshot": {"x": {"series": []}}},
+                "add_location": {"node_id": nid.binary(),
+                                 "incarnation": stale_inc,
+                                 "object_id": os.urandom(16), "size": 1},
+                "put_inline": {"node_id": nid.binary(),
+                               "incarnation": stale_inc,
+                               "object_id": os.urandom(16), "blob": b""},
+                "wedge_report": {"node_id": nid.binary(),
+                                 "incarnation": stale_inc,
+                                 "event": "wedge", "report": {}},
+            }
+            for verb, payload in vectors.items():
+                reply = probe.call(verb, payload, timeout=10.0,
+                                   retry=False)
+                assert isinstance(reply, dict) and reply.get("fenced"), \
+                    f"stale-incarnation {verb} must be fenced: {reply!r}"
+                assert _metric_value("ray_tpu.fencing.rejected_total",
+                                     verb=verb) >= 1, verb
+        finally:
+            probe.close()
+        # Lease-reply vector: the dead mirror was fenced at the death
+        # prune — a late grant converts to a rejection and counts.
+        assert old_proxy.fenced
+        late = {"worker_token": b"ghost-token"}
+        token = late.pop("worker_token")
+        result = dict(late)
+        assert old_proxy._fence_grant(result, token)
+        assert result.get("rejected")
+        assert _metric_value("ray_tpu.fencing.rejected_total",
+                             verb="lease_reply") >= 1
+
+        # -- the object the dead incarnation held reconstructs
+        # bit-identical via lineage, re-executing the task EXACTLY once
+        # (the dedup plane absorbs any duplicate deliveries).
+        rebuilt = ray_tpu.get(ref, timeout=60)
+        assert np.array_equal(rebuilt, expected), "must be bit-identical"
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with open(exec_log) as f:
+                runs = [ln for ln in f.read().splitlines() if ln]
+            if len(runs) >= 2:
+                break
+            time.sleep(0.05)
+        assert len(runs) == 2, \
+            f"task must re-execute exactly once, saw {len(runs)} runs"
+
+        # -- and the reborn incarnation serves fresh work.
+        fresh = ray_tpu.get(produce.remote(9), timeout=30)
+        assert fresh.shape == expected.shape
+        # State surface: list_nodes carries the evidence.
+        from ray_tpu.experimental.state.api import nodes_from_cluster
+        row = next(r for r in nodes_from_cluster(cluster)
+                   if r["node_id"] == nid.hex())
+        assert row["state"] == "ALIVE"
+        assert row["incarnation"] == stale_inc + 1
+        assert row["fenced_rejections"] >= 6
+
+
+class TestSubGraceFlap:
+    def test_flap_within_grace_zero_restarts_zero_reconstructions(
+            self, partition_cluster):
+        """Partition healed between SUSPECT and DEAD: the node returns
+        to ALIVE under the SAME incarnation, the actor keeps its state
+        (zero restarts), nothing reconstructs, nothing is fenced."""
+        cluster = partition_cluster
+        nm = cluster.gcs.node_manager
+        handle = cluster.add_remote_node(num_cpus=1,
+                                         resources={"spoke": 2.0})
+        nid = handle.node_id
+
+        @ray_tpu.remote(resources={"spoke": 1}, num_cpus=0,
+                        max_restarts=2)
+        class Stateful:
+            def __init__(self):
+                self.n = 0
+
+            def incr(self):
+                self.n += 1
+                return self.n
+
+        actor = Stateful.remote()
+        assert ray_tpu.get(actor.incr.remote(), timeout=30) == 1
+        reconstructions_before = _metric_value(
+            "ray_tpu.lineage_reconstructions")
+
+        part = fault_injection.partition(handle.proxy.address,
+                                         outbound=True, inbound=False)
+        part.arm()
+        assert _wait_state(cluster, nid, "SUSPECT", 10.0)
+        part.heal()
+        part.close()
+        assert _wait_state(cluster, nid, "ALIVE", 10.0), \
+            "a beat inside the grace must restore ALIVE"
+
+        state, inc = _node_state(cluster, nid)
+        assert inc == 1, "no re-registration: same incarnation"
+        assert nm.fenced_count(nid) == 0, "nothing may be fenced in-grace"
+        # Actor state intact -> the worker was never restarted.
+        assert ray_tpu.get(actor.incr.remote(), timeout=30) == 2
+        assert _metric_value("ray_tpu.lineage_reconstructions") == \
+            reconstructions_before, "zero reconstructions on a flap"
+
+
+class TestSuspectMasksPlacement:
+    def test_suspect_node_takes_no_new_placements(self):
+        """In-process: cut ONE node's beats (scoped node.heartbeat
+        fault), wait for SUSPECT, and assert a task needing that node
+        WAITS (masked — not placed, not failed); recovery places it."""
+        config = dict(_FAST_DETECT)
+        config["num_heartbeats_timeout"] = 2000   # suspect-only test
+        ray_tpu.init(num_cpus=2, _system_config=config)
+        try:
+            cluster = global_worker().cluster
+            node_b = cluster.add_node(num_cpus=1,
+                                      resources={"beta": 1.0})
+            assert cluster.wait_for_nodes(2)
+            fault_injection.arm(
+                "node.heartbeat", "error", count=-1,
+                match={"node": node_b.node_id.hex()[:12]})
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and \
+                    not cluster.gcs.heartbeat_manager.is_suspect(
+                        node_b.node_id):
+                time.sleep(0.02)
+            assert cluster.gcs.heartbeat_manager.is_suspect(
+                node_b.node_id)
+            # The mask propagates to every scheduling view.
+            deadline = time.monotonic() + 5
+            head_view = cluster.head_node.cluster_view
+            while time.monotonic() < deadline and \
+                    node_b.node_id not in head_view.masked_nodes():
+                time.sleep(0.02)
+            assert node_b.node_id in head_view.masked_nodes()
+
+            @ray_tpu.remote(resources={"beta": 1}, num_cpus=0)
+            def on_beta():
+                return "placed"
+
+            ref = on_beta.remote()
+            with pytest.raises(Exception):
+                ray_tpu.get(ref, timeout=0.8)   # masked: must WAIT
+            fault_injection.disarm("node.heartbeat")
+            # Beats resume -> suspect clears -> the queued task places.
+            assert ray_tpu.get(ref, timeout=30) == "placed"
+            assert not cluster.gcs.heartbeat_manager.is_suspect(
+                node_b.node_id)
+        finally:
+            ray_tpu.shutdown()
+
+
+class TestIncarnationUnit:
+    def test_minting_is_monotonic_and_fencing_checks(self):
+        from ray_tpu.gcs.pubsub import Publisher
+        from ray_tpu.gcs.storage import (GcsTableStorage,
+                                         InMemoryStoreClient)
+        from ray_tpu.gcs.server import GcsNodeManager
+        nm = GcsNodeManager(GcsTableStorage(InMemoryStoreClient()),
+                            Publisher())
+        nid = NodeID.from_random()
+        assert nm.register_node(nid, {"node_name": "a"}) == 1
+        assert nm.check_incarnation(nid, 1)
+        assert not nm.check_incarnation(nid, 0)
+        nm.on_node_death(nid, "test")
+        assert not nm.check_incarnation(nid, 1), \
+            "a dead node's incarnation is fenced"
+        assert nm.register_node(nid, {"node_name": "a"}) == 2, \
+            "re-registration moves FORWARD"
+        assert nm.check_incarnation(nid, 2)
+        assert not nm.check_incarnation(nid, 1)
+        nm.note_fenced(nid, "heartbeat")
+        nm.note_fenced(nid, "heartbeat")
+        nm.note_fenced(nid, "add_location")
+        assert nm.fenced_count(nid) == 3
+        assert nm.fence_rejections[nid] == {"heartbeat": 2,
+                                            "add_location": 1}
+
+    def test_explicit_incarnation_is_preserved(self):
+        """GCS-restart reconcile re-registers survivors WITH their
+        existing incarnation — no bump, no spurious fencing."""
+        from ray_tpu.gcs.pubsub import Publisher
+        from ray_tpu.gcs.storage import (GcsTableStorage,
+                                         InMemoryStoreClient)
+        from ray_tpu.gcs.server import GcsNodeManager
+        store = GcsTableStorage(InMemoryStoreClient())
+        nm = GcsNodeManager(store, Publisher())
+        nid = NodeID.from_random()
+        assert nm.register_node(nid, {}) == 1
+        assert nm.register_node(nid, {}, incarnation=1) == 1
+        assert nm.check_incarnation(nid, 1)
+        # A fresh manager over the same storage (GCS restart) still
+        # mints FORWARD from the durable row.
+        nm2 = GcsNodeManager(store, Publisher())
+        assert nm2.register_node(nid, {}) == 2

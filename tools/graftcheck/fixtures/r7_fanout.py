@@ -1,0 +1,36 @@
+"""R7 fixture (fan-out shape): a listener/callback fan-out loop that
+eats a subscriber's exception silently — one broken callback drops
+every future notification unseen.
+
+Never imported — parsed only by graftcheck.
+"""
+
+
+class DeathNotifier:
+    def __init__(self):
+        self._listeners = []
+
+    def notify(self, node_id):
+        for cb in list(self._listeners):
+            try:
+                cb(node_id)
+            except Exception:
+                pass               # R7 fan-out: per-listener loss, uncounted
+
+    def notify_objects(self, pairs):
+        # Attribute-call flavor: listener.on_death(...) counts too.
+        for key, listener in pairs:
+            try:
+                listener.on_death(key)
+            except Exception:
+                pass               # R7 fan-out
+
+
+def harmless_per_item_work(items, out):
+    # NOT a finding: the try body never calls the loop variable —
+    # incidental per-item work is outside the fan-out shape.
+    for item in items:
+        try:
+            out.append(int(str(item)))
+        except Exception:
+            pass

@@ -541,16 +541,14 @@ def check_pyc_orphans(paths: List[str], repo_root: str) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
-# R7 — silent swallow in pump loops.
+# R7 — silent swallow in pump loops and listener/callback fan-outs.
 
 
 def check_silent_swallow(prog: Program) -> List[Finding]:
     findings: List[Finding] = []
     for fm in prog.all_functions():
-        loops = [n for n in ast.walk(fm.node) if isinstance(n, ast.While)]
-        if not loops:
-            continue
-        for loop in loops:
+        for loop in [n for n in ast.walk(fm.node)
+                     if isinstance(n, ast.While)]:
             for node in ast.walk(loop):
                 if not isinstance(node, ast.Try):
                     continue
@@ -567,7 +565,67 @@ def check_silent_swallow(prog: Program) -> List[Finding]:
                                      "the count and first traceback "
                                      "survive"),
                             detail="silent-swallow"))
+        # Listener/callback fan-out shape: ``for cb in listeners: try:
+        # cb(...) except: pass``.  Swallowing here is per-LISTENER loss
+        # — one buggy subscriber silently stops observing node deaths /
+        # events forever (the PR-8 tombstone bug's cousin); the loop
+        # must keep fanning out, but the drop has to be counted.
+        for loop in [n for n in ast.walk(fm.node)
+                     if isinstance(n, ast.For)]:
+            targets = _loop_target_names(loop.target)
+            if not targets:
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Try):
+                    continue
+                if not _calls_any(node.body, targets):
+                    continue
+                for handler in node.handlers:
+                    if not _is_broad_handler(handler):
+                        continue
+                    if _is_silent_body(handler.body):
+                        findings.append(Finding(
+                            rule="R7", path=fm.module.path,
+                            line=handler.lineno, symbol=fm.qualname,
+                            message=("listener/callback fan-out "
+                                     "swallows exceptions silently; a "
+                                     "broken subscriber drops every "
+                                     "future notification unseen — "
+                                     "route through debug.swallow."
+                                     "noted(site, exc)"),
+                            detail="silent-swallow-fanout"))
     return findings
+
+
+def _loop_target_names(target: ast.expr) -> Set[str]:
+    """Names bound by a for-loop target (``cb`` / ``(key, cb)``)."""
+    if isinstance(target, ast.Name):
+        return {target.id}
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: Set[str] = set()
+        for el in target.elts:
+            out |= _loop_target_names(el)
+        return out
+    return set()
+
+
+def _calls_any(body: List[ast.stmt], names: Set[str]) -> bool:
+    """True when the statements CALL one of ``names`` — either directly
+    (``cb(...)``) or through an attribute (``listener.on_death(...)``);
+    that call is what makes a try/except a fan-out swallow rather than
+    incidental per-item work."""
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Name) and func.id in names:
+                return True
+            if isinstance(func, ast.Attribute) and \
+                    isinstance(func.value, ast.Name) and \
+                    func.value.id in names:
+                return True
+    return False
 
 
 def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
