@@ -215,6 +215,34 @@ def _introspection_overhead_row():
             "reason": "no introspection row in output"}
 
 
+def _profile_overhead_row():
+    """Run bench_runtime.py --profile-bench in a subprocess and return
+    the provenance-armed dispatch-latency row (the ISSUE-15 job
+    profiler's overhead bound + its end-to-end profile of the burst),
+    or a structured skip dict."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench_runtime.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, path, "--profile-bench"],
+            env=env, capture_output=True, text=True, timeout=600)
+    except subprocess.TimeoutExpired:
+        return {"skipped": True, "reason": "profile bench timed out"}
+    if proc.returncode != 0:
+        return {"skipped": True,
+                "reason": f"profile bench rc={proc.returncode}: "
+                          f"{(proc.stderr or '')[-400:]}"}
+    for line in proc.stdout.strip().splitlines():
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if row.get("metric") == "dispatch_latency_provenance_armed":
+            return row
+    return {"skipped": True, "reason": "no profile row in output"}
+
+
 def _broadcast_relay_row():
     """Run bench_runtime.py --broadcast-only in a subprocess (CPU-side
     runtime, never touches the chip) and return the parsed
@@ -441,6 +469,22 @@ def main():
             "within_10pct": (ratio is not None and ratio <= 1.10),
         }
         res["contention_summary"] = armed.get("introspection")
+
+    # Causal-profiler overhead bound (ISSUE 15): provenance capture
+    # armed vs off on the same dispatch burst, plus the armed arm's
+    # critical-path profile of its own burst (the end-to-end proof).
+    prov = _profile_overhead_row()
+    if prov.get("skipped"):
+        res["provenance_overhead"] = prov
+    else:
+        print(json.dumps(prov))
+        res["provenance_overhead"] = {
+            "armed_p99_ms": prov["value"],
+            "off_p99_ms": prov.get("off_p99_ms"),
+            "ratio": prov.get("ratio"),
+            "within_10pct": prov.get("within_10pct"),
+        }
+        res["job_profile_summary"] = prov.get("profile")
     print(json.dumps(res))
 
 

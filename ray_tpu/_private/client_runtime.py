@@ -178,9 +178,27 @@ class ClientCoreWorker:
                     out.append(TaskArg(is_inline=True, value=s))
         return out, dep_ids, holders, borrowed
 
+    def _inject_trace_ctx(self, spec) -> None:
+        """Stamp ``TaskSpec.trace_ctx`` exactly like the in-process
+        submit path does (core_worker.py submit_task) — WITHOUT this, a
+        nested ``.remote`` from inside a process-mode worker (or any
+        ray-client driver) started a fresh trace and the driver →
+        actor-method → nested-task chain broke at the process boundary.
+        ``force`` when a parent context exists: the enclosing execute
+        span is force-recorded in workers that never enabled capture,
+        and the submit hop must be too."""
+        from ray_tpu.util import tracing
+        parent = tracing.current_context()
+        with tracing.span(f"submit:{spec.function_name}",
+                          category="submit", parent=parent,
+                          force=bool(parent),
+                          task_id=spec.task_id.hex()) as sp:
+            spec.trace_ctx = sp.context()
+
     def submit_task(self, spec, holders=()) -> List[ObjectRef]:
         # worker_id scopes the host-side pin on the RESULT objects to
         # this client (released with the client, like put pins).
+        self._inject_trace_ctx(spec)
         self._client.call("submit_task",
                           {"spec": spec,
                            "worker_id": self.client_worker_id},
@@ -191,6 +209,7 @@ class ClientCoreWorker:
                 for oid in spec.return_ids]
 
     def submit_actor_task(self, spec, holders=()) -> List[ObjectRef]:
+        self._inject_trace_ctx(spec)
         self._client.call("submit_actor_task",
                           {"spec": spec,
                            "worker_id": self.client_worker_id},

@@ -271,6 +271,27 @@ class Config:
     #: Enable OpenTelemetry-style span capture (tracing_helper.py parity).
     tracing_enabled: bool = False
 
+    # ------ causal job profiler (gcs/job_graph.py) ------
+    #: Arms provenance capture end-to-end: parent/arg-ids stamped onto
+    #: submit-side task events, terminal records copied into the per-job
+    #: graph store, and object-plane spans (transfer/spill/restore)
+    #: force-recorded so `ray-tpu profile` can attribute edge time.
+    #: Off = the pre-profiler pipeline, byte-for-byte (the bench's
+    #: armed-vs-off overhead row toggles exactly this).
+    job_profiler_enabled: bool = True
+    #: Bounded graph store: jobs tracked (LRU-evicted beyond this)...
+    job_graph_max_jobs: int = 16
+    #: ...and terminal task records kept per job (oldest-first evicted;
+    #: the profile reports the eviction count as a coverage caveat).
+    job_graph_max_tasks: int = 20_000
+
+    # ------ heartbeat-channel shipping budget ------
+    #: Per-heartbeat-ship-window byte budget for the node-side timeline
+    #: span shipper (unused budget carries over, capped at 4 windows):
+    #: bounds observability's share of the heartbeat channel so a span
+    #: storm cannot congest the control plane at 64-node scale.
+    timeline_ship_budget_bytes: int = 262_144
+
     # ------ introspection plane (flight recorder / watchdog) ------
     #: Always-on per-process decision ring (debug.flight_recorder):
     #: scheduler tick summaries, lease-batch vectors, transfer source

@@ -470,6 +470,21 @@ class CoreWorker:
                     out.append(TaskArg(is_inline=True, value=s))
         return out, dep_ids, holders, borrowed
 
+    def _provenance(self, spec: TaskSpec) -> Dict[str, Any]:
+        """Submit-side provenance for the task event: the parent task id
+        and the non-inline arg object ids (the DAG edges `ray-tpu
+        profile` reconstructs).  Empty when the profiler is off — the
+        event payload stays byte-identical to the pre-profiler wire."""
+        if not get_config().job_profiler_enabled:
+            return {}
+        out: Dict[str, Any] = {}
+        if spec.parent_task_id is not None:
+            out["parent_task_id"] = spec.parent_task_id.hex()
+        args = spec.arg_object_ids()
+        if args:
+            out["arg_object_ids"] = [oid.hex() for oid in args]
+        return out
+
     def submit_task(self, spec: TaskSpec, holders=()) -> List[ObjectRef]:
         from ray_tpu.gcs import task_events
         from ray_tpu.util import tracing
@@ -480,11 +495,17 @@ class CoreWorker:
                          task_events.PENDING_ARGS_AVAIL,
                          name=spec.function_name,
                          job_id=spec.job_id.hex(),
-                         task_type=spec.task_type)
+                         task_type=spec.task_type,
+                         **self._provenance(spec))
+        # A spec arriving WITH a trace context (a ray-client submission
+        # whose driver-side span already stamped it) continues that
+        # trace: its ctx is the parent, and ``force`` records the hop
+        # even when this process never enabled capture itself.
         with tracing.span(f"submit:{spec.function_name}",
-                          category="submit",
+                          category="submit", parent=spec.trace_ctx,
+                          force=bool(spec.trace_ctx),
                           task_id=spec.task_id.hex()) as sp:
-            spec.trace_ctx = sp.context()
+            spec.trace_ctx = sp.context() or spec.trace_ctx
             self.task_submitter.submit(spec)
         return [ObjectRef(oid, owner_id=self.worker_id)
                 for oid in spec.return_ids]
@@ -499,11 +520,13 @@ class CoreWorker:
                          task_events.PENDING_ARGS_AVAIL,
                          name=spec.function_name,
                          job_id=spec.job_id.hex(),
-                         task_type=spec.task_type)
+                         task_type=spec.task_type,
+                         **self._provenance(spec))
         with tracing.span(f"submit:{spec.function_name}",
-                          category="submit",
+                          category="submit", parent=spec.trace_ctx,
+                          force=bool(spec.trace_ctx),
                           task_id=spec.task_id.hex()) as sp:
-            spec.trace_ctx = sp.context()
+            spec.trace_ctx = sp.context() or spec.trace_ctx
             self.actor_submitter.submit(spec)
         return [ObjectRef(oid, owner_id=self.worker_id)
                 for oid in spec.return_ids]

@@ -91,9 +91,21 @@ def register_operator_handlers(cluster, job_manager):
             })
         return out
 
-    def handle_timeline(_payload):
+    def handle_timeline(payload):
         from ray_tpu.gcs.timeline import merged_timeline
-        return merged_timeline(cluster)
+        payload = payload or {}
+        return merged_timeline(
+            cluster, job=payload.get("job"),
+            critical_path=bool(payload.get("critical_path")))
+
+    def handle_profile(payload):
+        """Causal job profile (`ray-tpu profile <job>`): critical-path
+        walk of the job's task DAG with stage/node/edge attribution."""
+        from ray_tpu.experimental.state import api as state_api
+        payload = payload or {}
+        return state_api.profile_job_from_cluster(
+            cluster, payload.get("job"),
+            top_k=int(payload.get("top_k", 3)))
 
     def handle_latency(_payload):
         """Dispatch-latency decomposition (`ray-tpu latency`)."""
@@ -123,6 +135,7 @@ def register_operator_handlers(cluster, job_manager):
 
     server.register("memory_summary", handle_memory_summary)
     server.register("timeline_dump", handle_timeline)
+    server.register("profile_job", handle_profile)
     server.register("latency_summary", handle_latency)
     server.register("state_list", handle_state_list)
     server.register("state_summary", handle_state_summary)

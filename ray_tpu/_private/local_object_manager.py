@@ -115,8 +115,14 @@ class LocalObjectManager:
         from ray_tpu.util import tracing
         try:
             fault_injection.hook("spill.write")
+            # Spilled-object ids ride the span (bounded) so the job
+            # profiler can attribute spill time to the DAG edges that
+            # consumed those objects; force-recorded when armed.
             with tracing.span("object.spill", category="spill",
-                              objects=len(batch)), \
+                              objects=len(batch),
+                              force=get_config().job_profiler_enabled,
+                              object_ids=[oid.hex() for oid, _e, _s
+                                          in batch[:64]]), \
                     open(path, "wb") as f:
                 for object_id, entry, source in batch:
                     if isinstance(source, memoryview):

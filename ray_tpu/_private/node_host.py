@@ -110,6 +110,114 @@ class _ClockSync:
             self._inflight = False
 
 
+class _TimelineShipper:
+    """Beat-budgeted timeline span shipper (ROADMAP item 1: heartbeat-
+    channel congestion is a 64-node scale blocker — make observability's
+    share of the channel measurable AND bounded).
+
+    Each ship window grants ``timeline_ship_budget_bytes`` of budget;
+    unused budget carries over (capped at ``_CARRYOVER_WINDOWS``
+    windows) so a quiet node can absorb a later burst without ever
+    exceeding the long-run byte rate.  Spans past the budget stay in a
+    bounded pending queue for the next beat; queue overflow drops the
+    OLDEST spans and counts them into the batch's drop counter (loss
+    explicit, task-event-buffer semantics).  Every shipped batch's
+    payload bytes are recorded as ``ray_tpu_heartbeat_payload_bytes``
+    (kind="timeline")."""
+
+    _CARRYOVER_WINDOWS = 4
+    _PENDING_CAP = 50_000
+
+    def __init__(self, publish, source: str, node_hex: str, offset_fn):
+        from collections import deque
+        self._publish = publish
+        self._source = source
+        self._node_hex = node_hex
+        self._offset_fn = offset_fn
+        self._pending = deque()
+        self._budget = 0.0
+        self.dropped = 0          # shipper-side queue overflow, cumulative
+        self.shipped_bytes = 0
+        self.shipped_batches = 0
+
+    def _drain_into_pending(self):
+        from ray_tpu.util import tracing
+        if not tracing.num_buffered():
+            return
+        events = tracing.drain()
+        self._pending.extend(events)
+        overflow = len(self._pending) - self._PENDING_CAP
+        for _ in range(max(0, overflow)):
+            self._pending.popleft()
+            self.dropped += 1
+
+    def ship(self) -> int:
+        """One beat: refresh the budget, ship the prefix of pending
+        spans that fits, return the bytes shipped."""
+        import pickle
+
+        from ray_tpu._private.config import get_config
+        from ray_tpu._private.metrics_agent import record_internal
+        from ray_tpu.util import tracing
+        per_beat = max(1, int(get_config().timeline_ship_budget_bytes))
+        self._budget = min(self._budget + per_beat,
+                           per_beat * self._CARRYOVER_WINDOWS)
+        self._drain_into_pending()
+        if not self._pending:
+            return 0
+        if self._budget <= 0:
+            # Repaying debt from an oversized single-span ship: skip
+            # this window so the LONG-RUN byte rate stays bounded (the
+            # progress guarantee below would otherwise overshoot the
+            # budget forever on a stream of oversized spans).
+            return 0
+        batch, size = [], 0
+        while self._pending:
+            ev = self._pending[0]
+            try:
+                ev_size = len(pickle.dumps(ev, protocol=4)) + 16
+            except Exception:
+                self._pending.popleft()     # unpicklable span: drop it
+                self.dropped += 1
+                continue
+            # Progress guarantee: a single span larger than the whole
+            # budget still ships (alone) rather than wedging the queue.
+            if batch and size + ev_size > self._budget:
+                break
+            self._pending.popleft()
+            batch.append(ev)
+            size += ev_size
+        if not batch:
+            return 0
+        from ray_tpu.gcs.pubsub import TIMELINE_CHANNEL
+        try:
+            self._publish(
+                TIMELINE_CHANNEL, b"",
+                {"source": self._source,
+                 "node_id": self._node_hex,
+                 "clock_offset_us": self._offset_fn() * 1e6,
+                 "dropped": tracing.dropped_count() + self.dropped,
+                 "events": batch})
+        except Exception:
+            # Failed publish: the spans go BACK to the queue head (the
+            # budget was not charged, the next beat retries) — popping
+            # them before a flaky send would be silent loss, the exact
+            # failure mode this class's accounting exists to prevent.
+            self._pending.extendleft(reversed(batch))
+            raise
+        # No zero-clamp: an oversized span drives the budget negative
+        # (debt), and later windows pay it down before shipping again.
+        self._budget -= size
+        self.shipped_bytes += size
+        self.shipped_batches += 1
+        record_internal("ray_tpu.heartbeat.payload_bytes", size,
+                        mtype="counter", kind="timeline",
+                        node=self._node_hex)
+        record_internal("ray_tpu.timeline.ship_backlog_events",
+                        len(self._pending), node=self._node_hex)
+        return size
+
+
 class _RemoteActorManager:
     def __init__(self, host: "NodeHost"):
         self._host = host
@@ -674,6 +782,7 @@ class NodeHost:
         self._metrics_shipper = MetricsDeltaShipper()
         self._last_metrics_ship = 0.0
         self._last_timeline_ship = 0.0
+        self._timeline_shipper: Optional[_TimelineShipper] = None
         self.adapter = _RemoteClusterAdapter(self)
         store_bytes = resources.get("object_store_memory")
         self.raylet = Raylet(
@@ -1040,26 +1149,45 @@ class NodeHost:
                     if err is not None or result is not True:
                         self._metrics_shipper.force_full()
 
+                payload = self.stamp(
+                    {"node_id": self.raylet.node_id.binary(),
+                     "snapshot": delta, "full": full})
+                # Heartbeat-channel telemetry (ROADMAP item 1): what
+                # does each observability kind cost per beat in bytes?
+                # Sized on the delta payload itself — the dominant
+                # term; framing overhead is constant per RPC.  This IS
+                # a second pickle of the delta (the RPC layer has no
+                # frame-size hook), accepted because the metrics beat
+                # runs at metrics_report_interval_ms cadence (2s
+                # default) with steady-state deltas of a few KB — not
+                # a per-task path.
+                try:
+                    import pickle
+
+                    from ray_tpu._private.metrics_agent import \
+                        record_internal
+                    record_internal(
+                        "ray_tpu.heartbeat.payload_bytes",
+                        len(pickle.dumps(payload, protocol=4)),
+                        mtype="counter", kind="metrics",
+                        node=self.raylet.node_id.hex()[:12])
+                except Exception as e:
+                    swallow.noted("node_host.payload_telemetry", e)
                 self.client.call_async(
-                    "metrics_report",
-                    self.stamp({"node_id": self.raylet.node_id.binary(),
-                                "snapshot": delta, "full": full}),
+                    "metrics_report", payload,
                     self.fence_watch(on_report))
         if now - self._last_timeline_ship >= 0.5:
             self._last_timeline_ship = now
-            from ray_tpu.util import tracing
-            if tracing.num_buffered():
-                events = tracing.drain()
-                if events:
-                    from ray_tpu.gcs.pubsub import TIMELINE_CHANNEL
-                    self.adapter.gcs.publisher.publish(
-                        TIMELINE_CHANNEL, b"",
-                        {"source": self._timeline_source,
-                         "node_id": self.raylet.node_id.hex()[:12],
-                         "clock_offset_us":
-                             self.clock_sync.offset_s * 1e6,
-                         "dropped": tracing.dropped_count(),
-                         "events": events})
+            if self._timeline_shipper is None:
+                self._timeline_shipper = _TimelineShipper(
+                    self.adapter.gcs.publisher.publish,
+                    self._timeline_source,
+                    self.raylet.node_id.hex()[:12],
+                    lambda: self.clock_sync.offset_s)
+            try:
+                self._timeline_shipper.ship()
+            except Exception as e:
+                swallow.noted("node_host.timeline_ship", e)
 
     @property
     def _timeline_source(self) -> str:

@@ -613,10 +613,21 @@ class NodeObjectManager:
         if source is None:
             return False            # source died; caller tries others
         from ray_tpu.util import tracing
+        from ray_tpu._private import worker_context
+        # The consuming task, when this pull runs on an executor thread
+        # materializing args (the critical-path engine's edge
+        # attribution); pulls from pump threads carry no task.
+        ctx_spec = worker_context.current_task_spec()
         transfer_span = tracing.span(
             "object.transfer", category="transfer",
+            # Force-recorded when the profiler is armed: `ray-tpu
+            # profile` needs edge-transfer time even when full tracing
+            # is off (the span ring is bounded either way).
+            force=get_config().job_profiler_enabled,
             node=self._raylet.node_id.hex()[:12],
-            source=node_id.hex()[:12])
+            source=node_id.hex()[:12],
+            object_id=object_id.hex(),
+            task_id=ctx_spec.task_id.hex() if ctx_spec is not None else "")
         transfer_span.__enter__()
         t0 = time.monotonic()
         reader = source.object_store

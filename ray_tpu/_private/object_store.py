@@ -1213,8 +1213,15 @@ class NodeObjectStore:
         from ray_tpu.util import tracing
         path, offset, size = _parse_spill_url(e.spilled_path)
         fault_injection.hook("restore.read")
+        from ray_tpu._private.config import get_config as _get_config
+        from ray_tpu._private import worker_context
+        _ctx = worker_context.current_task_spec()
         with tracing.span("object.restore", category="spill",
-                          bytes=size), open(path, "rb") as f:
+                          bytes=size, object_id=object_id.hex(),
+                          task_id=(_ctx.task_id.hex()
+                                   if _ctx is not None else ""),
+                          force=_get_config().job_profiler_enabled), \
+                open(path, "rb") as f:
             f.seek(offset)
             blob = f.read(size)
         e.data = SerializedObject.from_bytes(blob)

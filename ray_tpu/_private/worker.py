@@ -354,10 +354,12 @@ def available_resources() -> dict:
     return w.cluster.gcs.resource_manager.live_available_resources()
 
 
-def timeline() -> list:
+def timeline(job=None, critical_path: bool = False) -> list:
     """Merged chrome://tracing dump for the whole cluster: this
     process's spans plus clock-normalized span batches every remote
-    daemon shipped to the GCS timeline store."""
+    daemon shipped to the GCS timeline store.  ``job`` filters to one
+    job's spans; ``critical_path`` overlays that job's critical path
+    as flow events (``ray-tpu profile`` in trace form)."""
     w = _require_connected()
     from ray_tpu.gcs.timeline import merged_timeline
-    return merged_timeline(w.cluster)
+    return merged_timeline(w.cluster, job=job, critical_path=critical_path)

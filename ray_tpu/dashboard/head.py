@@ -12,6 +12,8 @@ scope; this serves the same data as JSON for tools and humans:
                                 pipeline; ?state= ?name= ?limit= filters)
     GET /api/tasks/summary      per-function rollup + loss accounting
     GET /api/latency            task-dispatch latency by stage (p50/p99)
+    GET /api/profile            critical-path job profile (?job_id=,
+                                ?top_k= — stage/node/edge attribution)
     GET /api/placement_groups   PG table (state, bundles)
     GET /api/jobs               job submissions (when a JobManager runs)
     GET /metrics                Prometheus text exposition
@@ -88,6 +90,16 @@ class Dashboard:
             mgr = flushed_manager(self._cluster.gcs)
             self._send_json(req, mgr.latency_summary()
                             if mgr is not None else {})
+        elif path == "/api/profile":
+            from ray_tpu.experimental.state.api import \
+                profile_job_from_cluster
+            try:
+                top_k = int(params.get("top_k", 3))
+            except ValueError:
+                top_k = 3
+            self._send_json(req, profile_job_from_cluster(
+                self._cluster, params.get("job_id") or params.get("job"),
+                top_k=top_k))
         elif path == "/api/placement_groups":
             self._send_json(req, self._cluster.gcs
                             .placement_group_manager.table())
@@ -184,7 +196,7 @@ class Dashboard:
             "<table border=1><tr><th>node</th><th>state</th>"
             "<th>resources</th></tr>" + rows + "</table>"
             "<p>endpoints: /api/cluster /api/nodes /api/actors "
-            "/api/tasks /api/tasks/summary /api/latency "
+            "/api/tasks /api/tasks/summary /api/latency /api/profile "
             "/api/placement_groups /api/jobs /metrics</p>"
             "</body></html>")
 

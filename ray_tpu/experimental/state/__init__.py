@@ -1,12 +1,14 @@
 """State API (reference ``ray.experimental.state.api``): list and
 summarize cluster entities — tasks (from the task-event pipeline,
 ``gcs/task_events.py``), actors, objects and nodes — with filters and
-pagination.  The CLI (``ray-tpu list/summary``) and the dashboard's
-``/api/tasks`` route are thin wrappers over this module."""
+pagination, plus the causal job profiler (``profile_job``,
+``gcs/job_graph.py``).  The CLI (``ray-tpu list/summary/profile``) and
+the dashboard's ``/api/tasks`` + ``/api/profile`` routes are thin
+wrappers over this module."""
 
 from ray_tpu.experimental.state.api import (  # noqa: F401
     StateApiError, list_actors, list_nodes, list_objects, list_tasks,
-    summarize_tasks)
+    profile_job, summarize_tasks)
 
 __all__ = ["list_tasks", "list_actors", "list_objects", "list_nodes",
-           "summarize_tasks", "StateApiError"]
+           "summarize_tasks", "profile_job", "StateApiError"]

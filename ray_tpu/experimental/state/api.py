@@ -105,7 +105,23 @@ def summarize_tasks_from_cluster(cluster) -> dict:
         # — the BASELINE.json north-star p99).
         "dispatch_latency": (mgr.latency_summary()
                              if mgr is not None else {}),
+        # Causal layer: per-job graph-store accounting (task/finished
+        # counts, wall-clock, eviction counters) — the jobs `ray-tpu
+        # profile` can answer for.
+        "job_graphs": (mgr.job_graphs.summary()
+                       if mgr is not None else {}),
     }
+
+
+def profile_job_from_cluster(cluster, job: Optional[str] = None,
+                             top_k: int = 3) -> dict:
+    """Critical-path profile of one job (gcs/job_graph.py): walks the
+    completed job's task DAG backward from its last-finishing task,
+    attributing wall-clock per stage / node / object edge.  ``job`` is
+    a job id hex (or unique prefix), or None/"last" for the most
+    recently updated job."""
+    from ray_tpu.gcs.job_graph import profile_job as _profile
+    return _profile(cluster, job, top_k=top_k)
 
 
 def actors_from_cluster(cluster, filters=None, limit: Optional[int] = None,
@@ -202,3 +218,9 @@ def summarize_tasks() -> dict:
     """Per-function rollup: counts by state, mean/total duration, plus
     the pipeline's loss accounting (drop/eviction counters)."""
     return summarize_tasks_from_cluster(_require_cluster())
+
+
+def profile_job(job: Optional[str] = None, top_k: int = 3) -> dict:
+    """Driver-side critical-path profile (``ray-tpu profile`` parity):
+    stage/node/edge attribution along the job's dependency chain."""
+    return profile_job_from_cluster(_require_cluster(), job, top_k)

@@ -24,6 +24,20 @@ flushed batch carries the cumulative drop counter, and the GCS-side
 manager bounds tracked tasks (oldest finished evicted first) with its
 own eviction counter.  Observability must never become the memory leak
 it is meant to find.
+
+Provenance fields (the causal layer, ISSUE 15): the submit-side
+``PENDING_ARGS_AVAIL`` event additionally carries ``parent`` (the
+submitting task's id) and ``args`` (the non-inline arg ``ObjectRef``
+ids, stamped from the ``TaskSpec`` in ``core_worker`` at submit).
+Because object ids embed their creating task id (``ObjectID.FromIndex``
+scheme), those two fields are enough for the head to reconstruct the
+per-job task DAG with object edges — folded per record as
+``parent_task_id`` / ``arg_object_ids`` and, at each task's terminal
+transition, copied into the bounded per-job :class:`JobGraphStore`
+(``gcs/job_graph.py``) that backs ``ray-tpu profile``.  Per-record
+per-stage durations (``stages``) are kept alongside so the
+critical-path engine can attribute wall-clock without re-deriving
+stage math.
 """
 
 from __future__ import annotations
@@ -133,7 +147,8 @@ class TaskEventBuffer:
     def emit(self, task_id, state: str, *, name: str = "",
              job_id: str = "", task_type: str = "NORMAL_TASK",
              node_id: str = "", worker_id: str = "", attempt: int = 0,
-             error: Optional[str] = None) -> None:
+             error: Optional[str] = None, parent_task_id: str = "",
+             arg_object_ids: Optional[Sequence[str]] = None) -> None:
         tid = task_id.hex() if hasattr(task_id, "hex") else str(task_id)
         ts = time.time()
         if self._ts_offset is not None:
@@ -156,6 +171,12 @@ class TaskEventBuffer:
             ev["attempt"] = attempt
         if error is not None:
             ev["error"] = str(error)[:500]
+        # Provenance (submit-side only; a few dozen bytes per task —
+        # the DAG reconstruction the profiler runs on).
+        if parent_task_id:
+            ev["parent"] = parent_task_id
+        if arg_object_ids:
+            ev["args"] = list(arg_object_ids)
         flush_now = False
         start_flusher = False
         inline_flush = False
@@ -253,8 +274,14 @@ class TaskEventManager:
     worker placement, ordered transition history)."""
 
     def __init__(self, publisher, max_tasks: int = 10_000):
+        from ray_tpu.gcs.job_graph import JobGraphStore
         self._lock = diag_lock("TaskEventManager._lock")
         self._max_tasks = max_tasks
+        #: Per-job provenance DAG (terminal records copied in at each
+        #: task's terminal transition, bounded + LRU-evicted by job) —
+        #: the store ``ray-tpu profile`` walks.  Fed from this ingest,
+        #: no new channel.
+        self.job_graphs = JobGraphStore()
         self._records: "OrderedDict[str, dict]" = OrderedDict()
         # Terminal-record index (insertion order): O(1) eviction even
         # when ingest runs synchronously on the emitter's flush path.
@@ -296,6 +323,8 @@ class TaskEventManager:
                    "worker_id": "", "attempt": 0, "state_ts": {},
                    "events": [], "error": None,
                    "start_time": ev["ts"], "end_time": None,
+                   "parent_task_id": "", "arg_object_ids": [],
+                   "stages": {},
                    "_observed_stages": set(), "_seen_states": set()}
             self._records[tid] = rec
         state, ts = ev["state"], ev["ts"]
@@ -310,6 +339,7 @@ class TaskEventManager:
             # measured again for the new attempt.
             rec["_observed_stages"] = set()
             rec["_seen_states"] = set()
+            rec["stages"] = {}
         # First arrival per state per attempt wins: a straggling
         # duplicate from another buffer must not overwrite the anchor a
         # later stage will be measured against (last-wins would poison
@@ -325,6 +355,11 @@ class TaskEventManager:
                 rec[key] = ev[key]
         if ev.get("type"):
             rec["type"] = ev["type"]
+        # Provenance rides the submit event; fold it once per record.
+        if ev.get("parent"):
+            rec["parent_task_id"] = ev["parent"]
+        if ev.get("args"):
+            rec["arg_object_ids"] = list(ev["args"])
         is_retry = ev.get("attempt", 0) > rec["attempt"]
         if is_retry:
             rec["attempt"] = ev["attempt"]
@@ -350,6 +385,13 @@ class TaskEventManager:
                 rec["state"] is None or
                 STATE_ORDER.index(state) >= STATE_ORDER.index(rec["state"])):
             rec["state"] = state
+        # Job-graph feed: UPSERT the record into the per-job DAG store
+        # whenever it is terminal — not only on the terminal event
+        # itself, because cross-buffer straggler states (a node-side
+        # RUNNING landing after the owner's FINISHED) complete stage
+        # durations the profiler needs after the first terminal fold.
+        if rec["state"] in TERMINAL_STATES:
+            self.job_graphs.note_terminal(rec)
 
     def _observe_stages(self, rec: dict) -> None:
         """Fold the record's current state_ts into the dispatch-latency
@@ -390,6 +432,10 @@ class TaskEventManager:
             return
         from ray_tpu._private.metrics_agent import observe_internal
         for stage, dt in pairs:
+            # Kept on the record too: the critical-path engine
+            # attributes each path task's wall-clock by stage without
+            # re-deriving the decomposition.
+            rec["stages"][stage] = dt
             window = self._stage_samples.get(stage)
             if window is None:
                 window = self._stage_samples[stage] = self._stage_deque()
@@ -447,6 +493,8 @@ class TaskEventManager:
         row.pop("_observed_stages", None)   # ingest-internal bookkeeping
         row.pop("_seen_states", None)
         row["state_ts"] = dict(rec["state_ts"])
+        row["stages"] = dict(rec["stages"])
+        row["arg_object_ids"] = list(rec["arg_object_ids"])
         row["events"] = sorted(rec["events"], key=lambda e: e[1])
         start, end = row["start_time"], row["end_time"]
         row["duration_s"] = (end - start) if end is not None else None

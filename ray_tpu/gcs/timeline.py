@@ -122,16 +122,91 @@ class TimelineStore:
             return sum(self._source_dropped.values())
 
 
-def merged_timeline(cluster) -> List[dict]:
+def merged_timeline(cluster, job: Optional[str] = None,
+                    critical_path: bool = False) -> List[dict]:
     """One chrome://tracing event list for the whole cluster: this
     process's local tracing buffer (head clock — the reference frame)
     merged with the GCS store's normalized remote spans, in timestamp
-    order."""
+    order.
+
+    ``job`` filters the dump to one job's spans (``ray-tpu timeline
+    --job``): events tagged with a task id belonging to the job's
+    graph/records, an object id produced by one of its tasks, or the
+    job id itself.  ``critical_path`` additionally overlays the job's
+    critical path as flow events so the bottleneck chain is visually
+    traceable in Perfetto."""
     from ray_tpu.util import tracing
     events = list(tracing.chrome_tracing_dump())
     store: Optional[TimelineStore] = getattr(
         getattr(cluster, "gcs", None), "timeline_store", None)
     if store is not None:
         events.extend(store.events())
+    if job:
+        events = _filter_job(cluster, events, job)
+        if critical_path:
+            from ray_tpu.gcs.job_graph import (critical_path_flow_events,
+                                               profile_job)
+            # The filtered dump already holds the job's object-plane
+            # spans and execute slices: hand it to the profiler instead
+            # of re-merging the whole cluster timeline.
+            profile = profile_job(cluster, job, events=events)
+            if not profile.get("error"):
+                events.extend(critical_path_flow_events(profile, events))
     events.sort(key=lambda e: e.get("ts", 0.0))
     return events
+
+
+def _filter_job(cluster, events: List[dict], job: str) -> List[dict]:
+    """Events belonging to one job (plumbed through
+    ``GlobalState.chrome_tracing_dump``): membership is decided by the
+    task-id set from the job-graph store (terminal tasks) plus the live
+    task-event records (in-flight tasks), so a mid-run dump still
+    resolves."""
+    from ray_tpu.gcs.task_events import flushed_manager
+    mgr = flushed_manager(getattr(cluster, "gcs", None))
+    task_ids: set = set()
+    job_id = job
+    if mgr is not None:
+        live = mgr.tasks(pred=lambda r: r.get("job_id", "")
+                         .startswith(job))
+        resolved = mgr.job_graphs.resolve(job)
+        if resolved is not None:
+            job_id = resolved
+        else:
+            # An ambiguous prefix must FAIL, not silently merge two
+            # unrelated jobs into one dump (profile rejects the same
+            # reference; the timeline filter must agree with it).
+            # Candidates come from the graph store AND the live
+            # records — two still-running jobs with no terminal task
+            # yet are just as mergeable as two finished ones.
+            hits = set(j for j in mgr.job_graphs.summary()["jobs"]
+                       if j.startswith(job))
+            hits |= {rec["job_id"] for rec in live if rec.get("job_id")}
+            if len(hits) > 1:
+                raise ValueError(
+                    f"ambiguous job reference {job!r}: matches "
+                    + ", ".join(sorted(h[:16] for h in hits)))
+            if len(hits) == 1:
+                job_id = next(iter(hits))
+        task_ids |= mgr.job_graphs.task_ids(job_id)
+        for rec in live:
+            if rec.get("job_id", "").startswith(job_id):
+                task_ids.add(rec["task_id"])
+
+    def keep(ev: dict) -> bool:
+        args = ev.get("args") or {}
+        if args.get("job_id", "").startswith(job_id):
+            return True
+        tid = args.get("task_id")
+        if tid and tid in task_ids:
+            return True
+        oid = args.get("object_id")
+        # Object ids embed their creating task id (ids.py FromIndex).
+        if oid and oid[:32] in task_ids:
+            return True
+        for oid in args.get("object_ids") or ():
+            if oid[:32] in task_ids:
+                return True
+        return False
+
+    return [ev for ev in events if keep(ev)]

@@ -269,8 +269,23 @@ class JobSubmissionClient:
     def memory_summary(self) -> list:
         return self._client.call("memory_summary", None, timeout=30.0)
 
-    def timeline(self) -> list:
-        return self._client.call("timeline_dump", None, timeout=30.0)
+    def timeline(self, job: Optional[str] = None,
+                 critical_path: bool = False) -> list:
+        """Merged chrome://tracing dump; ``job`` restricts it to one
+        job's spans, ``critical_path`` overlays that job's critical
+        path as flow events."""
+        payload = None
+        if job or critical_path:
+            payload = {"job": job, "critical_path": critical_path}
+        return self._client.call("timeline_dump", payload, timeout=60.0)
+
+    def profile_job(self, job: Optional[str] = None,
+                    top_k: int = 3) -> dict:
+        """Critical-path profile of one job (`ray-tpu profile`):
+        stage/node/edge wall-clock attribution along the dependency
+        chain, from the head's job-graph store."""
+        return self._client.call(
+            "profile_job", {"job": job, "top_k": top_k}, timeout=60.0)
 
     def list_state(self, resource: str, filters: Optional[list] = None,
                    limit: Optional[int] = 100, offset: int = 0) -> list:

@@ -10,7 +10,8 @@ start/stop/status/submit/...``) + ``dashboard/modules/job/cli.py``
     python -m ray_tpu list tasks --filter state=RUNNING
     python -m ray_tpu summary tasks
     python -m ray_tpu latency
-    python -m ray_tpu timeline -o trace.json
+    python -m ray_tpu profile [job] [--top-k 3]
+    python -m ray_tpu timeline -o trace.json [--job ID --critical-path]
     python -m ray_tpu submit --working-dir . -- python script.py
     python -m ray_tpu jobs
     python -m ray_tpu logs <job-id>
@@ -466,18 +467,114 @@ def cmd_stacks(args) -> int:
 
 def cmd_timeline(args) -> int:
     """Dump the head's tracing timeline as chrome://tracing JSON
-    (reference `ray timeline`)."""
+    (reference `ray timeline`); --job restricts the dump to one job's
+    spans, --critical-path overlays that job's bottleneck chain as
+    flow events."""
     import json as json_mod
+    if args.critical_path and not args.job:
+        raise SystemExit("--critical-path needs --job <id>: the overlay "
+                         "traces ONE job's bottleneck chain")
     client = _client(args)
     try:
-        events = client.timeline()
+        events = client.timeline(job=args.job,
+                                 critical_path=args.critical_path)
     finally:
         client.close()
     with open(args.output, "w") as f:
         json_mod.dump(events, f)
-    print(f"wrote {len(events)} events to {args.output} "
+    scope = f" (job {args.job})" if args.job else ""
+    print(f"wrote {len(events)} events{scope} to {args.output} "
           "(open in chrome://tracing or Perfetto)")
     return 0
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}GiB"
+
+
+def _render_profile(profile: dict) -> None:
+    """Human rendering of a job profile: headline attribution, then the
+    critical path root -> sink with per-entry stage splits and the
+    object edges (producer, bytes, transfer time) between them."""
+    if profile.get("error"):
+        print(f"profile error: {profile['error']}")
+        known = profile.get("known_jobs")
+        if known:
+            print("known jobs: " + " ".join(j[:16] for j in known))
+        return
+    cov = profile.get("coverage", {})
+    print(f"job {profile.get('job_id', '?')[:16]}  "
+          f"wall-clock {profile.get('wall_clock_s', 0):.3f}s  "
+          f"critical path {profile.get('path_s', 0):.3f}s over "
+          f"{cov.get('path_len', 0)} task(s)  "
+          f"[{cov.get('finished', 0)}/{cov.get('tasks', 0)} finished"
+          + (f", {cov['unfinished_tasks']} still running"
+             if cov.get("unfinished_tasks") else "") + "]")
+    print(f"bottleneck: {profile.get('headline', '')}")
+    attribution = profile.get("attribution", {})
+    by_stage = attribution.get("by_stage", {})
+    if by_stage:
+        print(f"\n{'STAGE':12} {'SECONDS':>10} {'SHARE':>7}")
+        for stage, row in sorted(by_stage.items(),
+                                 key=lambda kv: -kv[1]["seconds"]):
+            print(f"{stage:12} {row['seconds']:>10.4f} "
+                  f"{100.0 * row['fraction']:>6.1f}%")
+    by_node = attribution.get("by_node", {})
+    if by_node:
+        print(f"\n{'NODE':14} {'SECONDS':>10} {'SHARE':>7}")
+        for node, row in sorted(by_node.items(),
+                                key=lambda kv: -kv[1]["seconds"]):
+            print(f"{(node or '?')[:12]:14} {row['seconds']:>10.4f} "
+                  f"{100.0 * row['fraction']:>6.1f}%")
+    print("\nCRITICAL PATH (root -> sink):")
+    for entry in profile.get("path", []):
+        edge = entry.get("edge")
+        if edge:
+            detail = f"arg {edge['object_id'][:12]} from " \
+                     f"{edge['producer'] or edge['producer_task_id'][:12]}"
+            if edge.get("bytes"):
+                detail += f" {_fmt_bytes(edge['bytes'])}"
+            if edge.get("transfer_s"):
+                detail += f" transfer {edge['transfer_s']:.4f}s"
+            if edge.get("restore_s"):
+                detail += f" restore {edge['restore_s']:.4f}s"
+            if edge.get("spill_s"):
+                detail += f" spill {edge['spill_s']:.4f}s"
+            print(f"    |  ({detail})")
+        stages = " ".join(f"{k}={v:.4f}s"
+                          for k, v in sorted(
+                              entry["stages"].items(),
+                              key=lambda kv: -kv[1]))
+        print(f"  {entry['name'] or entry['task_id'][:12]:32} "
+              f"[{(entry['node_id'] or '?')[:12]}] "
+              f"window {entry['window_s']:.4f}s: {stages}")
+    near = profile.get("near_critical", [])
+    if near:
+        print("\nnear-critical (smallest slack first):")
+        for row in near:
+            print(f"  at {row['at_task']}: {row['candidate']} finished "
+                  f"{row['slack_s']:.4f}s before {row['instead_of']}")
+
+
+def cmd_profile(args) -> int:
+    """Causal job profile (`ray-tpu profile <job>`): the critical path
+    of the job's task DAG with per-stage/per-node/per-edge wall-clock
+    attribution — "why did this job take 30s", answered along the
+    dependency chain."""
+    client = _client(args)
+    try:
+        profile = client.profile_job(args.job, top_k=args.top_k)
+    finally:
+        client.close()
+    if args.output == "json":
+        print(json.dumps(profile, default=str, indent=2))
+    else:
+        _render_profile(profile)
+    return 1 if profile.get("error") else 0
 
 
 def cmd_up(args) -> int:
@@ -677,7 +774,26 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("timeline", help="dump chrome://tracing JSON")
     p.add_argument("--address", default=None)
     p.add_argument("-o", "--output", default="timeline.json")
+    p.add_argument("--job", default=None,
+                   help="restrict the dump to one job's spans "
+                        "(job id hex or unique prefix)")
+    p.add_argument("--critical-path", action="store_true",
+                   help="overlay the job's critical path as flow "
+                        "events (requires --job)")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("profile", help="critical-path profile of a "
+                                       "job: stage/node/edge "
+                                       "wall-clock attribution")
+    p.add_argument("job", nargs="?", default=None,
+                   help="job id hex or unique prefix (default: the "
+                        "most recently updated job)")
+    p.add_argument("--top-k", type=int, default=3,
+                   help="near-critical alternatives reported")
+    p.add_argument("--output", choices=["table", "json"],
+                   default="table")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_profile)
 
     p = sub.add_parser("up", help="launch a local cluster from a "
                                   "YAML/JSON config")

@@ -55,11 +55,16 @@ class GlobalState:
     def available_resources(self) -> dict:
         return self._gcs().resource_manager.live_available_resources()
 
-    def chrome_tracing_dump(self) -> List[dict]:
+    def chrome_tracing_dump(self, job: Optional[str] = None,
+                            critical_path: bool = False) -> List[dict]:
+        """Merged cluster timeline; ``job`` restricts the dump to one
+        job's spans (``ray-tpu timeline --job``), ``critical_path``
+        overlays that job's critical path as flow events."""
         w = worker_mod.global_worker()
         if w.connected and w.cluster is not None:
             from ray_tpu.gcs.timeline import merged_timeline
-            return merged_timeline(w.cluster)
+            return merged_timeline(w.cluster, job=job,
+                                   critical_path=critical_path)
         from ray_tpu.util import tracing
         return tracing.chrome_tracing_dump()
 

@@ -1,0 +1,733 @@
+"""Causal job profiler (ISSUE 15): task-graph provenance capture, the
+critical-path engine, and the `profile` surfaces.
+
+Engine-level tests run on hand-built graphs (deterministic, no
+cluster); integration tests drive real DAGs through a cluster with
+fault-injected per-stage delays and assert the engine names the right
+stage, node and dependency chain with attribution that sums to the
+measured wall-clock.
+"""
+
+import json as json_mod
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def thread_cluster():
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+def _row(tid, name, start, end, *, job="job1", node="nodeA", parent="",
+         args=(), running=None, scheduled=None, submitted=None,
+         state="FINISHED"):
+    """Synthetic graph-store row (the shape JobGraphStore.note_terminal
+    copies out of a TaskEventManager record)."""
+    from ray_tpu.gcs import task_events as te
+    sts = {}
+    if scheduled is not None:
+        sts[te.SCHEDULED] = scheduled
+    if submitted is not None:
+        sts[te.SUBMITTED_TO_WORKER] = submitted
+    if running is not None:
+        sts[te.RUNNING] = running
+    return {"task_id": tid, "name": name, "job_id": job, "state": state,
+            "node_id": node, "worker_id": "", "attempt": 0,
+            "type": "NORMAL_TASK", "error": None,
+            "start_time": start, "end_time": end,
+            "parent_task_id": parent, "arg_object_ids": list(args),
+            "state_ts": sts, "stages": {}}
+
+
+def _tid(i):
+    return f"{i:032x}"
+
+
+def _oid(task_hex, index=1):
+    return task_hex + f"{index:016x}"
+
+
+class TestCriticalPathEngine:
+    def test_fan_in_selects_slow_chain_and_sums_exactly(self):
+        """Diamond a -> (fast, slow) -> sink: the engine must walk
+        sink -> slow -> a, and the per-entry windows must tile
+        [root submit, sink end] so attribution sums to the path
+        wall-clock exactly."""
+        from ray_tpu.gcs.job_graph import critical_path
+        a, fast, slow, sink = _tid(1), _tid(2), _tid(3), _tid(4)
+        tasks = {
+            a: _row(a, "a", 0.0, 1.0, running=0.1),
+            fast: _row(fast, "fast", 0.0, 1.5, running=1.05,
+                       args=[_oid(a)], node="nodeA"),
+            slow: _row(slow, "slow", 0.0, 9.0, running=1.1,
+                       args=[_oid(a)], node="nodeB"),
+            sink: _row(sink, "sink", 0.0, 10.0, running=9.2,
+                       args=[_oid(fast), _oid(slow)], node="nodeA"),
+        }
+        p = critical_path(tasks)
+        assert [e["name"] for e in p["path"]] == ["a", "slow", "sink"]
+        assert p["sink_task"]["name"] == "sink"
+        total = sum(sum(e["stages"].values()) for e in p["path"])
+        assert total == pytest.approx(p["path_s"], rel=1e-6)
+        assert p["path_s"] == pytest.approx(10.0, rel=1e-6)
+        # The slow branch ran on nodeB: it must dominate the node rollup.
+        by_node = p["attribution"]["by_node"]
+        assert by_node["nodeB"]["seconds"] > by_node["nodeA"]["seconds"]
+        # Near-critical: the fast branch, with its slack vs slow.
+        assert p["near_critical"]
+        alt = p["near_critical"][0]
+        assert alt["candidate"] == "fast"
+        assert alt["slack_s"] == pytest.approx(7.5, rel=1e-6)
+
+    def test_control_edge_walks_to_the_submitting_parent(self):
+        """A task with no (finished) arg producers chains through its
+        parent: the parent's entry window ends at the child's submit."""
+        from ray_tpu.gcs.job_graph import critical_path
+        parent, child = _tid(1), _tid(2)
+        tasks = {
+            parent: _row(parent, "parent", 0.0, 6.0, running=0.2),
+            child: _row(child, "child", 3.0, 5.0, running=3.3,
+                        parent=parent),
+            # The parent finishes LAST but the sink is the child's
+            # subtree: pick the child-side sink explicitly by making
+            # parent end earlier.
+        }
+        tasks[parent]["end_time"] = 4.0
+        p = critical_path(tasks)
+        assert [e["name"] for e in p["path"]] == ["parent", "child"]
+        # Parent entry window must end exactly at the child's submit.
+        assert p["path"][0]["window_end"] == pytest.approx(3.0)
+        total = sum(sum(e["stages"].values()) for e in p["path"])
+        assert total == pytest.approx(p["path_s"], rel=1e-6)
+
+    def test_transfer_span_time_is_carved_onto_the_edge(self):
+        """An object.transfer span for the gating arg moves time from
+        the consumer's execution segment onto the edge."""
+        from ray_tpu.gcs.job_graph import critical_path
+        prod, cons = _tid(5), _tid(6)
+        oid = _oid(prod)
+        tasks = {
+            prod: _row(prod, "prod", 0.0, 2.0, running=0.1, node="nodeA"),
+            cons: _row(cons, "cons", 0.0, 8.0, running=2.2,
+                       args=[oid], node="nodeB"),
+        }
+        timeline = [{"name": "object.transfer", "ph": "X", "cat":
+                     "transfer", "ts": 2.2e6, "dur": 1.5e6, "pid": 1,
+                     "tid": 1, "args": {"object_id": oid,
+                                        "bytes": 256 * 2**20}}]
+        p = critical_path(tasks, timeline)
+        entry = next(e for e in p["path"] if e["name"] == "cons")
+        assert entry["edge"]["object_id"] == oid
+        assert entry["edge"]["bytes"] == 256 * 2**20
+        assert entry["stages"]["transfer"] == pytest.approx(1.5, rel=1e-6)
+        # Carved OUT of execution, not added on top: still sums exactly.
+        total = sum(sum(e["stages"].values()) for e in p["path"])
+        assert total == pytest.approx(p["path_s"], rel=1e-6)
+
+    def test_fan_out_transfer_charged_per_consumer_not_summed(self):
+        """A shared arg pulled by many consumers: the critical
+        consumer's edge gets ITS tagged span only, not the sum of the
+        whole fan-out's pulls; failed/reselected attempts are excluded
+        too."""
+        from ray_tpu.gcs.job_graph import critical_path
+        prod, c1, c2 = _tid(1), _tid(2), _tid(3)
+        oid = _oid(prod)
+        tasks = {
+            prod: _row(prod, "prod", 0.0, 2.0, running=0.1),
+            c1: _row(c1, "c1", 0.0, 4.0, running=2.1, args=[oid]),
+            c2: _row(c2, "c2", 0.0, 10.0, running=2.1, args=[oid]),
+        }
+
+        def span(task, dur, **extra):
+            args = {"object_id": oid, "task_id": task, "bytes": 1 << 20}
+            args.update(extra)
+            return {"name": "object.transfer", "ph": "X", "ts": 2.1e6,
+                    "dur": dur * 1e6, "pid": 1, "tid": 1, "args": args}
+
+        timeline = [span(c1, 1.0), span(c2, 1.5),
+                    span(c2, 9.0, ok=False),        # failed attempt
+                    span(c2, 9.0, ok="reselect")]   # busy reselect
+        p = critical_path(tasks, timeline)
+        entry = next(e for e in p["path"] if e["name"] == "c2")
+        assert entry["edge"]["transfer_s"] == pytest.approx(1.5)
+        assert entry["stages"]["transfer"] == pytest.approx(1.5)
+
+    def test_spill_share_reported_on_edge_not_carved(self):
+        """Batch spill time is split across the batch's objects and
+        reported on the edge, but NOT carved from the consumer's
+        execution (it was paid in the spiller's frame)."""
+        from ray_tpu.gcs.job_graph import critical_path
+        prod, cons = _tid(1), _tid(2)
+        oid = _oid(prod)
+        tasks = {
+            prod: _row(prod, "prod", 0.0, 2.0, running=0.1),
+            cons: _row(cons, "cons", 0.0, 6.0, running=2.2, args=[oid]),
+        }
+        timeline = [{"name": "object.spill", "ph": "X", "ts": 1.0e6,
+                     "dur": 4.0e6, "pid": 1, "tid": 1,
+                     "args": {"object_ids": [oid, _oid(prod, 2)]}}]
+        p = critical_path(tasks, timeline)
+        entry = next(e for e in p["path"] if e["name"] == "cons")
+        assert entry["edge"]["spill_s"] == pytest.approx(2.0)  # share
+        assert "transfer" not in entry["stages"]
+        # The emitter caps the id list at 64 but stamps the TRUE batch
+        # size as `objects`: the share divides by that, not the list.
+        timeline[0]["args"]["objects"] = 100
+        p = critical_path(tasks, timeline)
+        entry = next(e for e in p["path"] if e["name"] == "cons")
+        assert entry["edge"]["spill_s"] == pytest.approx(0.04)
+
+    def test_empty_and_unfinished_graphs_answer_structurally(self):
+        from ray_tpu.gcs.job_graph import critical_path
+        assert "error" in critical_path({})
+        t = _tid(7)
+        row = _row(t, "t", 0.0, None)
+        row["end_time"] = None
+        assert "error" in critical_path({t: row})
+
+
+class TestJobGraphStore:
+    def _store(self, max_jobs=2, max_tasks=3):
+        from ray_tpu.gcs.job_graph import JobGraphStore
+        return JobGraphStore(max_jobs=max_jobs, max_tasks_per_job=max_tasks)
+
+    def test_bounded_per_job_with_eviction_counters(self):
+        store = self._store(max_jobs=2, max_tasks=3)
+        for i in range(10):
+            store.note_terminal(_row(_tid(i), f"t{i}", 0.0, 1.0 + i))
+        s = store.summary()
+        assert s["jobs"]["job1"]["tasks"] == 3
+        assert s["jobs"]["job1"]["evicted"] == 7
+        assert store.evicted_tasks == 7
+        # Oldest-inserted evicted first: the survivors are the newest.
+        assert sorted(store.task_ids("job1")) == \
+            sorted(_tid(i) for i in (7, 8, 9))
+
+    def test_job_lru_eviction(self):
+        store = self._store(max_jobs=2)
+        for j, job in enumerate(["jobA", "jobB", "jobC"]):
+            store.note_terminal(
+                _row(_tid(j), "t", 0.0, 1.0, job=job))
+        assert store.num_jobs() == 2
+        assert store.evicted_jobs == 1
+        assert store.resolve("jobA") is None      # the LRU victim
+        assert store.resolve("jobC") == "jobC"
+
+    def test_resolve_prefix_and_last(self):
+        store = self._store()
+        store.note_terminal(_row(_tid(1), "t", 0.0, 1.0, job="aabb01"))
+        store.note_terminal(_row(_tid(2), "t", 0.0, 1.0, job="ccdd02"))
+        assert store.resolve("ccdd") == "ccdd02"
+        assert store.resolve(None) == "ccdd02"       # most recent
+        assert store.resolve("last") == "ccdd02"
+        assert store.resolve("zz") is None
+        # Ambiguous prefix resolves to nothing, not an arbitrary hit.
+        store.note_terminal(_row(_tid(3), "t", 0.0, 1.0, job="ccdd03"))
+        assert store.resolve("ccdd") is None
+
+    def test_profiler_disabled_skips_capture(self):
+        from ray_tpu._private.config import get_config
+        cfg = get_config()
+        store = self._store()
+        cfg.job_profiler_enabled = False
+        try:
+            store.note_terminal(_row(_tid(1), "t", 0.0, 1.0))
+        finally:
+            cfg.job_profiler_enabled = True
+        assert store.num_jobs() == 0
+
+
+class TestProvenanceCapture:
+    def test_records_carry_parent_and_arg_ids(self, thread_cluster):
+        """The task-event pipeline folds the submit-side provenance
+        fields, and the nested task's parent is the submitting task."""
+        from ray_tpu.experimental.state.api import list_tasks
+
+        @ray_tpu.remote
+        def leaf_prov():
+            return 1
+
+        @ray_tpu.remote
+        def mid_prov(x):
+            return ray_tpu.get(leaf_prov.remote()) + x
+
+        ref = ray_tpu.put(41)
+        assert ray_tpu.get(mid_prov.remote(ref), timeout=60) == 42
+        rows = {r["name"]: r for r in list_tasks(limit=None)
+                if "prov" in r["name"]}
+        mid = rows[next(n for n in rows if "mid_prov" in n)]
+        leaf = rows[next(n for n in rows if "leaf_prov" in n)]
+        # mid consumed the put ref as a by-reference arg.
+        assert ref.object_id().hex() in mid["arg_object_ids"]
+        # leaf was submitted from inside mid: parent chain.
+        assert leaf["parent_task_id"] == mid["task_id"]
+        # Per-record stage durations ride along for the engine.
+        assert "execution" in mid["stages"]
+
+    def test_profile_names_the_injected_bottleneck(self, thread_cluster):
+        """Acceptance: fan-out/fan-in with one slow branch — the
+        profile must name the slow task's chain and stage, and its
+        attribution must sum to the measured job wall-clock within
+        10%."""
+        from ray_tpu.experimental.state.api import profile_job
+
+        @ray_tpu.remote
+        def cp_src():
+            time.sleep(0.05)
+            return 1
+
+        @ray_tpu.remote
+        def cp_fast(x):
+            time.sleep(0.01)
+            return x
+
+        @ray_tpu.remote
+        def cp_slow(x):
+            time.sleep(0.5)
+            return x
+
+        @ray_tpu.remote
+        def cp_join(*parts):
+            time.sleep(0.02)
+            return sum(parts)
+
+        t0 = time.monotonic()
+        a = cp_src.remote()
+        out = cp_join.remote(cp_fast.remote(a), cp_fast.remote(a),
+                             cp_slow.remote(a))
+        assert ray_tpu.get(out, timeout=60) == 3
+        measured = time.monotonic() - t0
+
+        p = profile_job()
+        assert not p.get("error"), p
+        names = [e["name"] for e in p["path"]]
+        assert any("cp_slow" in n for n in names), names
+        assert not any("cp_fast" in n for n in names), names
+        assert "cp_join" in names[-1]
+        # Execution dominates (the injected bottleneck is a sleep).
+        by_stage = p["attribution"]["by_stage"]
+        dominant = max(by_stage, key=lambda s: by_stage[s]["seconds"])
+        assert dominant == "execution"
+        # Attribution sums to the path by construction AND the path
+        # covers the measured job wall-clock within 10% (the get()
+        # bracketing adds submit/get overhead on top of the path).
+        # abs tolerance: entry stage values are rounded to 6 decimals.
+        total = sum(sum(e["stages"].values()) for e in p["path"])
+        assert total == pytest.approx(p["path_s"], abs=1e-4)
+        assert p["path_s"] <= measured + 1e-3
+        assert p["path_s"] >= 0.9 * p["wall_clock_s"]
+        assert abs(p["wall_clock_s"] - measured) / measured < 0.10, \
+            (p["wall_clock_s"], measured)
+        # The correct node is named on the slow entry.
+        slow_entry = next(e for e in p["path"] if "cp_slow" in e["name"])
+        assert slow_entry["node_id"]
+
+    def test_injected_dispatch_delay_lands_in_scheduling_stages(self):
+        """A delay injected at the worker.dispatch fault point (before
+        SCHEDULED is emitted) must surface in the pre-execution stages
+        of the profile, not as execution time."""
+        from ray_tpu._private import fault_injection
+        from ray_tpu.experimental.state.api import profile_job
+        ray_tpu.init(num_cpus=1, _system_config={
+            # Force the scheduler path (no prestart/keepalive push
+            # bypassing the raylet tick where the fault point lives).
+            "worker_lease_keepalive_ms": 0,
+            "num_prestart_workers": 0,
+        })
+        try:
+            @ray_tpu.remote
+            def quick_cp():
+                return 1
+
+            fault_injection.arm("worker.dispatch", "delay", count=1,
+                                delay_s=0.4)
+            try:
+                assert ray_tpu.get(quick_cp.remote(), timeout=60) == 1
+            finally:
+                fault_injection.disarm("worker.dispatch")
+            p = profile_job()
+            assert not p.get("error"), p
+            by_stage = p["attribution"]["by_stage"]
+            sched_side = sum(by_stage.get(s, {}).get("seconds", 0.0)
+                             for s in ("queue_wait", "dispatch",
+                                       "startup"))
+            exec_s = by_stage.get("execution", {}).get("seconds", 0.0)
+            assert sched_side > 0.3, by_stage
+            assert sched_side > exec_s, by_stage
+        finally:
+            ray_tpu.shutdown()
+
+    def test_store_stays_bounded_under_burst(self, thread_cluster):
+        """Graph-store bound holds under a real burst (acceptance:
+        bounded under eviction), and the eviction is visible in the
+        summarize_tasks integration."""
+        from ray_tpu._private.config import get_config
+        from ray_tpu._private.worker import global_worker
+        from ray_tpu.experimental.state.api import summarize_tasks
+        cfg = get_config()
+        prev = cfg.job_graph_max_tasks
+        cfg.job_graph_max_tasks = 16
+        # The store reads its bound at construction: rebind the live
+        # store's limit directly (same object the ingest feeds).
+        mgr = global_worker().cluster.gcs.task_event_manager
+        prev_store = mgr.job_graphs._max_tasks
+        mgr.job_graphs._max_tasks = 16
+        try:
+            @ray_tpu.remote
+            def burst_cp(i):
+                return i
+
+            assert len(ray_tpu.get([burst_cp.remote(i)
+                                    for i in range(80)],
+                                   timeout=120)) == 80
+            s = summarize_tasks()["job_graphs"]
+            job = next(iter(s["jobs"].values()))
+            assert job["tasks"] <= 16
+            assert job["evicted"] >= 64
+        finally:
+            cfg.job_graph_max_tasks = prev
+            mgr.job_graphs._max_tasks = prev_store
+
+
+class TestTransferEdgeAttribution:
+    def test_cross_node_arg_transfer_rides_the_edge(self):
+        """A big arg produced on one sim node and consumed on another:
+        the forced object.transfer span must surface as edge transfer
+        time on the profile, inflated by the armed transfer.chunk
+        delay."""
+        import numpy as np
+
+        from ray_tpu._private import fault_injection
+        from ray_tpu._private.worker import global_worker
+        from ray_tpu.experimental.state.api import profile_job
+        ray_tpu.init(num_cpus=2, resources={"locA": 1.0})
+        try:
+            cluster = global_worker().cluster
+            cluster.add_node(num_cpus=2, resources={"locB": 1.0},
+                             object_store_memory=256 * 2**20)
+
+            @ray_tpu.remote(resources={"locA": 0.1})
+            def produce_cp():
+                return np.ones(4 * 2**20, dtype=np.uint8)
+
+            @ray_tpu.remote(resources={"locB": 0.1})
+            def consume_cp(arr):
+                return int(arr[0])
+
+            fault_injection.arm("transfer.chunk", "delay", count=-1,
+                                delay_s=0.05)
+            try:
+                assert ray_tpu.get(
+                    consume_cp.remote(produce_cp.remote()),
+                    timeout=120) == 1
+            finally:
+                fault_injection.disarm("transfer.chunk")
+            p = profile_job()
+            assert not p.get("error"), p
+            entry = next(e for e in p["path"]
+                         if "consume_cp" in e["name"])
+            assert entry["edge"] is not None
+            assert entry["edge"]["transfer_s"] > 0.04, entry["edge"]
+            assert entry["edge"]["bytes"] >= 4 * 2**20
+            assert entry["stages"].get("transfer", 0.0) > 0.0
+        finally:
+            ray_tpu.shutdown()
+
+
+class TestProfileSurfaces:
+    def test_dashboard_profile_route(self, thread_cluster):
+        from ray_tpu._private.worker import global_worker
+        from ray_tpu.dashboard.head import start_dashboard
+
+        @ray_tpu.remote
+        def dash_cp(x):
+            return x * 2
+
+        assert ray_tpu.get(dash_cp.remote(21), timeout=30) == 42
+        dash = start_dashboard(global_worker().cluster)
+        try:
+            body = urllib.request.urlopen(
+                dash.url + "/api/profile", timeout=10).read()
+            p = json_mod.loads(body)
+            assert not p.get("error"), p
+            assert p["path"]
+            assert "headline" in p
+            # Unknown job answers structurally, not with a 500.
+            body = urllib.request.urlopen(
+                dash.url + "/api/profile?job_id=feedbeef",
+                timeout=10).read()
+            assert json_mod.loads(body).get("error")
+        finally:
+            dash.stop()
+
+    def test_timeline_job_filter_and_overlay(self):
+        """`ray-tpu timeline --job`: only the job's spans survive the
+        filter, and --critical-path overlays flow events anchored on
+        the execute spans."""
+        from ray_tpu.util import tracing
+        ray_tpu.init(num_cpus=2, _system_config={"tracing_enabled": True})
+        try:
+            tracing.clear()
+
+            @ray_tpu.remote
+            def tl_a():
+                return 1
+
+            @ray_tpu.remote
+            def tl_b(x):
+                return x + 1
+
+            assert ray_tpu.get(tl_b.remote(tl_a.remote()),
+                               timeout=30) == 2
+            from ray_tpu._private.worker import global_worker
+            job_hex = global_worker().job_id.hex()
+            everything = ray_tpu.timeline()
+            scoped = ray_tpu.timeline(job=job_hex)
+            assert scoped and len(scoped) < len(everything)
+            tids = {(e.get("args") or {}).get("task_id")
+                    for e in scoped if e.get("cat") == "execute"}
+            assert len(tids) == 2     # both tasks, nothing else
+            overlaid = ray_tpu.timeline(job=job_hex, critical_path=True)
+            flows = [e for e in overlaid
+                     if e.get("cat") == "critical_path"]
+            assert any(e["ph"] == "s" for e in flows)
+            assert any(e["ph"] == "f" for e in flows)
+            assert any(e["name"] == "critical_path.summary"
+                       for e in flows)
+        finally:
+            ray_tpu.shutdown()
+            tracing.enable(False)
+            tracing.clear()
+
+    def test_cli_rendering_smoke(self, capsys):
+        """_render_profile on an engine-produced dict: names, stages
+        and edges render without crashing (the `ray-tpu profile`
+        table path)."""
+        from ray_tpu.gcs.job_graph import critical_path
+        from ray_tpu.scripts.cli import _render_profile
+        a, b = _tid(1), _tid(2)
+        tasks = {
+            a: _row(a, "a", 0.0, 2.0, running=0.1),
+            b: _row(b, "b", 0.0, 5.0, running=2.2, args=[_oid(a)]),
+        }
+        timeline = [{"name": "object.transfer", "ph": "X", "ts": 2.1e6,
+                     "dur": 0.5e6, "pid": 1, "tid": 1,
+                     "args": {"object_id": _oid(a), "bytes": 1 << 20}}]
+        profile = critical_path(tasks, timeline)
+        profile["coverage"]["unfinished_tasks"] = 0
+        _render_profile(profile)
+        out = capsys.readouterr().out
+        assert "CRITICAL PATH" in out
+        assert "execution" in out
+        assert "transfer" in out
+        _render_profile({"error": "unknown job 'x'",
+                         "known_jobs": ["aa", "bb"]})
+        assert "profile error" in capsys.readouterr().out
+
+
+class TestTimelineShipBudget:
+    """Heartbeat-channel shipping telemetry (ROADMAP item 1): the
+    node-side timeline shipper is byte-budgeted per beat with
+    carryover, and payload bytes are counted by kind."""
+
+    def _shipper(self, published):
+        from ray_tpu._private.node_host import _TimelineShipper
+        return _TimelineShipper(
+            lambda _ch, _key, batch: published.append(batch),
+            "node-test", "cafe", lambda: 0.0)
+
+    def _fill(self, n, pad=200):
+        from ray_tpu.util import tracing
+        tracing.clear()
+        tracing.ingest([{"name": f"span{i}", "ph": "X", "ts": float(i),
+                         "dur": 1.0, "pid": 1, "tid": 1,
+                         "args": {"pad": "x" * pad}} for i in range(n)])
+
+    def test_budget_bounds_bytes_per_beat_with_carryover(self):
+        import pickle
+
+        from ray_tpu._private.config import get_config
+        from ray_tpu.util import tracing
+        cfg = get_config()
+        prev = cfg.timeline_ship_budget_bytes
+        cfg.timeline_ship_budget_bytes = 2_000
+        published = []
+        try:
+            self._fill(100)
+            shipper = self._shipper(published)
+            first = shipper.ship()
+            assert 0 < first <= 2_000 + 400      # one-span slack
+            assert published, "nothing shipped"
+            assert len(published[0]["events"]) < 100, \
+                "budget did not split the backlog"
+            # The remainder stays pending and drains on later beats
+            # under the same per-beat bound.
+            total_events = len(published[0]["events"])
+            for _ in range(60):
+                shipper.ship()
+                total_events = sum(len(b["events"]) for b in published)
+                if total_events == 100:
+                    break
+            assert total_events == 100, "backlog never drained"
+            for batch in published:
+                size = sum(len(pickle.dumps(ev, protocol=4)) + 16
+                           for ev in batch["events"])
+                # Carryover cap: no batch exceeds 4 windows + slack.
+                assert size <= 4 * 2_000 + 400, size
+        finally:
+            cfg.timeline_ship_budget_bytes = prev
+            tracing.clear()
+
+    def test_pending_overflow_drops_oldest_and_counts(self):
+        from ray_tpu._private.config import get_config
+        from ray_tpu.util import tracing
+        cfg = get_config()
+        prev = cfg.timeline_ship_budget_bytes
+        cfg.timeline_ship_budget_bytes = 1_000
+        published = []
+        try:
+            shipper = self._shipper(published)
+            shipper._PENDING_CAP = 10
+            self._fill(25, pad=10)
+            shipper.ship()
+            assert shipper.dropped == 15
+            # The drop counter rides the shipped batch (loss explicit).
+            assert published[0]["dropped"] >= 15
+        finally:
+            cfg.timeline_ship_budget_bytes = prev
+            tracing.clear()
+
+    def test_oversized_single_span_still_ships(self):
+        from ray_tpu._private.config import get_config
+        from ray_tpu.util import tracing
+        cfg = get_config()
+        prev = cfg.timeline_ship_budget_bytes
+        cfg.timeline_ship_budget_bytes = 64
+        published = []
+        try:
+            self._fill(1, pad=5_000)
+            shipper = self._shipper(published)
+            assert shipper.ship() > 64          # progress guarantee
+            assert len(published[0]["events"]) == 1
+        finally:
+            cfg.timeline_ship_budget_bytes = prev
+            tracing.clear()
+
+    def test_oversized_stream_pays_debt_between_ships(self):
+        """An oversized ship drives the budget negative (debt): the
+        next windows repay it before shipping again, so the LONG-RUN
+        byte rate stays at the configured budget even when every span
+        exceeds it."""
+        from ray_tpu._private.config import get_config
+        from ray_tpu.util import tracing
+        cfg = get_config()
+        prev = cfg.timeline_ship_budget_bytes
+        cfg.timeline_ship_budget_bytes = 1_000
+        published = []
+        try:
+            self._fill(6, pad=3_000)        # every span ~3x the budget
+            shipper = self._shipper(published)
+            ships = [shipper.ship() for _ in range(30)]
+            shipped = sum(1 for s in ships if s > 0)
+            # 30 windows x 1000 B grants ~ 30 KB of budget; 6 spans of
+            # ~3.2 KB cost ~19 KB — all ship, but interleaved with
+            # debt-repayment windows, never back-to-back every beat.
+            assert sum(len(b["events"]) for b in published) == 6
+            assert shipped < 30
+            total = sum(ships)
+            assert total <= 30 * 1_000 + 4_000   # grant + one-span slack
+        finally:
+            cfg.timeline_ship_budget_bytes = prev
+            tracing.clear()
+
+
+class TestTimelineJobFilterSafety:
+    def test_failed_publish_requeues_batch_not_silent_loss(self):
+        """A publish failure (head flap mid-beat) must put the popped
+        spans back for the next beat, not lose them uncounted."""
+        from ray_tpu._private.config import get_config
+        from ray_tpu._private.node_host import _TimelineShipper
+        from ray_tpu.util import tracing
+        cfg = get_config()
+        prev = cfg.timeline_ship_budget_bytes
+        cfg.timeline_ship_budget_bytes = 100_000
+        published = []
+        calls = {"n": 0}
+
+        def flaky_publish(_ch, _key, batch):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise ConnectionError("head gone")
+            published.append(batch)
+
+        try:
+            tracing.clear()
+            tracing.ingest([{"name": f"s{i}", "ph": "X", "ts": float(i),
+                             "dur": 1.0, "pid": 1, "tid": 1}
+                            for i in range(5)])
+            shipper = _TimelineShipper(flaky_publish, "src", "cafe",
+                                       lambda: 0.0)
+            with pytest.raises(ConnectionError):
+                shipper.ship()
+            assert shipper.shipped_bytes == 0     # budget uncharged
+            assert shipper.ship() > 0             # retry succeeds
+            assert len(published) == 1
+            assert len(published[0]["events"]) == 5
+            # In order, nothing lost or duplicated.
+            assert [e["name"] for e in published[0]["events"]] == \
+                [f"s{i}" for i in range(5)]
+        finally:
+            cfg.timeline_ship_budget_bytes = prev
+            tracing.clear()
+
+    def test_ambiguous_live_jobs_fail_too(self):
+        """Two RUNNING jobs (no terminal task yet — nothing in the
+        graph store) matching the prefix must also error: mid-run
+        dumps are just as mergeable as finished ones."""
+        from ray_tpu.gcs.pubsub import TASK_EVENT_CHANNEL
+        from ray_tpu.gcs.timeline import merged_timeline
+        ray_tpu.init(num_cpus=2)
+        try:
+            from ray_tpu._private.worker import global_worker
+            cluster = global_worker().cluster
+            pub = cluster.gcs.publisher
+            for i, job in enumerate(["fe01", "fe02"]):
+                pub.publish(TASK_EVENT_CHANNEL, b"", {
+                    "buffer_id": "t", "dropped": 0,
+                    "events": [{"task_id": _tid(40 + i),
+                                "state": "RUNNING", "ts": 1.0,
+                                "job_id": job}]})
+            deadline = time.monotonic() + 5
+            mgr = cluster.gcs.task_event_manager
+            while time.monotonic() < deadline and mgr.num_tracked() < 2:
+                time.sleep(0.02)
+            with pytest.raises(ValueError, match="ambiguous"):
+                merged_timeline(cluster, job="fe")
+        finally:
+            ray_tpu.shutdown()
+
+    def test_ambiguous_prefix_fails_instead_of_merging(self):
+        """`ray-tpu timeline --job <prefix>` matching several jobs must
+        error, not silently merge unrelated jobs into one dump."""
+        from ray_tpu.gcs.timeline import merged_timeline
+        ray_tpu.init(num_cpus=2)
+        try:
+            from ray_tpu._private.worker import global_worker
+            cluster = global_worker().cluster
+            store = cluster.gcs.task_event_manager.job_graphs
+            store.note_terminal(_row(_tid(1), "t", 0.0, 1.0,
+                                     job="ab01"))
+            store.note_terminal(_row(_tid(2), "t", 0.0, 1.0,
+                                     job="ab02"))
+            with pytest.raises(ValueError, match="ambiguous"):
+                merged_timeline(cluster, job="ab")
+            # An exact reference still resolves.
+            assert isinstance(merged_timeline(cluster, job="ab01"), list)
+        finally:
+            ray_tpu.shutdown()

@@ -238,6 +238,83 @@ class TestTracing:
             tracing.enable(False)
             tracing.clear()
 
+    def test_trace_id_unbroken_driver_actor_nested_task(self):
+        """Trace-context coverage (ISSUE 15 satellite): driver ->
+        actor method -> nested task must share ONE trace_id, in thread
+        mode.  Actor-method submits inject TaskSpec.trace_ctx exactly
+        like plain tasks."""
+        from ray_tpu.util import tracing
+        ray_tpu.init(num_cpus=2, _system_config={"tracing_enabled": True})
+        try:
+            tracing.clear()
+
+            @ray_tpu.remote
+            def nested_tr(x):
+                return x + 1
+
+            @ray_tpu.remote
+            class ChainTr:
+                def go(self, x):
+                    return ray_tpu.get(nested_tr.remote(x)) + 1
+
+            actor = ChainTr.remote()
+            assert ray_tpu.get(actor.go.remote(1), timeout=30) == 3
+            events = ray_tpu.timeline()
+            executes = [e for e in events if e.get("cat") == "execute"]
+            method = next(e for e in executes if "go" in e["name"])
+            nested = next(e for e in executes
+                          if "nested_tr" in e["name"])
+            sub = next(e for e in events if e.get("cat") == "submit"
+                       and "go" in e["name"])
+            assert method["args"]["trace_id"] == sub["args"]["trace_id"]
+            assert nested["args"]["trace_id"] == \
+                sub["args"]["trace_id"], \
+                "trace broke between the actor method and its nested task"
+        finally:
+            ray_tpu.shutdown()
+            tracing.enable(False)
+            tracing.clear()
+
+    def test_trace_id_unbroken_across_client_submission(self):
+        """Trace-context coverage, process mode: a nested task
+        submitted from INSIDE a process-mode worker goes through the
+        ray-client submit path (client_runtime), which must inject
+        TaskSpec.trace_ctx like core_worker.py does for plain tasks —
+        the pre-fix behavior started a fresh trace at the process
+        boundary."""
+        from ray_tpu.util import tracing
+        ray_tpu.init(num_cpus=2, _system_config={
+            "worker_process_mode": "process",
+            "scheduler_backend": "native",
+            "tracing_enabled": True,
+        })
+        try:
+            tracing.clear()
+
+            @ray_tpu.remote
+            def inner_tr(x):
+                return x * 2
+
+            @ray_tpu.remote
+            def outer_tr(x):
+                return ray_tpu.get(inner_tr.remote(x)) + 1
+
+            assert ray_tpu.get(outer_tr.remote(3), timeout=60) == 7
+            events = ray_tpu.timeline()
+            executes = [e for e in events if e.get("cat") == "execute"]
+            outer = next(e for e in executes if "outer_tr" in e["name"])
+            inner = next((e for e in executes
+                          if "inner_tr" in e["name"]), None)
+            assert inner is not None, \
+                "nested execute span never reached the driver"
+            assert inner["args"]["trace_id"] == \
+                outer["args"]["trace_id"], \
+                "trace broke across the client submission boundary"
+        finally:
+            ray_tpu.shutdown()
+            tracing.enable(False)
+            tracing.clear()
+
     def test_spans_cross_the_process_boundary(self):
         """Execute spans recorded in a worker OS process must appear in
         the driver's timeline with the worker's pid (ProfileEvent
