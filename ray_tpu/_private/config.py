@@ -65,6 +65,21 @@ class Config:
     #: as above.
     autoscaler_kernel_backend: str = "auto"
     autoscaler_kernel_min_cells: int = 2048
+    #: Pod-sharded solve: shard the (classes x nodes) waterfill /
+    #: solve-tick / bundle-pack along the NODE axis across the local
+    #: devices (shard_map over a 1-D mesh, cross-shard prefix/argmax
+    #: reductions per bucket step).  "auto" shards when more than one
+    #: device is visible AND the cluster has at least
+    #: solver_shard_min_nodes nodes; "force" shards whenever >1 device
+    #: exists (tests); "off" never shards.  The single-device path
+    #: stays the default below the gate — sharding a small solve pays
+    #: collective latency for nothing.
+    solver_shard_backend: str = "auto"
+    solver_shard_min_nodes: int = 4096
+    #: Event-buffer lock striping: per-thread striped sub-buffers
+    #: (round-robin thread->stripe binding) drained and merged by the
+    #: flusher.  1 = the old single-lock buffer.
+    task_event_stripes: int = 8
     #: Max lease requests in flight per scheduling class
     #: (ray_config_def.h:342).  Batched lease requests count each
     #: entry against this cap.

@@ -33,6 +33,16 @@ Cost when armed: the steady-state acquire path is thread-local list ops
 plus one dict read (edge dedup); the internal registry lock is taken only
 when a *new* edge is inserted, which happens a bounded number of times
 per process (#locks is small and fixed).
+
+Striped-lock naming contract: a lock that is one stripe of a sharded
+hot-path structure is named ``<Base>[sNN]`` (two-digit stripe index,
+e.g. ``TaskEventBuffer._lock[s03]``, ``ReferenceCounter._lock[s12]``).
+Witness edges and contention histograms stay per-stripe — a stripe-order
+inversion or one hot stripe is visible as itself — while
+``debug.report.striped_lock_rollup()`` re-aggregates the suffix back to
+the base name so post-striping waits compare 1:1 against pre-striping
+baselines.  Keep the suffix exactly ``[s`` + digits + ``]`` and at the
+END of the name; the rollup matches on that.
 """
 
 from __future__ import annotations

@@ -18,6 +18,34 @@ from ray_tpu._private.debug import (flight_recorder, lock_order, swallow,
                                     watchdog)
 
 
+def striped_lock_rollup() -> dict:
+    """Aggregate contention stats of lock-striped locks back to their
+    base name (``Foo._lock[s03]`` -> ``Foo._lock``).  The per-stripe
+    rows stay individually visible in :func:`top_locks`; this rollup is
+    the number that compares against pre-striping baselines (the PR 13
+    ``TaskEventBuffer._lock`` / ``ReferenceCounter._lock`` waits)."""
+    import re
+    stripe_re = re.compile(r"\[s\d+\]$")
+    snap = lock_order.contention_snapshot()
+    out: Dict[str, dict] = {}
+    for name, st in snap.items():
+        m = stripe_re.search(name)
+        if not m:
+            continue
+        base = name[:m.start()]
+        agg = out.setdefault(base, {
+            "stripes": 0, "acquires": 0, "contended": 0,
+            "wait_total_s": 0.0, "wait_max_s": 0.0})
+        agg["stripes"] += 1
+        agg["acquires"] += st["acquires"]
+        agg["contended"] += st["contended"]
+        agg["wait_total_s"] = round(
+            agg["wait_total_s"] + st["wait_sum_s"], 6)
+        agg["wait_max_s"] = max(agg["wait_max_s"],
+                                round(st["wait_max_s"], 6))
+    return out
+
+
 def top_locks(n: int = 5) -> list:
     """The ``n`` hottest locks by total sampled acquire-wait time."""
     snap = lock_order.contention_snapshot()

@@ -759,18 +759,23 @@ def waterfill_oracle(avail: np.ndarray, total: np.ndarray,
                      spread_threshold: float,
                      cost: Optional[np.ndarray] = None,
                      invert_util: bool = False,
-                     zero_shifts: bool = False) -> np.ndarray:
+                     zero_shifts: bool = False,
+                     n_pad: Optional[int] = None) -> np.ndarray:
     """Pure-numpy reference of the bucketized waterfill (same semantics,
     including the per-class within-bucket rotation, the per-(class,node)
     ``cost`` offsets and the inverted-utilization pack mode).
 
     Float32 throughout so score/bucket boundaries match the device kernel
-    bit-for-bit."""
+    bit-for-bit.  ``n_pad`` overrides the padded ring width the rotation
+    wraps on — the sharded solve pads to ``_GROUP * n_shards`` instead of
+    ``_GROUP``, so parity tests pass the sharded ring explicitly to pin
+    bit-exactness at non-aligned ``N``."""
     avail = avail.astype(np.float32).copy()
     total = total.astype(np.float32)
     C, R = demand.shape
     N = avail.shape[0]
-    n_pad = _round_up(max(N, 8), _GROUP)
+    if n_pad is None:
+        n_pad = _round_up(max(N, 8), _GROUP)
     alloc = np.zeros((C, N), dtype=np.int64)
     eps = np.float32(1e-6)
     empty = total.max(axis=1) <= 0
@@ -909,13 +914,29 @@ class BatchSolver:
         ``cost`` [C, N] adds per-(class, node) score offsets (negative =
         preferred); ``invert_util`` + ``zero_shifts`` select pack mode
         (most-utilized-first, first-fit within a bucket) — the
-        autoscaler's node-count bin-packing ordering."""
+        autoscaler's node-count bin-packing ordering.
+
+        Above the ``solver_shard_min_nodes`` gate (and with >1 device
+        visible) the solve runs node-sharded across the local mesh
+        (``sharded_solve``); any sharded failure flips the process
+        kill-switch and falls through to the single-device kernel."""
         import jax
         C, R = demand.shape
         N = avail.shape[0]
-        c_pad, n_pad, r_pad = self._pads(C, N, R)
         accel_node, accel_class, spread_threshold = self._defaults(
             N, C, accel_node, accel_class, spread_threshold)
+        if self.mode != "sinkhorn":
+            from ray_tpu.scheduler import sharded_solve
+            n_shards = sharded_solve.plan_shards(N)
+            if n_shards > 1:
+                try:
+                    return sharded_solve.solve_matrices_sharded(
+                        avail, total, demand, counts, accel_node,
+                        accel_class, spread_threshold, cost, invert_util,
+                        zero_shifts, n_shards)
+                except Exception:
+                    sharded_solve.mark_broken("solve_matrices")
+        c_pad, n_pad, r_pad = self._pads(C, N, R)
         args = (
             _pad_to(avail.astype(np.float32), (n_pad, r_pad)),
             _pad_to(total.astype(np.float32), (n_pad, r_pad)),
@@ -956,10 +977,22 @@ class BatchSolver:
         (unsorted) order; strategy semantics ride the kernel's cost and
         masks.  Returns (node_idx [B] int64, ok [B] bool) — callers
         treat any ``~ok`` as all-or-nothing failure and re-validate
-        against exact vectors before committing."""
+        against exact vectors before committing.
+
+        Sharded above the ``solver_shard_min_nodes`` gate: the
+        cross-shard argmax keeps the exact first-max tie-break, so the
+        sharded solve is bit-identical for any N (see sharded_solve)."""
         import jax
         B, R = demand.shape
         N = avail.shape[0]
+        from ray_tpu.scheduler import sharded_solve
+        n_shards = sharded_solve.plan_shards(N)
+        if n_shards > 1:
+            try:
+                return sharded_solve.solve_bundles_sharded(
+                    avail, total, demand, strategy, excluded, n_shards)
+            except Exception:
+                sharded_solve.mark_broken("solve_bundles")
         b_pad = _round_up(max(B, 1), 8)
         n_pad = _round_up(max(N, 8), _GROUP)
         r_pad = _round_up(max(R, 1), 8)
@@ -1190,7 +1223,8 @@ class DeviceRuntimeSolver:
         self.last_cost_active = False
         self.stats = {"ticks": 0, "full_syncs": 0, "row_deltas": 0,
                       "fallbacks": 0, "class_evictions": 0,
-                      "cost_ticks": 0}
+                      "cost_ticks": 0, "sharded_ticks": 0,
+                      "shard_fallbacks": 0}
         from ray_tpu._private.metrics_agent import (get_metrics_registry,
                                                     record_internal)
         # Label by owning node: one solver per raylet, and unlabeled
@@ -1306,22 +1340,46 @@ class DeviceRuntimeSolver:
             return False
         cfg = get_config()
         cost = self._build_cost(specs, groups, st, c_cap, cfg)
-        packed = np.asarray(_call_with_pallas_fallback(
-            lambda use: _jit_solve_tick(c_cap, st["n_pad"], st["r_pad"],
-                                        nnz_max, use),
-            (st["avail_t"], st["total_t"], self._demand_dev, counts,
-             st["accel_node"], self._accel_dev,
-             np.float32(cfg.scheduler_spread_threshold), cost)))
-        ok = packed[2 * nnz_max + 1] > 0.5
-        if not ok:
-            return False
-        # Decode the sparse assignment and expand per-spec targets.
-        idx = np.rint(packed[:nnz_max]).astype(np.int64)
-        vals = packed[nnz_max:2 * nnz_max]
         n_pad = st["n_pad"]
+        if st.get("n_shards", 1) > 1:
+            # Pod-sharded tick: every shard solves its node block
+            # against the resident sharded world state; failure flips
+            # the kill-switch so the NEXT full sync rebuilds
+            # single-device (this tick falls back like spillback).
+            from ray_tpu.scheduler import sharded_solve
+            try:
+                merged = sharded_solve.solve_tick_sharded(
+                    st["avail_t"], st["total_t"], self._demand_dev,
+                    counts, st["accel_node"], self._accel_dev,
+                    cfg.scheduler_spread_threshold, cost, c_cap, n_pad,
+                    st["r_pad"], nnz_max, st["n_shards"])
+            except Exception:
+                sharded_solve.mark_broken("solve_tick")
+                self.stats["shard_fallbacks"] += 1
+                raise
+            self.stats["sharded_ticks"] += 1
+            if not merged["ok"]:
+                return False
+            idx, vals = merged["idx"], merged["vals"]
+            live = idx < c_cap * n_pad
+            idx, vals = idx[live], vals[live]
+        else:
+            packed = np.asarray(_call_with_pallas_fallback(
+                lambda use: _jit_solve_tick(c_cap, st["n_pad"],
+                                            st["r_pad"], nnz_max, use),
+                (st["avail_t"], st["total_t"], self._demand_dev, counts,
+                 st["accel_node"], self._accel_dev,
+                 np.float32(cfg.scheduler_spread_threshold), cost)))
+            ok = packed[2 * nnz_max + 1] > 0.5
+            if not ok:
+                return False
+            # Decode the sparse assignment and expand per-spec targets.
+            idx = np.rint(packed[:nnz_max]).astype(np.int64)
+            vals = packed[nnz_max:2 * nnz_max]
+            live = idx < c_cap * n_pad
+            idx, vals = idx[live], vals[live]
         alloc = np.zeros((c_cap, n_pad), dtype=np.int64)
-        live = idx < c_cap * n_pad
-        alloc.reshape(-1)[idx[live]] = np.rint(vals[live]).astype(np.int64)
+        alloc.reshape(-1)[idx] = np.rint(vals).astype(np.int64)
         node_ids = st["node_ids"]
         n_real = len(node_ids)
         for cls, members in groups.items():
@@ -1397,11 +1455,20 @@ class DeviceRuntimeSolver:
         ver, node_ids, total, avail, columns = view.snapshot_versioned()
         N, R = total.shape
         prev = self._state
-        # Keep padded dims monotone to avoid recompiles on node churn.
-        n_pad = _round_up(max(N, 8), _GROUP)
+        # Pod-sharded residency: above the gate the world state shards
+        # along the node axis across the local mesh; every shard stays
+        # device-resident between ticks exactly like the single-chip
+        # path (deltas scatter into the sharded array, see
+        # _apply_deltas).
+        from ray_tpu.scheduler import sharded_solve
+        n_shards = sharded_solve.plan_shards(N)
+        # Keep padded dims monotone to avoid recompiles on node churn;
+        # the sharded ring additionally pads to whole groups per shard.
+        n_pad = _round_up(max(N, 8), _GROUP * n_shards)
         r_pad = _round_up(max(R, 1), 8)
         if prev is not None:
-            n_pad = max(n_pad, prev["n_pad"])
+            n_pad = _round_up(max(n_pad, prev["n_pad"]),
+                              _GROUP * n_shards)
             r_pad = max(r_pad, prev["r_pad"])
         accel_node = accelerator_node_mask(total)
         # Per-node throughput rates (heterogeneity cost term): read once
@@ -1422,18 +1489,26 @@ class DeviceRuntimeSolver:
         rates_accel[N:] = rates_accel[:max(N, 1)].max()
         het_cpu = 1.0 - rates_cpu / rates_cpu.max()
         het_accel = 1.0 - rates_accel / rates_accel.max()
+        if n_shards > 1:
+            sh_rn = sharded_solve.node_sharding(n_shards)
+            sh_n = sharded_solve.node_sharding(n_shards, ("nodes",))
+        else:
+            sh_rn = sh_n = None
         self._state = {
             "version": ver, "node_ids": node_ids, "columns": columns,
             "node_index": {nid: i for i, nid in enumerate(node_ids)},
-            "n_pad": n_pad, "r_pad": r_pad,
+            "n_pad": n_pad, "r_pad": r_pad, "n_shards": n_shards,
             "het_cpu": het_cpu.astype(np.float32),
             "het_accel": het_accel.astype(np.float32),
             "het_active": bool(het_cpu.any() or het_accel.any()),
             "avail_t": jax.device_put(
-                _pad_to(avail.astype(np.float32), (n_pad, r_pad)).T.copy()),
+                _pad_to(avail.astype(np.float32), (n_pad, r_pad)).T.copy(),
+                sh_rn),
             "total_t": jax.device_put(
-                _pad_to(total.astype(np.float32), (n_pad, r_pad)).T.copy()),
-            "accel_node": jax.device_put(_pad_to(accel_node, (n_pad,))),
+                _pad_to(total.astype(np.float32), (n_pad, r_pad)).T.copy(),
+                sh_rn),
+            "accel_node": jax.device_put(_pad_to(accel_node, (n_pad,)),
+                                         sh_n),
         }
         # Rebuild the demand matrix against the (possibly wider) column
         # mapping.
@@ -1451,14 +1526,21 @@ class DeviceRuntimeSolver:
                     demand[row, col] = v
             accel[row] = req.uses_accelerator()
         self._demand_host, self._accel_host = demand, accel
-        self._demand_dev = jax.device_put(demand)
-        self._accel_dev = jax.device_put(accel)
+        n_shards = self._state["n_shards"] if self._state else 1
+        if n_shards > 1:
+            from ray_tpu.scheduler import sharded_solve
+            rep = sharded_solve.replicated_sharding(n_shards)
+            cost_sh = sharded_solve.node_sharding(n_shards)
+        else:
+            rep = cost_sh = None
+        self._demand_dev = jax.device_put(demand, rep)
+        self._accel_dev = jax.device_put(accel, rep)
         # Device-resident zero cost matrix: the common no-cost tick
         # passes this cached handle, so nothing extra crosses
         # host->device unless a locality/heterogeneity term is live.
         n_pad = self._state["n_pad"] if self._state else _GROUP
         self._zero_cost_dev = jax.device_put(
-            np.zeros((c_cap, n_pad), dtype=np.float32))
+            np.zeros((c_cap, n_pad), dtype=np.float32), cost_sh)
 
     def _evict_stale_classes(self, keep: set, st: dict,
                              force_lru: bool = False) -> bool:
@@ -1504,19 +1586,28 @@ class DeviceRuntimeSolver:
         self._accel_host[row] = req.uses_accelerator()
         # Class registration is rare; re-uploading the (small) demand
         # matrix wholesale is simpler than a device scatter.
-        self._demand_dev = jax.device_put(self._demand_host)
-        self._accel_dev = jax.device_put(self._accel_host)
+        rep = None
+        if st.get("n_shards", 1) > 1:
+            from ray_tpu.scheduler import sharded_solve
+            rep = sharded_solve.replicated_sharding(st["n_shards"])
+        self._demand_dev = jax.device_put(self._demand_host, rep)
+        self._accel_dev = jax.device_put(self._accel_host, rep)
 
     def _apply_deltas(self, dirty_idx: List[int], dirty_rows: np.ndarray):
         import jax
         st = self._state
         self.stats["row_deltas"] += len(dirty_idx)
         n_pad, r_pad = st["n_pad"], st["r_pad"]
+        n_shards = st.get("n_shards", 1)
         if len(dirty_idx) > n_pad // 2:
             # Cheaper to re-upload than to scatter half the matrix.
+            sh = None
+            if n_shards > 1:
+                from ray_tpu.scheduler import sharded_solve
+                sh = sharded_solve.node_sharding(n_shards)
             avail = np.asarray(st["avail_t"]).T.copy()
             avail[dirty_idx, :dirty_rows.shape[1]] = dirty_rows
-            st["avail_t"] = jax.device_put(avail.T.copy())
+            st["avail_t"] = jax.device_put(avail.T.copy(), sh)
             return
         k_pad = 1
         while k_pad < len(dirty_idx):
@@ -1526,5 +1617,10 @@ class DeviceRuntimeSolver:
         rows = np.zeros((k_pad, r_pad), dtype=np.float32)
         rows[:, :dirty_rows.shape[1]] = dirty_rows[-1]
         rows[:len(dirty_idx), :dirty_rows.shape[1]] = dirty_rows
-        fn = _jit_apply_rows(n_pad, r_pad, k_pad)
+        if n_shards > 1:
+            from ray_tpu.scheduler import sharded_solve
+            fn = sharded_solve._jit_sharded_apply_rows(
+                n_pad, r_pad, k_pad, n_shards)
+        else:
+            fn = _jit_apply_rows(n_pad, r_pad, k_pad)
         st["avail_t"] = fn(st["avail_t"], idx, rows)

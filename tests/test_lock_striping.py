@@ -1,0 +1,242 @@
+"""Hot-path lock striping correctness (ISSUE 17 tentpole 2).
+
+The PR 13 contention profiler attributed the residual dispatch tail to
+``TaskEventBuffer._lock`` and ``ReferenceCounter._lock``; both are now
+striped.  These tests drive concurrent churn across the stripes with
+the lock-order witness and contention profiler armed suite-wide
+(conftest), so any stripe-stripe nesting or cross-layer ordering edge
+the refactor introduced fails the session, not just the test.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.debug import lock_order
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.reference_counter import (_NUM_STRIPES,
+                                                ReferenceCounter)
+from ray_tpu.gcs.task_events import TaskEventBuffer
+
+
+class _CollectPublisher:
+    def __init__(self, fail=False):
+        self.batches = []
+        self.fail = fail
+        self._lock = threading.Lock()
+
+    def publish(self, channel, key, payload):
+        if self.fail:
+            raise RuntimeError("injected publish failure")
+        with self._lock:
+            self.batches.append(payload)
+
+
+def _oid(i: int) -> ObjectID:
+    return ObjectID(
+        i.to_bytes(4, "little") * (ObjectID.SIZE // 4))
+
+
+class TestTaskEventBufferStriping:
+    def test_concurrent_emit_no_loss_and_sorted_batches(self):
+        pub = _CollectPublisher()
+        buf = TaskEventBuffer(pub, max_buffer=100_000,
+                              batch_size=1_000_000,
+                              flush_interval=999.0, stripes=8)
+        n_threads, per_thread = 8, 400
+
+        def emitter(k):
+            for i in range(per_thread):
+                buf.emit(f"t{k}-{i}", "RUNNING", name=f"job{k}")
+
+        threads = [threading.Thread(target=emitter, args=(k,))
+                   for k in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert buf.num_buffered() == n_threads * per_thread
+        assert buf.dropped == 0
+        buf.flush()
+        events = [e for b in pub.batches for e in b["events"]]
+        assert len(events) == n_threads * per_thread
+        # Published batch is globally ts-sorted (the cross-stripe merge
+        # contract consumers rely on).
+        ts = [e["ts"] for e in events]
+        assert ts == sorted(ts)
+        # Per-thread emission order survives the merge: ts ties broken
+        # stably, and each thread's own ids stay in sequence.
+        for k in range(n_threads):
+            mine = [e["task_id"] for e in events
+                    if e["task_id"].startswith(f"t{k}-")]
+            assert mine == [f"t{k}-{i}" for i in range(per_thread)]
+        buf.stop()
+
+    def test_overflow_counted_per_stripe_and_rides_batch(self):
+        pub = _CollectPublisher()
+        # stripe cap = 16 // 4 = 4: a single thread binds one stripe
+        # and overflows it while the other stripes stay empty.
+        buf = TaskEventBuffer(pub, max_buffer=16, batch_size=1_000_000,
+                              flush_interval=999.0, stripes=4)
+        for i in range(10):
+            buf.emit(f"x{i}", "RUNNING")
+        assert buf.num_buffered() == 4
+        assert buf.dropped == 6
+        buf.flush()
+        assert pub.batches[-1]["dropped"] == 6
+        buf.stop()
+
+    def test_publish_failure_counts_as_dropped(self):
+        pub = _CollectPublisher(fail=True)
+        buf = TaskEventBuffer(pub, max_buffer=1024,
+                              batch_size=1_000_000,
+                              flush_interval=999.0, stripes=4)
+        for i in range(7):
+            buf.emit(f"x{i}", "RUNNING")
+        buf.flush()
+        assert buf.dropped == 7
+        assert buf.num_buffered() == 0          # batch popped, counted
+        buf.stop()
+
+    def test_stripes_have_contention_instrumentation(self):
+        pub = _CollectPublisher()
+        buf = TaskEventBuffer(pub, max_buffer=1024,
+                              batch_size=1_000_000,
+                              flush_interval=999.0, stripes=4)
+        buf.emit("t0", "RUNNING")
+        buf.flush()
+        snap = lock_order.contention_snapshot()
+        stripe_names = [n for n in snap
+                        if n.startswith("TaskEventBuffer._lock[s")]
+        assert stripe_names, (
+            "striped locks missing from the contention profiler: "
+            f"{sorted(snap)[:20]}")
+        buf.stop()
+
+
+class TestReferenceCounterStriping:
+    def test_concurrent_churn_across_stripes(self):
+        rc = ReferenceCounter()
+        deleted = []
+        del_lock = threading.Lock()
+
+        def on_deleted(oid):
+            with del_lock:
+                deleted.append(oid)
+
+        rc.subscribe_deleted(on_deleted)
+        n_threads, per_thread = 8, 150
+
+        def churn(k):
+            rng = np.random.default_rng(k)
+            for i in range(per_thread):
+                oid = _oid(k * 10_000 + i)
+                rc.add_owned_object(oid)
+                rc.add_local_ref(oid)
+                rc.add_submitted_task_refs([oid])
+                rc.add_borrowed_object(oid, f"b{k}")
+                if rng.random() < 0.5:
+                    rc.ref_count(oid)
+                rc.remove_borrower(oid, f"b{k}")
+                rc.remove_submitted_task_refs([oid])
+                rc.remove_local_ref(oid)
+
+        threads = [threading.Thread(target=churn, args=(k,))
+                   for k in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        rc.flush_pending_releases()
+        assert rc.num_tracked() == 0
+        assert len(deleted) == n_threads * per_thread
+        rc.close()
+
+    def test_cross_stripe_containment_cascade(self):
+        """Outer release cascades deletion into inner objects living on
+        OTHER stripes (the worklist path) — every object's callbacks
+        fire exactly once."""
+        rc = ReferenceCounter()
+        fired = []
+        outer = _oid(1)
+        # Spread the inners across all stripes deliberately.
+        inners = [_oid(2 + i) for i in range(2 * _NUM_STRIPES)]
+        assert len({hash(o) & (_NUM_STRIPES - 1) for o in inners}) > 1
+        rc.add_owned_object(outer, contained_ids=inners)
+        rc.add_local_ref(outer)
+        for o in inners:
+            rc.add_on_delete(o, fired.append)
+        rc.add_on_delete(outer, fired.append)
+        for o in inners:
+            assert rc.has_reference(o)          # pinned by containment
+        rc.remove_local_ref(outer)
+        assert not rc.has_reference(outer)
+        for o in inners:
+            assert not rc.has_reference(o)
+        assert sorted(f.hex() for f in fired) == sorted(
+            o.hex() for o in [outer] + inners)
+        assert rc.num_tracked() == 0
+        rc.close()
+
+    def test_nested_cascade_chain_across_stripes(self):
+        """a contains b contains c: releasing a deletes all three via
+        the iterative worklist (the recursive path of the old code)."""
+        rc = ReferenceCounter()
+        a, b, c = _oid(11), _oid(22), _oid(33)
+        rc.add_owned_object(c)
+        rc.add_owned_object(b, contained_ids=[c])
+        rc.add_owned_object(a, contained_ids=[b])
+        rc.add_local_ref(a)
+        assert rc.has_reference(b) and rc.has_reference(c)
+        rc.remove_local_ref(a)
+        for o in (a, b, c):
+            assert not rc.has_reference(o)
+        rc.close()
+
+    def test_on_delete_after_gone_fires_immediately(self):
+        rc = ReferenceCounter()
+        oid = _oid(7)
+        fired = []
+        rc.add_on_delete(oid, fired.append)     # never registered
+        assert fired == [oid]
+        rc.close()
+
+    def test_duplicate_decrement_floors_not_frees(self):
+        rc = ReferenceCounter()
+        oid = _oid(3)
+        rc.add_owned_object(oid)
+        rc.add_local_ref(oid)
+        rc.add_local_ref(oid)
+        rc.remove_local_ref(oid)
+        rc.remove_local_ref(oid)
+        assert not rc.has_reference(oid)
+        # A third (buggy, duplicate) decrement must be a no-op.
+        rc.remove_local_ref(oid)
+        assert rc.ref_count(oid) == 0
+        rc.close()
+
+    def test_stripes_have_contention_instrumentation(self):
+        rc = ReferenceCounter()
+        oid = _oid(42)
+        rc.add_local_ref(oid)
+        rc.remove_local_ref(oid)
+        snap = lock_order.contention_snapshot()
+        stripe_names = [n for n in snap
+                        if n.startswith("ReferenceCounter._lock[s")]
+        assert stripe_names
+        rc.close()
+
+    def test_striped_rollup_aggregates_base_names(self):
+        from ray_tpu._private.debug.report import striped_lock_rollup
+        rc = ReferenceCounter()
+        for i in range(64):
+            oid = _oid(i)
+            rc.add_local_ref(oid)
+            rc.remove_local_ref(oid)
+        rollup = striped_lock_rollup()
+        assert "ReferenceCounter._lock" in rollup
+        row = rollup["ReferenceCounter._lock"]
+        assert row["stripes"] >= 2              # churn touched several
+        assert row["acquires"] >= 64
+        rc.close()

@@ -512,3 +512,161 @@ class TestTransferWriterDedupe:
                 cluster.object_directory.remove_location(oid, dst.node_id)
         for node in [cluster.head_node, src, dst]:
             assert node.object_store.stats.get("vanished_objects", 0) == 0
+
+
+class TestShardedSolveParity:
+    """ISSUE 17 satellite: the pod-sharded solve vs the single-device
+    kernel.  The suite-wide ``XLA_FLAGS=--xla_force_host_platform_
+    device_count=8`` (conftest) gives these tests an 8-device CPU
+    "pod" in-process.
+
+    Parity contract (sharded_solve module docstring): the sharded ring
+    pads N to ``_GROUP * n_shards``, so against the numpy oracle ON
+    THAT RING the waterfill is bit-exact for ANY N; against the
+    single-device kernel it is bit-exact when both rings coincide and
+    feasibility-equal otherwise (same placed totals per class is NOT
+    guaranteed node-for-node — only oracle-pinned determinism is)."""
+
+    @pytest.fixture(autouse=True)
+    def _fresh_shard_state(self):
+        from ray_tpu.scheduler import sharded_solve
+        sharded_solve.reset_broken()
+        yield
+        sharded_solve.reset_broken()
+
+    def _force(self, n_shards=None):
+        import jax
+        cfg = get_config()
+        cfg.solver_shard_backend = "force"
+        return n_shards or len(jax.devices())
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    @pytest.mark.parametrize("mode", ["plain", "cost", "pack",
+                                      "pack_cost"])
+    def test_waterfill_matches_oracle_on_sharded_ring(self, seed, mode):
+        from ray_tpu.scheduler import sharded_solve
+        rng = np.random.default_rng(seed)
+        n_shards = self._force()
+        C, N, R = 8, int(rng.integers(20, 90)), 4
+        avail, total, demand, counts, an, ac = _random_problem(
+            rng, C=C, N=N, R=R)
+        cost = None
+        if "cost" in mode:
+            cost = np.where(rng.random((C, N)) < 0.2,
+                            rng.uniform(-0.7, 0.5, (C, N)),
+                            0.0).astype(np.float32)
+        pack = "pack" in mode
+        got = sharded_solve.solve_matrices_sharded(
+            avail, total, demand, counts, an, ac, 0.5, cost,
+            pack, pack, n_shards)
+        _, n_pad, _ = sharded_solve.pads_sharded(C, N, R, n_shards)
+        want = waterfill_oracle(avail, total, demand, counts, an, ac,
+                                0.5, cost=cost, invert_util=pack,
+                                zero_shifts=pack, n_pad=n_pad)
+        np.testing.assert_array_equal(got, want)
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_waterfill_bit_parity_on_aligned_n(self, seed):
+        """When N is a multiple of _GROUP * n_shards both rings
+        coincide: sharded == single-device bit-for-bit."""
+        from ray_tpu.scheduler import sharded_solve
+        from ray_tpu.scheduler.jax_backend import _GROUP
+        rng = np.random.default_rng(seed)
+        n_shards = self._force()
+        N = _GROUP * n_shards
+        avail, total, demand, counts, an, ac = _random_problem(
+            rng, C=6, N=N, R=3)
+        get_config().solver_shard_backend = "off"
+        single = BatchSolver().solve_matrices(
+            avail, total, demand, counts, an, ac, spread_threshold=0.5)
+        sharded = sharded_solve.solve_matrices_sharded(
+            avail, total, demand, counts, an, ac, 0.5, None,
+            False, False, n_shards)
+        np.testing.assert_array_equal(single, sharded)
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    @pytest.mark.parametrize("strategy", ["PACK", "SPREAD",
+                                          "STRICT_PACK",
+                                          "STRICT_SPREAD"])
+    def test_bundle_bit_parity_all_strategies(self, seed, strategy):
+        """Bundles are argmax-per-step: the cross-shard first-max
+        reduction reproduces the single-device tie-break exactly, so
+        bit parity holds for ANY N."""
+        from ray_tpu.scheduler import sharded_solve
+        rng = np.random.default_rng(seed)
+        n_shards = self._force()
+        N, R = int(rng.integers(3, 40)), 3
+        total = rng.integers(2, 32, size=(N, R)).astype(np.float64)
+        avail = np.floor(total * rng.uniform(0.3, 1.0, size=(N, R)))
+        B = int(rng.integers(1, 6))
+        demand = rng.integers(0, 5, size=(B, R)).astype(np.float64)
+        excluded = rng.random(N) < 0.1
+        get_config().solver_shard_backend = "off"
+        i1, o1 = BatchSolver().solve_bundles(avail, total, demand,
+                                             strategy, excluded)
+        i2, o2 = sharded_solve.solve_bundles_sharded(
+            avail, total, demand, strategy, excluded, n_shards)
+        np.testing.assert_array_equal(i1, i2)
+        np.testing.assert_array_equal(o1, o2)
+
+    def test_pg_strategies_through_pack_bundles_surface(self):
+        """End-to-end through the pack_bundles routing with the shard
+        gate forced: every strategy still validates."""
+        from ray_tpu.scheduler.bundle_packing import (
+            pack_bundles_kernel, validate_assignment)
+        from ray_tpu.scheduler.resources import ResourceRequest
+        rng = np.random.default_rng(5)
+        self._force()
+        cfg = get_config()
+        cfg.pg_kernel_backend = "force"
+        view = _view([(f"n{i}",
+                       {"CPU": float(rng.integers(2, 8)),
+                        "memory": float(rng.integers(2, 16))}, None)
+                      for i in range(6)])
+        bundles = [ResourceRequest({"CPU": 1.0, "memory": 1.0})
+                   for _ in range(3)]
+        for strategy in ("PACK", "SPREAD", "STRICT_PACK",
+                         "STRICT_SPREAD"):
+            got = pack_bundles_kernel(view, bundles, strategy)
+            assert got is not None, strategy
+            assert validate_assignment(view, bundles, got, strategy,
+                                       set())
+
+    def test_min_nodes_gate(self):
+        """Below solver_shard_min_nodes (mode=auto) the solve stays
+        single-device; force overrides; off disables."""
+        import jax
+        from ray_tpu.scheduler import sharded_solve
+        cfg = get_config()
+        cfg.solver_shard_backend = "auto"
+        cfg.solver_shard_min_nodes = 4096
+        assert sharded_solve.plan_shards(100) == 1
+        assert sharded_solve.plan_shards(4096) == len(jax.devices())
+        cfg.solver_shard_backend = "force"
+        assert sharded_solve.plan_shards(100) == len(jax.devices())
+        cfg.solver_shard_backend = "off"
+        assert sharded_solve.plan_shards(100_000) == 1
+
+    def test_fallback_on_shard_failure(self, monkeypatch):
+        """A sharded-solve failure marks the backend broken and the
+        same call transparently re-solves single-device — and
+        plan_shards stays 1 until reset_broken()."""
+        from ray_tpu.scheduler import sharded_solve
+        rng = np.random.default_rng(9)
+        self._force()
+        avail, total, demand, counts, an, ac = _random_problem(rng)
+        want = waterfill_oracle(avail, total, demand, counts, an, ac,
+                                spread_threshold=0.5)
+
+        def boom(*a, **k):
+            raise RuntimeError("injected shard failure")
+
+        monkeypatch.setattr(sharded_solve, "solve_matrices_sharded",
+                            boom)
+        got = BatchSolver().solve_matrices(
+            avail, total, demand, counts, an, ac, spread_threshold=0.5)
+        np.testing.assert_array_equal(got, want)
+        assert sharded_solve.plan_shards(10_000) == 1   # pinned broken
+        monkeypatch.undo()
+        sharded_solve.reset_broken()
+        assert sharded_solve.plan_shards(10_000) > 1
