@@ -277,6 +277,41 @@ def _broadcast_relay_row():
                       f"{(proc.stderr or '')[-400:]}"}
 
 
+def _envelope_row():
+    """Run bench_runtime.py --envelope-smoke in a subprocess (the
+    envelope driver stands up its own fleet of node-host OS processes;
+    this process's backend/cluster state must not leak into it) and
+    return the parsed envelope_smoke row, or a structured skip dict.
+    The full 50-host soak is recorded separately (ENVELOPE_r06.json);
+    this row keeps the stand-up + zero-silent-loss contract riding
+    every bench.py invocation at smoke cost."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench_runtime.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, path, "--envelope-smoke"],
+            env=env, capture_output=True, text=True, timeout=600)
+    except subprocess.TimeoutExpired:
+        return {"skipped": True, "reason": "envelope smoke timed out"}
+    # Parse the row even on rc!=0: silent loss prints its data before
+    # exiting 1 — the honest failure must reach the JSON.
+    for line in proc.stdout.strip().splitlines():
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if row.get("metric") == "envelope_smoke":
+            if proc.returncode != 0:
+                row["failed"] = True
+                row["failed_rc"] = proc.returncode
+            return row
+    return {"skipped": True,
+            "reason": f"no envelope_smoke row in output "
+                      f"(rc={proc.returncode}): "
+                      f"{(proc.stderr or '')[-400:]}"}
+
+
 def main():
     probe = _probe()
     probed_cpu = not probe.get("ok") or probe.get("backend") != "tpu"
@@ -426,6 +461,14 @@ def main():
     # per-source served-bytes balance), folded as broadcast_relay.
     res["broadcast_relay"] = {
         k: v for k, v in _broadcast_relay_row().items()
+        if k not in ("metric", "value", "unit")}
+
+    # Cluster-envelope axis: the chaos-soak driver at smoke scale
+    # (4 node-host OS processes, seeded faults, zero-silent-loss
+    # contract), folded as envelope — the summary already carries the
+    # driver's own honest cpu_throttled marking for this box.
+    res["envelope"] = {
+        k: v for k, v in _envelope_row().items()
         if k not in ("metric", "value", "unit")}
 
     dispatch = _dispatch_latency_rows()

@@ -1111,6 +1111,51 @@ ray_tpu.shutdown()
                 zero_restart_ok=res["restarts"] == 0)
 
 
+def bench_envelope_smoke(hosts=4, timeout_s=420):
+    """envelope_smoke row: the cluster envelope driver (tools/envelope.py
+    / ``ray-tpu envelope``) at smoke scale — ``hosts`` real node-host OS
+    processes, a small actor/PG/broadcast workload, 2 scheduled chaos
+    faults — in a fresh subprocess, timeout-bounded.  Parses the single
+    summary JSON line the driver prints on stdout; the driver exits
+    non-zero on ANY silent loss, so the row carries the zero-silent-loss
+    contract, not just throughput."""
+    import subprocess
+    cmd = [sys.executable, "-m", "ray_tpu._private.envelope",
+           "--hosts", str(hosts), "--cpus-per-host", "1",
+           "--actors", "40", "--actor-wave", "20",
+           "--pgs", "8", "--pg-wave", "4",
+           "--broadcast", "8:2",
+           "--chaos-events", "2", "--chaos-window-s", "6",
+           "--chaos-seed", "1234",
+           "--get-timeout-s", "60", "--stand-up-timeout", "120",
+           "--out", "", "--quiet"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        out = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout_s,
+            env=env, cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return emit("envelope_smoke", -1.0, "s", hosts=hosts,
+                    error=f"timed out after {timeout_s}s")
+    summary = None
+    for line in reversed((out.stdout or "").strip().splitlines()):
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if "envelope" in row:
+            summary = row["envelope"]
+            break
+    if summary is None:
+        return emit("envelope_smoke", -1.0, "s", hosts=hosts,
+                    error=f"no summary line (rc={out.returncode}): "
+                          f"{(out.stderr or '')[-400:]}")
+    # rc=1 means the driver saw silent loss — keep the data, mark it.
+    return emit("envelope_smoke", summary["wall_s"], "s",
+                passed=(out.returncode == 0 and
+                        summary["silent_loss"] == 0), **summary)
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true",
@@ -1150,6 +1195,14 @@ def main():
     parser.add_argument("--gate-retries", type=int, default=1,
                         help="extra measurement rounds before the "
                              "gate trips")
+    parser.add_argument("--envelope-smoke", action="store_true",
+                        help="run the cluster envelope driver at smoke "
+                             "scale (4 node-host OS processes, chaos "
+                             "armed) in a fresh subprocess; exits "
+                             "non-zero on silent loss (bench.py folds "
+                             "this in)")
+    parser.add_argument("--envelope-hosts", type=int, default=4,
+                        help="fleet size for --envelope-smoke")
     parser.add_argument("--solve-scale", action="store_true",
                         help="pod-sharded vs single-device scheduler "
                              "solve sweep (ISSUE 17); forces 8 host "
@@ -1174,6 +1227,12 @@ def main():
                 " --xla_force_host_platform_device_count=8")
         bench_solve_scale()
         return 0
+    if args.envelope_smoke:
+        # The driver owns its own cluster in a fresh subprocess — no
+        # ray_tpu.init in THIS process.  rc mirrors the zero-silent-
+        # loss contract so a CI lane trips on loss, not just on crash.
+        row = bench_envelope_smoke(hosts=args.envelope_hosts)
+        return 0 if row.get("passed") else 1
     if args.introspection_gate:
         # Both arms are fresh subprocesses — no cluster in THIS
         # process.  The row is printed either way; a gate violation

@@ -1,0 +1,269 @@
+"""Seeded, reproducible chaos schedules for the cluster envelope.
+
+The envelope soak (``tools/envelope.py`` / ``ray-tpu envelope``) drives
+a 50–64-host fleet through its full workload while THIS module keeps
+faults firing underneath it: asymmetric partitions, SIGKILLs, RPC
+delays/duplicates, and spill faults, all generated from one integer
+seed so a failing soak replays bit-identically (``generate_schedule``
+is a pure function of its arguments — the determinism is pinned by
+``tests/test_envelope.py``).
+
+Two halves:
+
+* :func:`generate_schedule` — seed → ``List[ChaosEvent]``, sorted by
+  fire time.  No wall clock, no randomness source but the seed.
+* :class:`ChaosRunner` — a background thread that walks the schedule
+  against a LIVE fleet, applying each event through the PR 14 wire
+  fault plane (``fault_injection.partition`` / ``arm_over_wire`` over
+  the fault-exempt control verbs) and PR 6 fault points
+  (``spill.write``), and healing timed events when their duration
+  elapses.  Every application lands in ``event_log`` with its outcome
+  — a chaos run whose faults never fired proves nothing, so the log is
+  the envelope's evidence, not a debugging convenience.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+from typing import Callable, List, Optional
+
+from ray_tpu._private import fault_injection
+from ray_tpu._private.debug import swallow
+
+#: Everything generate_schedule can emit.  ``partition`` carries a
+#: direction (inbound / outbound / both — one direction alone is the
+#: classic asymmetric, zombie-producing shape) and a duration; some
+#: durations deliberately land INSIDE the suspect grace so the run
+#: proves sub-grace flaps cause zero restarts.
+KINDS = ("partition", "sigkill", "rpc_delay", "rpc_duplicate",
+         "spill_fault")
+
+
+@dataclasses.dataclass
+class ChaosEvent:
+    at_s: float             # fire time, seconds from schedule start
+    kind: str               # one of KINDS
+    target: int             # fleet index (runner resolves mod fleet size)
+    duration_s: float = 0.0  # timed events heal this long after firing
+    params: dict = dataclasses.field(default_factory=dict)
+
+    def to_jsonable(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def generate_schedule(seed: int, duration_s: float, n_events: int,
+                      n_targets: int,
+                      kinds: Optional[List[str]] = None,
+                      kill_budget: Optional[int] = None,
+                      flap_band=(0.3, 0.9),
+                      hold_band=(1.5, 4.0)) -> List[ChaosEvent]:
+    """Deterministic fault timeline: same arguments, same schedule.
+
+    ``kill_budget`` bounds SIGKILLs (default ``max(1, n_targets //
+    16)``) so the fleet survives its own soak; partition durations draw
+    from ``flap_band`` (sub-grace flap — must cause zero restarts) or
+    ``hold_band`` (past suspect, sometimes past dead) with equal
+    probability."""
+    rng = random.Random(seed)
+    kinds = list(kinds) if kinds else list(KINDS)
+    if kill_budget is None:
+        kill_budget = max(1, n_targets // 16)
+    kills = 0
+    events: List[ChaosEvent] = []
+    for _ in range(n_events):
+        at = rng.uniform(0.05 * duration_s, 0.95 * duration_s)
+        kind = rng.choice(kinds)
+        if kind == "sigkill" and kills >= kill_budget:
+            kind = "partition"
+        # Target 0 is reserved by convention for the envelope's relay
+        # origin / first node: chaos may partition it but not kill it.
+        target = rng.randrange(1, max(2, n_targets))
+        if kind == "partition":
+            direction = rng.choice(("inbound", "outbound", "both"))
+            band = flap_band if rng.random() < 0.5 else hold_band
+            dur = rng.uniform(*band)
+            events.append(ChaosEvent(at, kind, target, dur,
+                                     {"direction": direction}))
+        elif kind == "sigkill":
+            kills += 1
+            events.append(ChaosEvent(at, kind, target))
+        elif kind == "rpc_delay":
+            events.append(ChaosEvent(
+                at, kind, target, 0.0,
+                {"delay_s": round(rng.uniform(0.05, 0.3), 3),
+                 "count": rng.randrange(5, 50)}))
+        elif kind == "rpc_duplicate":
+            events.append(ChaosEvent(
+                at, kind, target, 0.0,
+                {"count": rng.randrange(3, 20)}))
+        elif kind == "spill_fault":
+            events.append(ChaosEvent(
+                at, kind, target, 0.0,
+                {"count": rng.randrange(1, 4)}))
+        else:
+            raise ValueError(f"unknown chaos kind {kind!r}")
+    events.sort(key=lambda e: e.at_s)
+    return events
+
+
+class ChaosRunner:
+    """Walk a schedule against a live fleet on a background thread.
+
+    ``handles`` are :class:`~ray_tpu._private.cluster.RemoteNodeHandle`
+    rows; an event's ``target`` indexes into them (mod size).  Events
+    targeting an already-killed node are logged as skipped, not
+    silently dropped.  ``stop()`` heals every armed partition — the
+    runner must never leave the cluster partitioned after the workload
+    finished, or teardown itself wedges."""
+
+    def __init__(self, handles, schedule: List[ChaosEvent],
+                 on_event: Optional[Callable] = None):
+        self._handles = list(handles)
+        self._schedule = sorted(schedule, key=lambda e: e.at_s)
+        self._on_event = on_event
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._active: List[tuple] = []   # (heal_at_abs, partition, row)
+        self._dead: set = set()          # fleet indexes SIGKILLed
+        self.event_log: List[dict] = []
+        self.events_fired = 0
+        self.events_skipped = 0
+
+    # ---- lifecycle -----------------------------------------------------
+    def start(self) -> "ChaosRunner":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="ray_tpu::chaos")
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        self._heal_all()
+
+    # ---- the walk ------------------------------------------------------
+    def _run(self):
+        t0 = time.monotonic()
+        for ev in self._schedule:
+            while not self._stop.is_set():
+                now = time.monotonic() - t0
+                self._heal_due(now)
+                if now >= ev.at_s:
+                    break
+                self._stop.wait(min(0.05, ev.at_s - now))
+            if self._stop.is_set():
+                break
+            self._apply(ev, time.monotonic() - t0)
+        # Drain remaining heals so timed events still close out.
+        while not self._stop.is_set() and self._active:
+            self._heal_due(time.monotonic() - t0)
+            self._stop.wait(0.05)
+
+    def _resolve(self, ev: ChaosEvent):
+        idx = ev.target % len(self._handles)
+        h = self._handles[idx]
+        if idx in self._dead or h.proc.poll() is not None:
+            return idx, h, None
+        return idx, h, h.proxy
+
+    def _apply(self, ev: ChaosEvent, now_s: float):
+        idx, handle, proxy = self._resolve(ev)
+        row = {"at_s": round(ev.at_s, 3), "applied_s": round(now_s, 3),
+               "kind": ev.kind, "target": idx,
+               "node": handle.node_name, "params": dict(ev.params),
+               "duration_s": round(ev.duration_s, 3)}
+        try:
+            if proxy is None and ev.kind != "sigkill":
+                row["outcome"] = "skipped: target dead"
+                self.events_skipped += 1
+                self.event_log.append(row)
+                return
+            if ev.kind == "partition":
+                direction = ev.params.get("direction", "both")
+                p = fault_injection.partition(
+                    tuple(proxy.address),
+                    outbound=direction in ("outbound", "both"),
+                    inbound=direction in ("inbound", "both"))
+                p.arm()
+                self._active.append((ev.at_s + ev.duration_s, p, row))
+                row["outcome"] = "armed"
+            elif ev.kind == "sigkill":
+                if idx in self._dead:
+                    row["outcome"] = "skipped: already dead"
+                    self.events_skipped += 1
+                    self.event_log.append(row)
+                    return
+                handle.kill()
+                self._dead.add(idx)
+                row["outcome"] = "killed"
+            elif ev.kind == "rpc_delay":
+                fault_injection.arm_over_wire(
+                    proxy.client, "rpc.send", "delay",
+                    count=int(ev.params.get("count", 10)),
+                    delay_s=float(ev.params.get("delay_s", 0.1)))
+                row["outcome"] = "armed"
+            elif ev.kind == "rpc_duplicate":
+                fault_injection.arm_over_wire(
+                    proxy.client, "rpc.send", "duplicate",
+                    count=int(ev.params.get("count", 5)))
+                row["outcome"] = "armed"
+            elif ev.kind == "spill_fault":
+                fault_injection.arm_over_wire(
+                    proxy.client, "spill.write", "error",
+                    count=int(ev.params.get("count", 1)))
+                row["outcome"] = "armed"
+            else:
+                row["outcome"] = f"skipped: unknown kind {ev.kind!r}"
+                self.events_skipped += 1
+                self.event_log.append(row)
+                return
+            self.events_fired += 1
+        except Exception as e:
+            # A fault that failed to arm (target mid-death, wire race)
+            # is an explicit log row — the soak's evidence must show
+            # what actually fired, not what was scheduled.
+            swallow.noted("chaos.apply", e)
+            row["outcome"] = f"error: {type(e).__name__}: {e}"
+            self.events_skipped += 1
+        self.event_log.append(row)
+        if self._on_event is not None:
+            try:
+                self._on_event(row)
+            except Exception as e:
+                swallow.noted("chaos.on_event", e)
+
+    def _heal_due(self, now_s: float):
+        due = [a for a in self._active if a[0] <= now_s]
+        self._active = [a for a in self._active if a[0] > now_s]
+        for _heal_at, p, row in due:
+            self._heal_one(p, row, now_s)
+
+    def _heal_all(self):
+        active, self._active = self._active, []
+        for _heal_at, p, row in active:
+            self._heal_one(p, row, None)
+
+    def _heal_one(self, p, row: dict, now_s: Optional[float]):
+        try:
+            # heal() disarms the drop faults inside the daemon; close()
+            # only releases the helper's own control-channel client.
+            # Calling close() alone leaves the partition armed FOREVER
+            # — sub-grace flaps silently escalate to node deaths and a
+            # healed node can never come back talking to be fenced.
+            p.heal()
+            row["healed_s"] = round(now_s, 3) if now_s is not None \
+                else "on_stop"
+        except Exception as e:
+            # Healing a partition on a node that died mid-partition
+            # fails by construction; the row says so.
+            swallow.noted("chaos.heal", e)
+            row["healed_s"] = f"heal failed: {type(e).__name__}"
+        finally:
+            try:
+                p.close()
+            except Exception as e:
+                swallow.noted("chaos.heal", e)

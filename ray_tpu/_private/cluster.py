@@ -115,18 +115,28 @@ class Cluster:
         process instead of an in-process one.  The spawned process is
         matched by a one-shot registration token, so duplicate
         node_names cannot bind the handle to the wrong node."""
+        return self.add_remote_nodes(
+            [dict(num_cpus=num_cpus, num_tpus=num_tpus, num_gpus=num_gpus,
+                  memory=memory, object_store_memory=object_store_memory,
+                  resources=resources, node_name=node_name)],
+            timeout=timeout)[0]
+
+    def _spawn_node_host(self, spec: dict):
+        """Spawn one NodeHost OS process; returns ``(proc, reg_token,
+        name)`` without waiting for registration."""
         import json
         import os
         import subprocess
         import sys
-        import time
         import uuid
 
         from ray_tpu._private.runtime_env import framework_import_root
         host, port = self.start_head_service()
-        total = self._assemble_totals(num_cpus, num_tpus, num_gpus, memory,
-                                      object_store_memory, resources)
-        name = node_name or f"remote-{uuid.uuid4().hex[:8]}"
+        total = self._assemble_totals(
+            spec.get("num_cpus", 1), spec.get("num_tpus", 0),
+            spec.get("num_gpus", 0), spec.get("memory"),
+            spec.get("object_store_memory"), spec.get("resources"))
+        name = spec.get("node_name") or f"remote-{uuid.uuid4().hex[:8]}"
         reg_token = uuid.uuid4().hex
         env = dict(os.environ)
         env["PYTHONPATH"] = framework_import_root() + os.pathsep + \
@@ -139,24 +149,65 @@ class Cluster:
              "--reg-token", reg_token,
              "--system-config", get_config().to_json()],
             env=env)
-        deadline = time.monotonic() + timeout
-        node_id = None
-        while time.monotonic() < deadline:
-            node_id = self.head_service.node_id_for_token(reg_token)
-            if node_id is not None:
-                break
-            if proc.poll() is not None:
-                raise RuntimeError(
-                    f"node_host process exited with {proc.returncode} "
-                    "before registering")
-            time.sleep(0.02)
-        if node_id is None:
-            proc.kill()
-            raise TimeoutError("remote node failed to register in time")
-        handle = RemoteNodeHandle(self, proc, node_id, name)
+        return proc, reg_token, name
+
+    def add_remote_nodes(self, specs, timeout: float = 60.0,
+                         spawn_interval_s: float = 0.0
+                         ) -> List["RemoteNodeHandle"]:
+        """Spawn MANY NodeHost processes concurrently, then wait for
+        them all to register.  Spawning everything before waiting is
+        what makes a 50–64-host fleet stand up in seconds instead of
+        serial spawn×poll round trips — and it deliberately produces
+        the registration storm the head's admission gate
+        (``head_registration_concurrency``) has to absorb.  On
+        timeout/early-exit, already-spawned unregistered processes are
+        killed and the error names the failing node."""
+        import time
+
+        entries = []           # (proc, reg_token, name, node_id|None)
+        try:
+            for spec in specs:
+                proc, reg_token, name = self._spawn_node_host(spec)
+                entries.append([proc, reg_token, name, None])
+                if spawn_interval_s > 0:
+                    time.sleep(spawn_interval_s)
+            deadline = time.monotonic() + timeout
+            pending = list(entries)
+            while pending and time.monotonic() < deadline:
+                still = []
+                for e in pending:
+                    node_id = self.head_service.node_id_for_token(e[1])
+                    if node_id is not None:
+                        e[3] = node_id
+                        continue
+                    if e[0].poll() is not None:
+                        raise RuntimeError(
+                            f"node_host {e[2]!r} exited with "
+                            f"{e[0].returncode} before registering")
+                    still.append(e)
+                pending = still
+                if pending:
+                    time.sleep(0.02)
+            if pending:
+                raise TimeoutError(
+                    f"{len(pending)}/{len(entries)} remote nodes failed "
+                    f"to register within {timeout}s (first: "
+                    f"{pending[0][2]!r})")
+        except Exception:
+            from ray_tpu._private.debug import swallow
+            for proc, _tok, _name, node_id in entries:
+                if node_id is None:
+                    try:
+                        proc.kill()
+                    except Exception as kill_err:
+                        swallow.noted("cluster.add_remote_nodes.kill",
+                                      kill_err)
+            raise
+        handles = [RemoteNodeHandle(self, proc, node_id, name)
+                   for proc, _tok, name, node_id in entries]
         with self._lock:
-            self._remote_procs.append(handle)
-        return handle
+            self._remote_procs.extend(handles)
+        return handles
 
     def remove_node(self, raylet: Raylet, graceful: bool = True):
         with self._lock:
@@ -211,6 +262,14 @@ class Cluster:
             self.head_service.stop()
             self.head_service = None
         self.gcs.shutdown()
+        try:
+            # Clean shutdown: drop this (driver/head) process's crash
+            # files — evidence already surfaced; the disk copy exists
+            # for SIGKILL forensics, which this is not.
+            from ray_tpu._private.debug import watchdog
+            watchdog.prune_own_crash_files()
+        except Exception as e:
+            swallow.noted("cluster.wedge_prune", e)
 
     def restart_gcs(self):
         """Kill and restart the control plane over the same persistent

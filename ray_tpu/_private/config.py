@@ -244,6 +244,17 @@ class Config:
     #: Maximum workers starting up concurrently (reference semantics:
     #: a throttle on spawns, NOT a total cap).
     maximum_startup_concurrency: int = 64
+    #: Process-wide (ALL pools in this OS process) cap on workers in
+    #: startup concurrently — the cluster-envelope startup-storm
+    #: throttle: per-node caps alone let N nodes × per-node cap spawns
+    #: land at once on one shared box.  A pop over the cap returns None
+    #: (the dispatch tick retries, same contract as the per-node cap).
+    #: 0 disables the global gate.
+    worker_global_startup_concurrency: int = 128
+    #: Stagger between consecutive background prestart spawns
+    #: (milliseconds) so a prestart storm ramps instead of spiking.
+    #: Only the throwaway prestart thread sleeps; pop_worker never does.
+    worker_startup_stagger_ms: float = 0.0
     #: Hard per-node worker cap (runaway backstop; the envelope needs
     #: thousands of dedicated actor workers, reference supports 10k+).
     max_workers_per_node: int = 20_000
@@ -277,6 +288,12 @@ class Config:
     #: Period of the GCS resource usage poll/broadcast loop
     #: (reference: ray_syncer.h broadcast thread).
     gcs_resource_broadcast_period_milliseconds: int = 100
+    #: Head-side registration admission: ``register_node`` handlers
+    #: running concurrently beyond this get ``{"busy": True,
+    #: "retry_after_ms"}`` instead of a proxy dial — fan-in
+    #: backpressure for a 64-host registration storm (the node host
+    #: retries with jittered backoff).  0 disables the gate.
+    head_registration_concurrency: int = 8
 
     # ------ misc ------
     event_loop_tick_ms: int = 5
@@ -306,6 +323,15 @@ class Config:
     #: bounds observability's share of the heartbeat channel so a span
     #: storm cannot congest the control plane at 64-node scale.
     timeline_ship_budget_bytes: int = 262_144
+    #: Shared per-beat byte budget for EVERYTHING observability ships
+    #: on the heartbeat channel (metrics deltas + timeline spans).  The
+    #: liveness beat itself is never charged: when a beat's payloads
+    #: would exceed the budget, the metrics delta is shed (the shipper
+    #: force-fulls so the next admitted report resyncs — deferral, not
+    #: loss) and the timeline shipper gets only the leftover budget —
+    #: congestion sheds telemetry, never liveness.  Shed bytes are
+    #: observable as ``ray_tpu_heartbeat_shed_bytes``.  0 = unbounded.
+    heartbeat_payload_budget_bytes: int = 1_048_576
 
     # ------ introspection plane (flight recorder / watchdog) ------
     #: Always-on per-process decision ring (debug.flight_recorder):
@@ -326,6 +352,13 @@ class Config:
     loop_stall_budget_s: float = 10.0
     #: Watchdog poll cadence (clamped to budget/4).
     watchdog_poll_interval_s: float = 0.5
+    #: Per-process cap on wedge/crash files kept in <temp_dir>/wedges:
+    #: after each write the oldest files beyond this are pruned (64
+    #: hosts under a chaos schedule otherwise grow the directory
+    #: without bound).  Dropped files are counted into the
+    #: introspection metrics; a clean shutdown removes this process's
+    #: remaining files.  0 = unbounded.
+    wedge_files_keep: int = 20
 
     @classmethod
     def from_env(cls, system_config: Optional[dict] = None) -> "Config":

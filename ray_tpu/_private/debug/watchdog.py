@@ -122,6 +122,7 @@ _beats: List[LoopBeat] = []
 _listeners: List[Callable] = []
 _reports: List[dict] = []
 _wedges_total = 0
+_crash_files_dropped = 0
 _thread: Optional[threading.Thread] = None
 _COLLECTOR_OWNER = None         # keeps the introspection collector alive
 
@@ -266,10 +267,67 @@ def _write_crash_file(report: dict) -> Optional[str]:
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(report, f, indent=1, default=str)
         os.replace(tmp, path)
+        _prune_crash_files(d, report["pid"])
         return path
     except Exception as e:
         swallow.noted("watchdog.crash_file", e)
         return None
+
+
+def _prune_crash_files(d: str, pid) -> None:
+    """Keep only the newest ``wedge_files_keep`` crash files THIS
+    process wrote (64 hosts under a chaos schedule otherwise grow the
+    wedge directory without bound).  Dropped files are counted — loss
+    of evidence is explicit, task-event-buffer semantics."""
+    global _crash_files_dropped
+    cfg = _config()
+    keep = getattr(cfg, "wedge_files_keep", 20) if cfg is not None else 20
+    if keep <= 0:
+        return
+    prefix = f"wedge-{pid}-"
+    try:
+        mine = [os.path.join(d, f) for f in os.listdir(d)
+                if f.startswith(prefix) and f.endswith(".json")]
+    except OSError:
+        return
+    if len(mine) <= keep:
+        return
+    mine.sort(key=lambda p: os.path.getmtime(p))
+    for victim in mine[:len(mine) - keep]:
+        try:
+            os.remove(victim)
+            with _lock:
+                _crash_files_dropped += 1
+        except OSError as e:
+            swallow.noted("watchdog.crash_prune", e)
+
+
+def crash_files_dropped() -> int:
+    """Crash files pruned by the per-process cap since process start."""
+    with _lock:
+        return _crash_files_dropped
+
+
+def prune_own_crash_files() -> int:
+    """Clean-shutdown hook: remove EVERY crash file this process wrote
+    (the reports already shipped to the head as they fired; the disk
+    copy exists for SIGKILL forensics, which a clean shutdown is not).
+    Returns how many files were removed."""
+    d = _crash_dir()
+    prefix = f"wedge-{os.getpid()}-"
+    removed = 0
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return 0
+    for f in names:
+        if f.startswith(prefix) and f.endswith(".json"):
+            try:
+                os.remove(os.path.join(d, f))
+                removed += 1
+            except OSError as e:
+                swallow.noted("watchdog.crash_prune", e)
+    return removed
 
 
 def _notify(event: str, report: dict) -> None:
@@ -404,6 +462,12 @@ def _render_introspection_metrics() -> None:
     reg.register("ray_tpu.watchdog.wedge_reports", "counter",
                  "wedge reports emitted since process start")
     reg.put_series("ray_tpu.watchdog.wedge_reports", (), float(total))
+    with _lock:
+        dropped = _crash_files_dropped
+    reg.register("ray_tpu.watchdog.crash_files_dropped", "counter",
+                 "crash files pruned by the per-process wedge cap")
+    reg.put_series("ray_tpu.watchdog.crash_files_dropped", (),
+                   float(dropped))
     # Lock contention histograms (sampled acquire-wait + hold time per
     # named lock; empty unless contention/witness mode armed).
     buckets = list(lock_order.CONTENTION_BUCKETS)

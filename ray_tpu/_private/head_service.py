@@ -151,6 +151,28 @@ class _ProxyObjectStore:
             "delete_object", {"object_id": object_id.binary()}, _ignore)
 
 
+def _merge_broadcast(pending: Optional[dict], batch: dict) -> dict:
+    """Fold a new resource broadcast into the batch already waiting
+    behind an in-flight send.  A FULL batch supersedes pending rows
+    wholesale; a delta layered on anything keeps the older coverage
+    (full stays full) with the newer rows winning.  Removals union —
+    a removal is an event, not a state — and the suspect set is pure
+    state, so latest wins."""
+    if pending is None:
+        return batch
+    if batch.get("full"):
+        rows, full = dict(batch["rows"]), True
+    else:
+        rows = dict(pending["rows"])
+        rows.update(batch["rows"])
+        full = bool(pending.get("full"))
+    removed = list(dict.fromkeys(
+        list(pending.get("removed") or []) +
+        list(batch.get("removed") or [])))
+    return {"rows": rows, "full": full, "removed": removed,
+            "suspect": list(batch.get("suspect") or [])}
+
+
 class RemoteNodeProxy:
     """Duck-types ``Raylet`` on the head for one NodeHost process.
 
@@ -193,6 +215,15 @@ class RemoteNodeProxy:
         # (reference ReleaseUnusedWorkers, node_manager.proto:312).
         self._held_tokens: set = set()
         self._tokens_lock = diag_lock("RemoteNodeProxy._tokens_lock")
+        # Resource-broadcast coalescing (64-node fan-out fix): at most
+        # ONE update_resource_usage RPC in flight per node; broadcasts
+        # arriving behind a slow send merge into a single pending batch
+        # instead of queueing unbounded RPCs on the node's wire.
+        self._bcast_lock = diag_lock("RemoteNodeProxy._bcast_lock")
+        self._bcast_inflight = False
+        self._bcast_pending: Optional[dict] = None
+        self.broadcasts_coalesced = 0
+        self.broadcasts_sent = 0
         self.client.on_reconnect = self._reconcile_leases
         # Periodic reconcile, not just on-reconnect: a lease the
         # client's bounded retry loop gave up on (the node's grant
@@ -229,7 +260,42 @@ class RemoteNodeProxy:
         return self._last_report
 
     def update_resource_usage(self, batch: dict):
-        self.client.call_async("update_resource_usage", batch, _ignore)
+        """Coalescing broadcast send: at most one RPC in flight.  A
+        batch arriving while a send is outstanding MERGES into the
+        pending batch (newest rows win, removals/suspects union/latest)
+        rather than stacking another async RPC behind a slow node —
+        under 64-node fan-out with one congested spoke the old path
+        accumulated unbounded in-flight broadcasts on that spoke's
+        wire while every healthy node waited on the same client."""
+        with self._bcast_lock:
+            if self._bcast_inflight:
+                self._bcast_pending = _merge_broadcast(
+                    self._bcast_pending, batch)
+                self.broadcasts_coalesced += 1
+                return
+            self._bcast_inflight = True
+        self._send_broadcast(batch)
+
+    def _send_broadcast(self, batch: dict):
+        def on_done(_result, _err):
+            # Errors are already swallowed by the async client path
+            # (same contract as the old fire-and-forget); what matters
+            # here is draining the pending batch exactly once.
+            with self._bcast_lock:
+                pending, self._bcast_pending = self._bcast_pending, None
+                if pending is None:
+                    self._bcast_inflight = False
+                    return
+            self._send_broadcast(pending)
+
+        self.broadcasts_sent += 1
+        try:
+            self.client.call_async("update_resource_usage", batch, on_done)
+        except Exception:
+            with self._bcast_lock:
+                self._bcast_inflight = False
+                self._bcast_pending = None
+            raise
 
     # ---- lease protocol ------------------------------------------------
     def _fence_grant(self, result: dict, token) -> bool:
@@ -418,6 +484,13 @@ class HeadService:
         # pulled directly.  The peer-to-peer plane keeps this at zero in
         # steady state; tests assert on it.
         self.relay_fetches = 0
+        # Registration admission (fan-in backpressure): count of
+        # register_node handlers currently running; over the config cap
+        # the handler replies busy instead of dialing a proxy, so a
+        # 64-host storm ramps in instead of piling 64 simultaneous
+        # connection setups + adoptions onto the dispatch pool.
+        self._registrations_active = 0
+        self.registrations_deferred = 0
         # Cluster-wide /metrics: every node_host's shipped registry
         # delta merges here under a node_id label; a dead node's series
         # are pruned with its federation entry.
@@ -539,6 +612,24 @@ class HeadService:
                 "incarnation": nm.current_incarnation(node_id)}
 
     def _handle_register_node(self, payload):
+        from ray_tpu._private.config import get_config
+        cap = get_config().head_registration_concurrency
+        with self._lock:
+            if cap > 0 and self._registrations_active >= cap:
+                self.registrations_deferred += 1
+                # Spread retries: base backoff plus a slot proportional
+                # to how deep the deferral queue is right now.
+                retry_ms = 50 + 25 * min(
+                    self.registrations_deferred % 32, 31)
+                return {"busy": True, "retry_after_ms": retry_ms}
+            self._registrations_active += 1
+        try:
+            return self._admit_register_node(payload)
+        finally:
+            with self._lock:
+                self._registrations_active -= 1
+
+    def _admit_register_node(self, payload):
         node_id = NodeID(payload["node_id"])
         proxy = RemoteNodeProxy(
             node_id, payload.get("node_name", ""),

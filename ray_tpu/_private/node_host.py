@@ -31,7 +31,7 @@ from ray_tpu._private.ids import NodeID, ObjectID
 from ray_tpu._private.object_store import MemoryStore
 from ray_tpu._private.serialization import (
     SerializedObject, loads_function, serialize)
-from ray_tpu.rpc import RpcClient, RpcServer
+from ray_tpu.rpc import RpcClient, RpcConnectionError, RpcServer
 from ray_tpu._private.debug import diag_lock
 
 
@@ -139,6 +139,7 @@ class _TimelineShipper:
         self.dropped = 0          # shipper-side queue overflow, cumulative
         self.shipped_bytes = 0
         self.shipped_batches = 0
+        self.windows_shed = 0     # windows skipped by the channel budget
 
     def _drain_into_pending(self):
         from ray_tpu.util import tracing
@@ -151,15 +152,26 @@ class _TimelineShipper:
             self._pending.popleft()
             self.dropped += 1
 
-    def ship(self) -> int:
+    def ship(self, budget_cap: Optional[int] = None) -> int:
         """One beat: refresh the budget, ship the prefix of pending
-        spans that fits, return the bytes shipped."""
+        spans that fits, return the bytes shipped.  ``budget_cap``
+        (heartbeat-channel congestion control) further clamps THIS
+        window's grant — the shared per-beat channel budget left after
+        higher-priority payloads (liveness is never charged, metrics
+        deltas go first).  A zero cap skips the window entirely: the
+        spans stay pending (bounded queue, drops counted), which is
+        shedding, not loss."""
         import pickle
 
         from ray_tpu._private.config import get_config
         from ray_tpu._private.metrics_agent import record_internal
         from ray_tpu.util import tracing
         per_beat = max(1, int(get_config().timeline_ship_budget_bytes))
+        if budget_cap is not None:
+            if budget_cap <= 0:
+                self.windows_shed += 1
+                return 0
+            per_beat = min(per_beat, int(budget_cap))
         self._budget = min(self._budget + per_beat,
                            per_beat * self._CARRYOVER_WINDOWS)
         self._drain_into_pending()
@@ -783,6 +795,11 @@ class NodeHost:
         self._last_metrics_ship = 0.0
         self._last_timeline_ship = 0.0
         self._timeline_shipper: Optional[_TimelineShipper] = None
+        #: Metrics deltas shed by the heartbeat-channel byte budget
+        #: (deferred + force-fulled, not lost) — the congestion
+        #: control's own observability, readable over the wire via
+        #: ``observability_stats``.
+        self.metrics_sheds = 0
         self.adapter = _RemoteClusterAdapter(self)
         store_bytes = resources.get("object_store_memory")
         self.raylet = Raylet(
@@ -821,6 +838,11 @@ class NodeHost:
         # (a chaos test whose fault never fired proves nothing).
         s.register("fault_fired",
                    lambda p: fault_injection.fired(p["point"]))
+        # Heartbeat-channel congestion-control counters: how much
+        # telemetry this node shed/shipped — the envelope's degradation
+        # proof reads this per node instead of hoping the (possibly
+        # shed) metrics plane delivered it.
+        s.register("observability_stats", self._handle_observability_stats)
         # Deterministic wire arming (chaos tests that need a fault
         # AFTER startup, where env-var count-skipping is unpredictable
         # — e.g. one loop.stall wedge once the node is registered, or a
@@ -865,8 +887,14 @@ class NodeHost:
     def _register(self, reg_token: str = ""):
         """(Re-)register with the head; one payload builder for both
         the initial join and the post-fence rebirth so their fields can
-        never drift apart."""
-        reply = self.client.call("register_node", {
+        never drift apart.  The head's admission gate
+        (``head_registration_concurrency``) may answer ``{"busy":
+        True, "retry_after_ms"}`` during a registration storm: honor
+        it with jittered backoff (deterministic per node id, so a
+        64-host storm fans out instead of re-colliding) until a
+        bounded deadline."""
+        import time
+        payload = {
             "node_id": self.raylet.node_id.binary(),
             "node_name": self.raylet.node_name,
             "resources": self.raylet.local_resources.to_float_dict("total"),
@@ -874,7 +902,41 @@ class NodeHost:
             "host": self.server.address[0],
             "port": self.server.address[1],
             "reg_token": reg_token,
-        }, timeout=30.0)
+        }
+        # Per-node deterministic jitter factor in [1.0, 1.5).
+        jitter = 1.0 + (self.raylet.node_id.binary()[0] % 128) / 256.0
+        # Short per-call timeout + long overall deadline: one congested
+        # call burns ~timeout × client-retries seconds, so a 30s call
+        # timeout leaves a 120s deadline room for barely one retry
+        # round.  10s × 3 attempts = 30s/round -> ~10 rounds in 300s,
+        # which rides out a 64-interpreter boot storm on a small box.
+        deadline = time.monotonic() + 300.0
+        conn_backoff_s = 0.25
+        while True:
+            try:
+                reply = self.client.call("register_node", dict(payload),
+                                         timeout=10.0)
+            except RpcConnectionError:
+                # A 64-host boot storm can starve the head (or this
+                # process) past the client's bounded retries before the
+                # admission gate even answers — that is the storm the
+                # gate exists for, so keep trying until the same
+                # deadline instead of dying on the first congested
+                # window.  register_node re-sends are safe: the head
+                # mints a fresh incarnation per registration and a
+                # node's own re-registration supersedes its prior one.
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(conn_backoff_s * jitter)
+                conn_backoff_s = min(conn_backoff_s * 2, 5.0)
+                continue
+            if not (isinstance(reply, dict) and reply.get("busy")):
+                break
+            if time.monotonic() >= deadline:
+                raise RuntimeError(
+                    "head deferred registration past the 300s "
+                    "admission deadline")
+            time.sleep(reply.get("retry_after_ms", 100) / 1000.0 * jitter)
         if isinstance(reply, dict) and reply.get("incarnation"):
             self.incarnation = reply["incarnation"]
         self.raylet.incarnation = self.incarnation
@@ -1131,8 +1193,22 @@ class NodeHost:
 
         from ray_tpu._private.config import get_config
         from ray_tpu._private.debug import swallow
+        if getattr(self, "raylet", None) is None:
+            # The raylet's heartbeat loop fires into this callback from
+            # inside the Raylet constructor — before ``self.raylet``
+            # is even bound on the host.  Nothing to ship yet.
+            return
         now = time.monotonic()
-        interval = get_config().metrics_report_interval_ms / 1000.0
+        cfg = get_config()
+        # Shared per-beat channel budget (congestion control): the
+        # liveness beat already went out un-charged; metrics deltas
+        # spend first, timeline spans get the remainder.  An
+        # over-budget metrics delta is SHED — not sent, shipper
+        # force-fulled so the next admitted report is a full resync
+        # (deferral with self-heal, never silent staleness).
+        budget = int(cfg.heartbeat_payload_budget_bytes)
+        remaining = budget if budget > 0 else None
+        interval = cfg.metrics_report_interval_ms / 1000.0
         if now - self._last_metrics_ship >= interval:
             self._last_metrics_ship = now
             try:
@@ -1142,13 +1218,6 @@ class NodeHost:
                 swallow.noted("node_host.metrics_delta", e)
                 delta, full = None, False
             if delta:
-                def on_report(result, err):
-                    # Lost or rejected report: the diff base already
-                    # counts it as shipped — resync fully next time so
-                    # settled series can't stay stale at the head.
-                    if err is not None or result is not True:
-                        self._metrics_shipper.force_full()
-
                 payload = self.stamp(
                     {"node_id": self.raylet.node_id.binary(),
                      "snapshot": delta, "full": full})
@@ -1160,22 +1229,51 @@ class NodeHost:
                 # frame-size hook), accepted because the metrics beat
                 # runs at metrics_report_interval_ms cadence (2s
                 # default) with steady-state deltas of a few KB — not
-                # a per-task path.
+                # a per-task path.  The same size now doubles as the
+                # budget charge, so it is computed BEFORE the send.
+                size = 0
                 try:
                     import pickle
-
-                    from ray_tpu._private.metrics_agent import \
-                        record_internal
-                    record_internal(
-                        "ray_tpu.heartbeat.payload_bytes",
-                        len(pickle.dumps(payload, protocol=4)),
-                        mtype="counter", kind="metrics",
-                        node=self.raylet.node_id.hex()[:12])
+                    size = len(pickle.dumps(payload, protocol=4))
                 except Exception as e:
                     swallow.noted("node_host.payload_telemetry", e)
-                self.client.call_async(
-                    "metrics_report", payload,
-                    self.fence_watch(on_report))
+                from ray_tpu._private.metrics_agent import record_internal
+                node_hex = self.raylet.node_id.hex()[:12]
+                if remaining is not None and size > remaining:
+                    # Over budget: shed the delta.  force_full() makes
+                    # the next admitted report a resync, so the head
+                    # converges once the channel decongests.
+                    self.metrics_sheds += 1
+                    self._metrics_shipper.force_full()
+                    try:
+                        record_internal(
+                            "ray_tpu.heartbeat.shed_bytes", size,
+                            mtype="counter", kind="metrics",
+                            node=node_hex)
+                    except Exception as e:
+                        swallow.noted("node_host.payload_telemetry", e)
+                else:
+                    if remaining is not None:
+                        remaining -= size
+
+                    def on_report(result, err):
+                        # Lost or rejected report: the diff base
+                        # already counts it as shipped — resync fully
+                        # next time so settled series can't stay stale
+                        # at the head.
+                        if err is not None or result is not True:
+                            self._metrics_shipper.force_full()
+
+                    try:
+                        record_internal(
+                            "ray_tpu.heartbeat.payload_bytes", size,
+                            mtype="counter", kind="metrics",
+                            node=node_hex)
+                    except Exception as e:
+                        swallow.noted("node_host.payload_telemetry", e)
+                    self.client.call_async(
+                        "metrics_report", payload,
+                        self.fence_watch(on_report))
         if now - self._last_timeline_ship >= 0.5:
             self._last_timeline_ship = now
             if self._timeline_shipper is None:
@@ -1185,9 +1283,21 @@ class NodeHost:
                     self.raylet.node_id.hex()[:12],
                     lambda: self.clock_sync.offset_s)
             try:
-                self._timeline_shipper.ship()
+                self._timeline_shipper.ship(budget_cap=remaining)
             except Exception as e:
                 swallow.noted("node_host.timeline_ship", e)
+
+    def _handle_observability_stats(self, _payload) -> dict:
+        ts = self._timeline_shipper
+        from ray_tpu._private import worker_pool as wp
+        return {
+            "metrics_sheds": self.metrics_sheds,
+            "timeline_shipped_bytes": ts.shipped_bytes if ts else 0,
+            "timeline_shipped_batches": ts.shipped_batches if ts else 0,
+            "timeline_windows_shed": ts.windows_shed if ts else 0,
+            "timeline_dropped": ts.dropped if ts else 0,
+            "worker_startup_throttled": wp.global_startup_throttled(),
+        }
 
     @property
     def _timeline_source(self) -> str:
@@ -1239,6 +1349,10 @@ class NodeHost:
         try:
             from ray_tpu._private.debug import watchdog as watchdog_mod
             watchdog_mod.remove_listener(self._wedge_listener)
+            # Clean shutdown: this process's wedge/crash files have
+            # been shipped to the head already — drop them so 64 hosts
+            # cycling under chaos can't grow <temp_dir>/wedges forever.
+            watchdog_mod.prune_own_crash_files()
         except Exception:
             pass
         try:

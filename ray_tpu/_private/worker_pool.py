@@ -778,6 +778,56 @@ class ProcessWorker:
             self.node.on_actor_worker_exit(self.actor_id, self.worker_id)
 
 
+# ---- process-wide startup gate (startup-storm throttle) -----------------
+# Per-node caps (`maximum_startup_concurrency`) bound ONE pool; a 64-node
+# envelope on a shared box is 64 pools spawning at once.  This gate caps
+# workers in startup across every pool in the OS process
+# (`worker_global_startup_concurrency`); a pop over the cap returns None
+# and the dispatch tick retries — exactly the per-node cap's contract,
+# applied fleet-wide.  Lock order: WorkerPool._lock may be held when the
+# gate is taken, never the reverse.
+_global_start_lock = diag_lock("worker_pool._global_start_lock")
+_global_starting = 0
+_global_throttled = 0
+
+
+def _acquire_global_start_slots(n: int) -> int:
+    """Claim up to ``n`` startup slots; returns how many were granted
+    (0 when the gate is saturated).  Shortfall counts as throttling.
+    The in-flight counter moves even with the gate disabled, so an
+    acquire/release pair stays symmetric across a config flip."""
+    global _global_starting, _global_throttled
+    if n <= 0:
+        return 0
+    cap = get_config().worker_global_startup_concurrency
+    with _global_start_lock:
+        granted = n if cap <= 0 else \
+            max(0, min(n, cap - _global_starting))
+        _global_starting += granted
+        if granted < n:
+            _global_throttled += n - granted
+    return granted
+
+
+def _release_global_start_slots(n: int):
+    global _global_starting
+    if n <= 0:
+        return
+    with _global_start_lock:
+        _global_starting = max(0, _global_starting - n)
+
+
+def global_startup_in_flight() -> int:
+    with _global_start_lock:
+        return _global_starting
+
+
+def global_startup_throttled() -> int:
+    """Cumulative pops/prestarts deferred by the process-wide gate."""
+    with _global_start_lock:
+        return _global_throttled
+
+
 class WorkerPool:
     def __init__(self, node):
         self._node = node
@@ -835,12 +885,20 @@ class WorkerPool:
             capacity = self._max_workers - len(self._all) - self._starting
             count = max(0, min(n, capacity,
                                self._max_starting - self._starting))
+            count = _acquire_global_start_slots(count)
             self._starting += count
+        stagger = get_config().worker_startup_stagger_ms / 1000.0
         created = []
         try:
-            for _ in range(count):
+            for i in range(count):
+                if i and stagger > 0:
+                    # Ramp, don't spike: only this background path
+                    # sleeps (prestart runs on a throwaway thread).
+                    import time
+                    time.sleep(stagger)
                 created.append(self._new_worker())
         finally:
+            _release_global_start_slots(count)
             with self._lock:
                 self._starting -= count
                 for w in created:
@@ -922,6 +980,8 @@ class WorkerPool:
             if total >= self._max_workers or \
                     self._starting >= self._max_starting:
                 return None      # caller retries on the dispatch tick
+            if _acquire_global_start_slots(1) < 1:
+                return None      # process-wide storm throttle; retried
             self._starting += 1
         # Construct OUTSIDE the lock: a process-mode spawn materializes
         # the runtime env (KV fetch + unzip) — holding the pool lock for
@@ -931,7 +991,9 @@ class WorkerPool:
         except BaseException:
             with self._lock:
                 self._starting -= 1
+            _release_global_start_slots(1)
             raise
+        _release_global_start_slots(1)
         with self._lock:
             self._starting -= 1
             self._all[w.worker_id] = w

@@ -242,24 +242,40 @@ class LocalProcessProvider(NodeProvider):
     def internal_ip(self, node_id):
         return node_id[:12]
 
-    def create_node(self, node_config, tags, count):
+    def create_node(self, node_config, tags, count,
+                    timeout: float = 120.0,
+                    spawn_interval_s: float = 0.0):
         node_type = tags.get(TAG_NODE_TYPE)
         resources = dict(
             (node_config or {}).get("resources") or
             self.node_types.get(node_type, {}).get("resources",
                                                    {"CPU": 1}))
-        for _ in range(count):
-            handle = self.cluster.add_remote_node(
-                num_cpus=resources.get("CPU", 0),
-                num_tpus=resources.get("TPU", 0),
-                memory=resources.get("memory"),
-                resources={k: v for k, v in resources.items()
-                           if k not in ("CPU", "TPU", "memory")})
+        spec = dict(
+            num_cpus=resources.get("CPU", 0),
+            num_tpus=resources.get("TPU", 0),
+            memory=resources.get("memory"),
+            object_store_memory=(node_config or {}).get(
+                "object_store_memory"),
+            resources={k: v for k, v in resources.items()
+                       if k not in ("CPU", "TPU", "memory")})
+        # Spawn-all-then-wait-all: a 50–64-host fleet stands up in one
+        # registration storm (the head's admission gate absorbs the
+        # fan-in) instead of serial spawn×poll round trips.
+        # ``spawn_interval_s`` optionally paces the Popen calls — on a
+        # box with fewer cores than hosts, 50 interpreters booting at
+        # once starve the head of the very CPU it needs to ANSWER the
+        # registrations (boot-storm analogue of the worker-pool
+        # startup stagger).
+        handles = self.cluster.add_remote_nodes(
+            [dict(spec) for _ in range(count)], timeout=timeout,
+            spawn_interval_s=spawn_interval_s)
+        for handle in handles:
             nid = handle.node_id.hex()
             with self.lock:
                 self._handles[nid] = handle
                 self._tags[nid] = dict(tags)
                 self._tags[nid][TAG_NODE_STATUS] = STATUS_UP_TO_DATE
+        return handles
 
     def terminate_node(self, node_id):
         with self.lock:

@@ -41,6 +41,7 @@ IDEMPOTENT_VERBS = frozenset({
     "get_resource_report",
     "fetch_object",
     "fault_fired",
+    "observability_stats",
 })
 
 #: Mutating verbs: retried only under a server-side dedup window keyed

@@ -198,18 +198,38 @@ class ClusterResourceView:
         return self._columns
 
     # ---- node membership ------------------------------------------------
+    @staticmethod
+    def _snapshot(resources: NodeResources):
+        """Copy ``(total, available)`` off a possibly LIVE ledger.
+
+        Raylets hand the view their actual ``NodeResources`` and keep
+        mutating it from other threads (PG bundle commit/cancel adds
+        and removes formatted resource keys), so iterating — or even
+        ``dict()``-copying — the live dicts can die with "dictionary
+        changed size during iteration".  The view's own lock cannot
+        guard a foreign object; retry the copy until it lands between
+        mutations (the window is a few microseconds).
+        """
+        for _ in range(1000):
+            try:
+                return dict(resources.total), dict(resources.available)
+            except RuntimeError:
+                continue
+        return dict(resources.total), dict(resources.available)
+
     def add_node(self, node_id, resources: NodeResources):
         with self._lock:
             if node_id in self._node_index:
                 self.update_node(node_id, resources)
                 return
-            for name in resources.total:
+            total, avail = self._snapshot(resources)
+            for name in total:
                 self._column(name)
             row_t = np.zeros((1, len(self._columns)), dtype=np.float32)
             row_a = np.zeros((1, len(self._columns)), dtype=np.float32)
-            for name, v in resources.total.items():
+            for name, v in total.items():
                 row_t[0, self._columns[name]] = v / FP_SCALE
-            for name, v in resources.available.items():
+            for name, v in avail.items():
                 row_a[0, self._columns[name]] = v / FP_SCALE
             self._node_index[node_id] = len(self._node_ids)
             self._node_ids.append(node_id)
@@ -243,13 +263,14 @@ class ClusterResourceView:
                 self.add_node(node_id, resources)
                 return
             self._nodes[node_id] = resources
-            for name in resources.total:
+            total, avail = self._snapshot(resources)
+            for name in total:
                 self._column(name)
             self._total[idx, :] = 0.0
             self._avail[idx, :] = 0.0
-            for name, v in resources.total.items():
+            for name, v in total.items():
                 self._total[idx, self._columns[name]] = v / FP_SCALE
-            for name, v in resources.available.items():
+            for name, v in avail.items():
                 self._avail[idx, self._columns[name]] = v / FP_SCALE
             # Totals changed: structural for the device mirror.
             self.version += 1

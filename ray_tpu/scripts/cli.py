@@ -250,6 +250,26 @@ def cmd_list(args) -> int:
     if args.output == "json":
         print(json.dumps(rows, default=str, indent=2))
         return 0
+    if getattr(args, "summary", False) and args.resource == "nodes":
+        by_state = {}
+        fenced_total = 0
+        offenders = []
+        for row in rows:
+            state = row.get("state", "?")
+            by_state[state] = by_state.get(state, 0) + 1
+            fenced = int(row.get("fenced_rejections", 0) or 0)
+            fenced_total += fenced
+            if fenced:
+                offenders.append((fenced, row.get("node_id", "")[:16]))
+        states = " ".join(f"{s}={n}" for s, n in sorted(by_state.items()))
+        print(f"{len(rows)} nodes ({states or 'none'})  "
+              f"fenced_rejections={fenced_total}")
+        offenders.sort(reverse=True)
+        for fenced, nid in offenders[:5]:
+            print(f"  {nid}: fenced_rejections={fenced}")
+        if len(offenders) > 5:
+            print(f"  ... and {len(offenders) - 5} more")
+        return 0
     columns = {
         "tasks": ("task_id", "name", "state", "attempt", "node_id",
                   "duration_s"),
@@ -407,6 +427,10 @@ def cmd_doctor(args) -> int:
         print(json.dumps(dump, default=str, indent=2))
         return 0
     liveness = dump.get("liveness") or {}
+    membership = dump.get("membership") or {}
+    if args.summary:
+        _render_doctor_summary(dump, liveness, membership)
+        return 0
     degraded = sorted(n for n, st in liveness.items()
                       if st.get("degraded"))
     print(f"nodes: {len(dump.get('nodes', {}))} remote + head; "
@@ -415,10 +439,13 @@ def cmd_doctor(args) -> int:
     for node, st in sorted(liveness.items()):
         print(f"  {node}: {'DEGRADED' if st.get('degraded') else 'ok'} "
               f"(wedges={st.get('wedges', 0)})")
-    membership = dump.get("membership") or {}
     if membership:
+        # Paginate: at 64 nodes the full roster drowns the report.
+        rows = sorted(membership.items())
+        shown = rows[:max(0, args.max_nodes)] \
+            if args.max_nodes > 0 else rows
         print("membership (heartbeat plane):")
-        for node, st in sorted(membership.items()):
+        for node, st in shown:
             fenced = st.get("fenced_rejections", 0)
             extra = ""
             if fenced:
@@ -428,11 +455,54 @@ def cmd_doctor(args) -> int:
                 extra = f" fenced_rejections={fenced} ({detail})"
             print(f"  {node}: {st.get('state'):8} "
                   f"incarnation={st.get('incarnation', 0)}{extra}")
+        if len(rows) > len(shown):
+            print(f"  ... and {len(rows) - len(shown)} more "
+                  f"(--max-nodes to widen, --summary for the rollup)")
     _render_process_report("head", dump.get("head") or {}, args.tail)
-    for node_hex, report in sorted((dump.get("nodes") or {}).items()):
+    node_reports = sorted((dump.get("nodes") or {}).items())
+    shown_reports = node_reports[:max(0, args.max_nodes)] \
+        if args.max_nodes > 0 else node_reports
+    for node_hex, report in shown_reports:
         _render_process_report(f"node {node_hex}", report or {},
                                args.tail)
+    if len(node_reports) > len(shown_reports):
+        print(f"\n... and {len(node_reports) - len(shown_reports)} more "
+              f"node reports (--max-nodes to widen)")
     return 0
+
+
+def _render_doctor_summary(dump, liveness, membership) -> None:
+    """64-node rollup: counts by state, fenced totals, top-5 offenders
+    — the at-a-glance shape of the fleet instead of 64 full rows."""
+    by_state = {}
+    fenced_total = 0
+    offenders = []           # (score, node, detail)
+    for node, st in membership.items():
+        state = st.get("state", "?")
+        by_state[state] = by_state.get(state, 0) + 1
+        fenced = st.get("fenced_rejections", 0)
+        fenced_total += fenced
+        wedges = (liveness.get(node) or {}).get("wedges", 0)
+        degraded = bool((liveness.get(node) or {}).get("degraded"))
+        score = fenced + 10 * wedges + (100 if degraded else 0)
+        if score:
+            offenders.append((score, node,
+                              f"fenced={fenced} wedges={wedges}"
+                              + (" DEGRADED" if degraded else "")))
+    degraded_n = sum(1 for st in liveness.values() if st.get("degraded"))
+    states = " ".join(f"{s}={n}" for s, n in sorted(by_state.items()))
+    print(f"fleet: {len(membership)} nodes ({states or 'none'})  "
+          f"fenced_rejections={fenced_total}  "
+          f"degraded_loops={degraded_n}")
+    offenders.sort(reverse=True)
+    if offenders:
+        print("top offenders:")
+        for _score, node, detail in offenders[:5]:
+            print(f"  {node}: {detail}")
+        if len(offenders) > 5:
+            print(f"  ... and {len(offenders) - 5} more")
+    else:
+        print("top offenders: none")
 
 
 def cmd_stacks(args) -> int:
@@ -740,6 +810,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="e.g. --filter state=FINISHED (also KEY!=VALUE)")
     p.add_argument("--limit", type=int, default=100)
     p.add_argument("--offset", type=int, default=0)
+    p.add_argument("--summary", action="store_true",
+                   help="nodes only: state/fenced rollup + top-5 "
+                        "offenders instead of one row per node")
     p.add_argument("--output", choices=["table", "json"], default="table")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_list)
@@ -762,6 +835,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--output", choices=["table", "json"], default="table")
     p.add_argument("--tail", type=int, default=20,
                    help="flight-recorder events shown per process")
+    p.add_argument("--summary", action="store_true",
+                   help="one-screen fleet rollup: counts by state, "
+                        "fenced totals, top-5 offenders")
+    p.add_argument("--max-nodes", type=int, default=16,
+                   help="membership/report rows shown before "
+                        "pagination (0 = unlimited)")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_doctor)
 
@@ -804,10 +883,42 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("down", help="shut the head down")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_down)
+
+    p = sub.add_parser(
+        "envelope",
+        help="cluster-scale envelope / chaos soak: stand up a fleet "
+             "of node-host processes, drive actors + PGs + relay "
+             "broadcasts under a seeded fault schedule",
+        add_help=False)
+    p.add_argument("rest", nargs=argparse.REMAINDER,
+                   help="forwarded to the envelope driver "
+                        "(see `ray-tpu envelope --help`)")
+    p.set_defaults(fn=cmd_envelope)
     return parser
 
 
+def cmd_envelope(args) -> int:
+    """Delegate to the envelope driver's own argparse (it owns its many
+    knobs); ``main()`` normally short-circuits before parsing, so this
+    only fires for programmatic build_parser() callers."""
+    from ray_tpu._private.envelope import main as envelope_main
+    rest = list(args.rest or [])
+    if rest and rest[0] == "--":
+        rest = rest[1:]
+    return envelope_main(rest)
+
+
 def main(argv=None) -> int:
+    import sys as _sys
+    argv = list(_sys.argv[1:]) if argv is None else list(argv)
+    if argv and argv[0] == "envelope":
+        # The envelope driver owns its (many) flags: forward everything
+        # verbatim — argparse REMAINDER can't start with an optional.
+        from ray_tpu._private.envelope import main as envelope_main
+        rest = argv[1:]
+        if rest and rest[0] == "--":
+            rest = rest[1:]
+        return envelope_main(rest)
     args = build_parser().parse_args(argv)
     entry = list(getattr(args, "entrypoint", []) or [])
     if entry and entry[0] == "--":
