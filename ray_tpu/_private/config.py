@@ -35,9 +35,6 @@ class Config:
     #:            device-resident world state and validated native
     #:            fallback.  Default since round 3.
     scheduler_backend: str = "jax"
-    #: Hybrid policy considers the top-k best nodes and picks randomly among
-    #: them (reference: hybrid_scheduling_policy.cc top-k behavior).
-    scheduler_top_k_fraction: float = 0.2
 
     #: Fuse the per-class waterfill into one Mosaic (Pallas) kernel on
     #: TPU; falls back to the jnp scan path automatically on failure.
@@ -239,8 +236,6 @@ class Config:
     #: scheduling, so workers warm while the solve runs.  No effect
     #: unless num_prestart_workers > 0.
     prestart_on_submit: bool = False
-    #: Seconds an idle worker thread lingers before exit.
-    idle_worker_killing_time_threshold_ms: int = 1000
     #: Maximum workers starting up concurrently (reference semantics:
     #: a throttle on spawns, NOT a total cap).
     maximum_startup_concurrency: int = 64
@@ -284,7 +279,6 @@ class Config:
 
     # ------ GCS ------
     gcs_storage_backend: str = "memory"  # "memory" | "file"
-    gcs_rpc_server_reconnect_timeout_s: int = 60
     #: Period of the GCS resource usage poll/broadcast loop
     #: (reference: ray_syncer.h broadcast thread).
     gcs_resource_broadcast_period_milliseconds: int = 100
@@ -297,7 +291,6 @@ class Config:
 
     # ------ misc ------
     event_loop_tick_ms: int = 5
-    debug_dump_period_milliseconds: int = 10_000
     metrics_report_interval_ms: int = 2_000
     temp_dir: str = "/tmp/ray_tpu"
     #: Enable OpenTelemetry-style span capture (tracing_helper.py parity).

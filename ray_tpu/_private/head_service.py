@@ -988,7 +988,10 @@ class HeadService:
         return directory.add_partial_location(
             ObjectID(payload["object_id"]), NodeID(payload["node_id"]))
 
-    def _handle_remove_partial_location(self, payload) -> bool:
+    def _handle_remove_partial_location(self, payload):
+        fenced = self._fence_gate(payload, "remove_partial_location")
+        if fenced is not None:
+            return fenced
         directory = self._cluster.object_directory
         if hasattr(directory, "remove_partial_location"):
             directory.remove_partial_location(

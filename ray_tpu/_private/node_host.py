@@ -511,11 +511,16 @@ class _RemoteDirectory:
 
     def remove_partial_location(self, object_id: ObjectID,
                                 node_id: NodeID):
+        # Stamped like every other head-bound directory write: an
+        # un-stamped removal from a STALE incarnation could erase the
+        # live incarnation's in-flight PARTIAL row (graftcheck R10
+        # caught this as the one directory verb missing the fence).
         self._host.client.call_async(
             "remove_partial_location",
-            {"object_id": object_id.binary(),
-             "node_id": node_id.binary()},
-            lambda _r, _e: None)
+            self._host.stamp(
+                {"object_id": object_id.binary(),
+                 "node_id": node_id.binary()}),
+            self._host.fence_watch())
 
     def remove_object(self, object_id):
         pass

@@ -34,9 +34,29 @@ class TaskManager:
         self._lock = diag_rlock("TaskManager._lock")
         self._pending: Dict[TaskID, _PendingTask] = {}
         # Lineage: task specs pinned while their return objects may need
-        # reconstruction (reference: TaskManager lineage map).
+        # reconstruction (reference: TaskManager lineage map), bounded
+        # by ``max_lineage_bytes`` of inlined-arg payload: beyond the
+        # budget the OLDEST pins are dropped (insertion order), so the
+        # newest — most likely still needed — lineage survives.  An
+        # evicted spec makes its objects non-reconstructable, exactly
+        # the doctor's "lineage=evicted" hint.
         self._lineage: Dict[TaskID, TaskSpec] = {}
+        self._lineage_sizes: Dict[TaskID, int] = {}
+        self._lineage_bytes = 0
         self._completion_cv = diag_condition(self._lock, name="TaskManager._lock")
+
+    @staticmethod
+    def _spec_lineage_bytes(spec: TaskSpec) -> int:
+        """Approximate pinned footprint: inlined serialized args + a
+        flat per-spec overhead for the metadata fields."""
+        total = 512
+        for arg in spec.args:
+            v = getattr(arg, "value", None)
+            if arg.is_inline and v is not None:
+                total += len(getattr(v, "inband", b"") or b"")
+                for buf in getattr(v, "buffers", ()) or ():
+                    total += getattr(buf, "nbytes", 0)
+        return total
 
     # ---- submission lifecycle ------------------------------------------
     def add_pending_task(self, spec: TaskSpec) -> None:
@@ -44,7 +64,17 @@ class TaskManager:
         with self._lock:
             self._pending[spec.task_id] = _PendingTask(spec, spec.max_retries)
             if cfg.lineage_pinning_enabled:
+                sz = self._spec_lineage_bytes(spec)
                 self._lineage[spec.task_id] = spec
+                self._lineage_sizes[spec.task_id] = sz
+                self._lineage_bytes += sz
+                budget = cfg.max_lineage_bytes
+                while self._lineage_bytes > budget and len(self._lineage) > 1:
+                    oldest = next(iter(self._lineage))
+                    if oldest == spec.task_id:
+                        break
+                    self._lineage.pop(oldest)
+                    self._lineage_bytes -= self._lineage_sizes.pop(oldest, 0)
         # Register owned return objects with lineage pointers.
         rc = self._core.reference_counter
         for oid in spec.return_ids:
@@ -147,7 +177,8 @@ class TaskManager:
 
     def evict_lineage(self, task_id: TaskID):
         with self._lock:
-            self._lineage.pop(task_id, None)
+            if self._lineage.pop(task_id, None) is not None:
+                self._lineage_bytes -= self._lineage_sizes.pop(task_id, 0)
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Block until no tasks are pending (driver exit parity)."""

@@ -77,6 +77,12 @@ class TestRules:
         ("r7_swallow.py", "R7"),
         ("r7_fanout.py", "R7"),
         ("r8_bare_lock.py", "R8"),
+        ("r9_verb_class.py", "R9"),
+        ("r10_fence.py", "R10"),
+        ("r11_fault.py", "R11"),
+        ("r12_knobs.py", "R12"),
+        ("r13_metrics.py", "R13"),
+        ("r14_stripes.py", "R14"),
     ])
     def test_fixture_trips_rule(self, fixture, rule):
         path = os.path.join(FIXTURES, fixture)
@@ -87,6 +93,8 @@ class TestRules:
     @pytest.mark.parametrize("fixture", [
         "r1_lock_order.py", "r2_blocking.py", "r3_aliasing.py",
         "r4_loop_affinity.py", "r5_refcount.py", "r8_bare_lock.py",
+        "r9_verb_class.py", "r10_fence.py", "r11_fault.py",
+        "r12_knobs.py", "r13_metrics.py", "r14_stripes.py",
     ])
     def test_cli_exits_nonzero_on_fixture(self, fixture):
         proc = subprocess.run(
@@ -205,6 +213,149 @@ class TestRules:
         a.write_text("# shifted\n# down\n" + src)
         fp2 = _run_on([str(a)], select={"R2"})[0].fingerprint
         assert fp1 == fp2
+
+
+class TestProtocolRules:
+    """R9-R14 (ISSUE 19): each distributed-protocol rule has a positive
+    fixture tripping exactly the expected details and a negative
+    contrast (the corrected protocol) that stays clean."""
+
+    @pytest.mark.parametrize("fixture,rule,details", [
+        ("r9_verb_class.py", "R9",
+         {"unclassified:drop_row", "ghost:renamed_away"}),
+        ("r10_fence.py", "R10", {"unfenced:row_remove"}),
+        ("r11_fault.py", "R11", {"dead_point:store.spil"}),
+        ("r12_knobs.py", "R12",
+         {"undeclared_knob:flush_batch_size", "dead_knob:flush_batch_max"}),
+        ("r13_metrics.py", "R13",
+         {"metric_type_conflict:app.requests:counter/gauge",
+          "dead_metric_read:app.request_total",
+          "mangle_collision:app_rate_limit_hits"}),
+        ("r14_stripes.py", "R14",
+         {"stripe_name:ShardedTable._aux[s?]",
+          "stripe_nest:ShardedTable._lock:ShardedTable.move_nested",
+          "stripe_call:ShardedTable._lock:ShardedTable.move_via_call"
+          "->_put"}),
+    ])
+    def test_positive_fixture_details(self, fixture, rule, details):
+        findings = _run_on([os.path.join(FIXTURES, fixture)],
+                           select={rule})
+        assert {f.detail for f in findings} == details, \
+            [f.render() for f in findings]
+
+    @pytest.mark.parametrize("fixture,rule", [
+        ("r9_verb_class_ok.py", "R9"),
+        ("r10_fence_ok.py", "R10"),
+        ("r11_fault_ok.py", "R11"),
+        ("r12_knobs_ok.py", "R12"),
+        ("r13_metrics_ok.py", "R13"),
+        ("r14_stripes_ok.py", "R14"),
+    ])
+    def test_negative_contrast_is_clean(self, fixture, rule):
+        findings = _run_on([os.path.join(FIXTURES, fixture)],
+                           select={rule})
+        assert not findings, [f.render() for f in findings]
+
+    # A node-host spawner arming a fault point over the wire, the shape
+    # chaos drivers use.  Key and value stay inside this ONE literal so
+    # the tier-1 gate's env scanner never reads the deliberate typo out
+    # of this test file's own source.
+    _SPAWNER = (
+        'import os\n'
+        'import subprocess\n'
+        '\n'
+        '\n'
+        'def spawn_node_host(binary, node_id):\n'
+        '    env = dict(os.environ)\n'
+        '    env["RAY_TPU_FAULT_POINTS"] = "node.heartbeatt:error:-1"\n'
+        '    return subprocess.Popen([binary, "--node-id", node_id],\n'
+        '                            env=env)\n')
+
+    def test_r11_catches_typod_arm_in_spawned_node_host(self, tmp_path):
+        """The e2e shape R11 exists for: a chaos driver spawns a node
+        host with a typo'd RAY_TPU_FAULT_POINTS spec.  Dynamically the
+        run passes vacuously (the point never fires, nothing fails);
+        statically the armed name has no hook site anywhere, so R11
+        flags it before the soak ever runs."""
+        raylet = os.path.join(REPO, "ray_tpu", "_private", "raylet.py")
+        bad = tmp_path / "spawn_host.py"
+        bad.write_text(self._SPAWNER)
+        findings = _run_on([str(bad), raylet], select={"R11"})
+        assert any(f.detail == "dead_point:node.heartbeatt"
+                   for f in findings), [f.render() for f in findings]
+        # Fix the typo: the arm now names a live hook site and the
+        # finding disappears.
+        bad.write_text(self._SPAWNER.replace("heartbeatt", "heartbeat"))
+        findings = _run_on([str(bad), raylet], select={"R11"})
+        assert not any("node.heartbeat" in f.detail for f in findings), \
+            [f.render() for f in findings]
+
+    def test_pragma_suppresses_a_protocol_finding(self, tmp_path):
+        src = ('from ray_tpu._private import fault_injection\n'
+               '\n'
+               'def chaos_case():\n'
+               '    fault_injection.arm("synthetic.point", "error")\n')
+        p = tmp_path / "armed.py"
+        p.write_text(src)
+        assert _run_on([str(p)], select={"R11"}), "arm must trip first"
+        p.write_text(src.replace(
+            '    fault_injection.arm',
+            '    # graftcheck: ok R11 synthetic point for injector test\n'
+            '    fault_injection.arm'))
+        assert not _run_on([str(p)], select={"R11"})
+        # The pragma is rule-scoped: an R9 pragma would not suppress R11.
+        p.write_text(src.replace(
+            '    fault_injection.arm',
+            '    # graftcheck: ok R9 wrong rule\n'
+            '    fault_injection.arm'))
+        assert _run_on([str(p)], select={"R11"})
+
+
+class TestCLI:
+    def test_json_output_is_machine_readable(self):
+        import json
+        proc = subprocess.run(
+            [sys.executable, "-m", "graftcheck", "--json", "--no-baseline",
+             os.path.join(FIXTURES, "r11_fault.py")],
+            cwd=REPO, capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 1
+        doc = json.loads(proc.stdout)
+        assert set(doc) == {"new", "baselined", "stale"}
+        assert any(f["rule"] == "R11" and f["fingerprint"]
+                   for f in doc["new"])
+
+    def test_rule_filter_narrows_the_run(self):
+        import json
+        fixture = os.path.join(FIXTURES, "r13_metrics.py")
+        proc = subprocess.run(
+            [sys.executable, "-m", "graftcheck", "--json", "--no-baseline",
+             "--rule", "R13", fixture],
+            cwd=REPO, capture_output=True, text=True, timeout=60)
+        doc = json.loads(proc.stdout)
+        assert doc["new"] and all(f["rule"] == "R13" for f in doc["new"])
+        # The same fixture under a disjoint rule is silent.
+        proc = subprocess.run(
+            [sys.executable, "-m", "graftcheck", "--json", "--no-baseline",
+             "--rule", "R1", fixture],
+            cwd=REPO, capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0
+        assert not json.loads(proc.stdout)["new"]
+
+    def test_changed_only_rejects_explicit_paths(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "graftcheck", "--changed-only",
+             os.path.join(FIXTURES, "r11_fault.py")],
+            cwd=REPO, capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 2
+
+    def test_full_sweep_fits_the_runtime_budget(self):
+        """graftcheck rides inside tier-1: the whole-tree sweep
+        (R1-R14, protocol registries over ray_tpu + tests + tools)
+        must stay under 30 s or it gets evicted from the gate."""
+        start = time.monotonic()
+        _run_on([os.path.join(REPO, "ray_tpu")])
+        elapsed = time.monotonic() - start
+        assert elapsed <= 30.0, f"full sweep took {elapsed:.1f}s"
 
 
 @pytest.fixture

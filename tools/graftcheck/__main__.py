@@ -23,6 +23,25 @@ def _repo_root() -> str:
         os.path.dirname(os.path.realpath(__file__))))
 
 
+def _changed_py_files(root: str):
+    """Working-tree .py files changed vs HEAD: unstaged + staged +
+    untracked.  The pre-commit fast path — rule passes run only on
+    these, while the R9-R14 protocol registries stay whole-repo."""
+    import subprocess
+    names = set()
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            out = subprocess.run(cmd, cwd=root, capture_output=True,
+                                 text=True, timeout=30).stdout
+        except (OSError, subprocess.SubprocessError):
+            return []
+        names.update(out.splitlines())
+    return sorted(os.path.join(root, n) for n in names
+                  if n.endswith(".py") and
+                  os.path.exists(os.path.join(root, n)))
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="graftcheck",
@@ -40,6 +59,18 @@ def main(argv=None) -> int:
                          "(the ratchet check used by tests)")
     ap.add_argument("--rules", default="",
                     help="comma-separated subset, e.g. R1,R2")
+    ap.add_argument("--rule", action="append", default=[],
+                    metavar="RN",
+                    help="run a single rule (repeatable; combines with "
+                         "--rules)")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="pre-commit fast path: analyze only files "
+                         "changed vs HEAD (git diff + staged + "
+                         "untracked); protocol registries (R9-R14) "
+                         "stay whole-repo so cross-checks remain "
+                         "global, and stale-entry reporting is "
+                         "skipped (the subset can't see every "
+                         "baselined finding)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable output")
     ap.add_argument("--list-rules", action="store_true")
@@ -51,7 +82,18 @@ def main(argv=None) -> int:
         return 0
 
     root = _repo_root()
-    paths = args.paths or [os.path.join(root, "ray_tpu")]
+    if args.changed_only:
+        if args.paths:
+            print("graftcheck: --changed-only computes its own file "
+                  "set; don't pass paths with it", file=sys.stderr)
+            return 2
+        paths = _changed_py_files(root)
+        if not paths:
+            print("graftcheck: 0 new finding(s) (no changed .py files)",
+                  file=sys.stderr)
+            return 0
+    else:
+        paths = args.paths or [os.path.join(root, "ray_tpu")]
     paths = [os.path.abspath(p) for p in paths]
     for p in paths:
         if not os.path.exists(p):
@@ -59,10 +101,13 @@ def main(argv=None) -> int:
             return 2
 
     selected = {r.strip().upper() for r in args.rules.split(",")
-                if r.strip()} or None
+                if r.strip()}
+    selected |= {r.strip().upper() for r in args.rule if r.strip()}
+    selected = selected or None
     prog, parse_errors = analyzer.load_program(paths, root)
-    findings = parse_errors + rules.run_all(prog, paths, root,
-                                            rules=selected)
+    findings = parse_errors + rules.run_all(
+        prog, paths, root, rules=selected,
+        global_protocol=args.changed_only)
 
     if args.update_baseline:
         prev = baseline_mod.load(args.baseline)
@@ -73,6 +118,10 @@ def main(argv=None) -> int:
 
     base = {} if args.no_baseline else baseline_mod.load(args.baseline)
     new, stale = baseline_mod.split(findings, base)
+    if args.changed_only:
+        # A diff-scoped run can't see most baselined findings, so every
+        # untouched entry would read as "stale" — meaningless here.
+        stale = []
 
     if args.as_json:
         print(json.dumps({

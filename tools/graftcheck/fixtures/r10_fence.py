@@ -1,0 +1,43 @@
+"""R10 positive fixture: two node-stamped head-bound verbs, only one
+of which the head fence-gates — the other would apply a stale
+incarnation's send."""
+
+
+class NodeSide:
+    def __init__(self, client):
+        self.client = client
+        self.node_id = b"n1"
+        self.incarnation = 1
+
+    def stamp(self, payload):
+        payload["node_id"] = self.node_id
+        payload["incarnation"] = self.incarnation
+        return payload
+
+    def report(self):
+        self.client.call("row_report", self.stamp({"rows": 1}))
+
+    def remove(self):
+        # stamped, but the head never gates "row_remove":
+        self.client.call("row_remove", self.stamp({"rows": 0}))
+
+
+class HeadSide:
+    def __init__(self):
+        self._rows = {}
+
+    def _fence_gate(self, payload, verb):
+        if payload.get("incarnation", -1) < 1:
+            return {"fenced": True}
+        return None
+
+    def _handle_row_report(self, payload):
+        fenced = self._fence_gate(payload, "row_report")
+        if fenced is not None:
+            return fenced
+        self._rows["n"] = payload["rows"]
+        return True
+
+    def _handle_row_remove(self, payload):
+        self._rows.pop("n", None)
+        return True

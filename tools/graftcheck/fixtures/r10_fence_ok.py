@@ -1,0 +1,45 @@
+"""R10 negative contrast: every stamped verb is fence-gated, and every
+gate checks a verb some sender actually stamps."""
+
+
+class NodeSide:
+    def __init__(self, client):
+        self.client = client
+        self.node_id = b"n1"
+        self.incarnation = 1
+
+    def stamp(self, payload):
+        payload["node_id"] = self.node_id
+        payload["incarnation"] = self.incarnation
+        return payload
+
+    def report(self):
+        self.client.call("row_report", self.stamp({"rows": 1}))
+
+    def remove(self):
+        payload = self.stamp({"rows": 0})
+        self.client.call("row_remove", payload)
+
+
+class HeadSide:
+    def __init__(self):
+        self._rows = {}
+
+    def _fence_gate(self, payload, verb):
+        if payload.get("incarnation", -1) < 1:
+            return {"fenced": True}
+        return None
+
+    def _handle_row_report(self, payload):
+        fenced = self._fence_gate(payload, "row_report")
+        if fenced is not None:
+            return fenced
+        self._rows["n"] = payload["rows"]
+        return True
+
+    def _handle_row_remove(self, payload):
+        fenced = self._fence_gate(payload, "row_remove")
+        if fenced is not None:
+            return fenced
+        self._rows.pop("n", None)
+        return True

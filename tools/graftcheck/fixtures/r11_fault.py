@@ -1,0 +1,16 @@
+"""R11 positive fixture: the arm() string is a typo of the hook()
+site's point name — the injection silently tests nothing."""
+
+from ray_tpu._private import fault_injection
+
+
+def spill(data):
+    fault_injection.hook("store.spill")
+    return bytes(data)
+
+
+def test_spill_faults():
+    # typo: "store.spil" never fires — a vacuously green chaos test
+    fault_injection.arm("store.spil", "error", count=1)
+    spill(b"x")
+    assert fault_injection.fired("store.spil") == 0

@@ -1,0 +1,15 @@
+"""R11 negative contrast: every armed/asserted point name matches a
+real hook() site."""
+
+from ray_tpu._private import fault_injection
+
+
+def spill(data):
+    fault_injection.hook("store.spill")
+    return bytes(data)
+
+
+def test_spill_faults():
+    fault_injection.arm("store.spill", "error", count=1)
+    spill(b"x")
+    assert fault_injection.fired("store.spill") == 1

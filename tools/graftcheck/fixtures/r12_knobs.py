@@ -1,0 +1,27 @@
+"""R12 positive fixture: a read of an undeclared knob (AttributeError
+in production) AND a declared knob nothing reads (dead — or its
+consumer is misspelled, which is the same defect seen from the other
+side)."""
+
+from dataclasses import dataclass
+
+
+@dataclass
+class Config:
+    flush_interval_s: float = 1.0
+    flush_batch_max: int = 64        # declared, never read anywhere
+
+
+_CONFIG = Config()
+
+
+def get_config():
+    return _CONFIG
+
+
+def flusher_tick():
+    cfg = get_config()
+    interval = cfg.flush_interval_s
+    # typo'd read: the field is flush_batch_max
+    batch = get_config().flush_batch_size
+    return interval, batch

@@ -1,0 +1,22 @@
+"""R12 negative contrast: every read names a declared field, every
+field is read."""
+
+from dataclasses import dataclass
+
+
+@dataclass
+class Config:
+    flush_interval_s: float = 1.0
+    flush_batch_max: int = 64
+
+
+_CONFIG = Config()
+
+
+def get_config():
+    return _CONFIG
+
+
+def flusher_tick():
+    cfg = get_config()
+    return cfg.flush_interval_s, get_config().flush_batch_max

@@ -1,0 +1,28 @@
+"""R13 positive fixture: one series written as a counter here and a
+gauge there (registration is first-wins, the late writer silently
+stomps the accumulated value), a get_value read of a series nothing
+writes, and two names that collide after Prometheus ``.`` -> ``_``
+mangling."""
+
+from ray_tpu._private.metrics_agent import (get_metrics_registry,
+                                            record_internal)
+
+
+def on_request():
+    record_internal("app.requests", 1.0, "counter")
+
+
+def on_scrape():
+    # same series, default mtype="gauge": set() replaces the count
+    record_internal("app.requests", 0.0)
+
+
+def dashboard_panel():
+    reg = get_metrics_registry()
+    # nothing ever writes "app.request_total": silently None forever
+    return reg.get_value("app.request_total")
+
+
+def mangled_pair():
+    record_internal("app.rate_limit.hits", 1.0, "counter")
+    record_internal("app.rate.limit_hits", 1.0, "counter")

@@ -1,0 +1,18 @@
+"""R13 negative contrast: one name one type, reads name written
+series, no mangling collisions."""
+
+from ray_tpu._private.metrics_agent import (get_metrics_registry,
+                                            record_internal)
+
+
+def on_request():
+    record_internal("app.requests", 1.0, "counter")
+
+
+def on_retry():
+    record_internal("app.requests", 1.0, "counter")
+
+
+def dashboard_panel():
+    reg = get_metrics_registry()
+    return reg.get_value("app.requests")

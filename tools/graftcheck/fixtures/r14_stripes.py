@@ -1,0 +1,35 @@
+"""R14 positive fixture: two stripes of one striped lock held on a
+single path (nested withs AND a call into a stripe-acquiring method
+under a held stripe), plus a stripe name violating the two-digit
+[sNN] contract."""
+
+from ray_tpu._private.debug import diag_lock, diag_rlock
+
+
+class ShardedTable:
+    def __init__(self):
+        self._stripes = [diag_rlock(f"ShardedTable._lock[s{i:02d}]")
+                         for i in range(4)]
+        self._rows = [dict() for _ in range(4)]
+        # naming violation: un-padded index breaks rollup grouping
+        self._extra = diag_lock(f"ShardedTable._aux[s{1}]")
+
+    def _stripe(self, key):
+        return self._stripes[hash(key) % 4]
+
+    def move_nested(self, src, dst, key):
+        # BAD: second stripe acquired while the first is held
+        with self._stripe(src):
+            with self._stripe(dst):
+                self._rows[hash(dst) % 4][key] = \
+                    self._rows[hash(src) % 4].pop(key)
+
+    def move_via_call(self, src, dst, key):
+        # BAD: callee takes another stripe under the held one
+        with self._stripe(src):
+            val = self._rows[hash(src) % 4].pop(key)
+            self._put(dst, key, val)
+
+    def _put(self, dst, key, val):
+        with self._stripe(dst):
+            self._rows[hash(dst) % 4][key] = val

@@ -1,0 +1,30 @@
+"""R14 negative contrast: stripes named to the [sNN] contract and
+acquired at most one at a time — sequentially for cross-stripe moves,
+and one per iteration in the flush loop."""
+
+from ray_tpu._private.debug import diag_rlock
+
+
+class ShardedTable:
+    def __init__(self):
+        self._stripes = [diag_rlock(f"ShardedTable._lock[s{i:02d}]")
+                         for i in range(4)]
+        self._rows = [dict() for _ in range(4)]
+
+    def _stripe(self, key):
+        return self._stripes[hash(key) % 4]
+
+    def move_sequential(self, src, dst, key):
+        # take, release, then take the other — never both at once
+        with self._stripe(src):
+            val = self._rows[hash(src) % 4].pop(key)
+        with self._stripe(dst):
+            self._rows[hash(dst) % 4][key] = val
+
+    def flush_all(self):
+        out = []
+        for i, stripe in enumerate(self._stripes):
+            with stripe:
+                out.extend(self._rows[i].items())
+                self._rows[i].clear()
+        return out

@@ -1,0 +1,29 @@
+"""R9 positive fixture: a mutating handler whose verb is missing from
+every classification set, plus a ghost entry naming a verb that no
+longer exists."""
+
+IDEMPOTENT_VERBS = frozenset({
+    "get_rows",
+    "renamed_away",     # ghost: nothing registers or calls this verb
+})
+DEDUP_VERBS = frozenset({"store_row"})
+
+
+class TableService:
+    def __init__(self, server):
+        self._rows = {}
+        server.register("get_rows", self._handle_get_rows)
+        server.register("store_row", self._handle_store_row)
+        # MUTATES self._rows but is in no classification set:
+        server.register("drop_row", self._handle_drop_row)
+
+    def _handle_get_rows(self, payload):
+        return list(self._rows)
+
+    def _handle_store_row(self, payload):
+        self._rows[payload["k"]] = payload["v"]
+        return True
+
+    def _handle_drop_row(self, payload):
+        self._rows.pop(payload["k"], None)
+        return True

@@ -1,0 +1,31 @@
+"""R9 negative contrast: every mutating verb is classified (dedup or
+the explicit no-retry registry), pure reads may stay unlisted, and
+every set entry names a live verb."""
+
+IDEMPOTENT_VERBS = frozenset({"get_rows"})
+DEDUP_VERBS = frozenset({"store_row"})
+NO_RETRY_VERBS = frozenset({"drop_row"})
+
+
+class TableService:
+    def __init__(self, server):
+        self._rows = {}
+        server.register("get_rows", self._handle_get_rows)
+        server.register("store_row", self._handle_store_row)
+        server.register("drop_row", self._handle_drop_row)
+        # Pure read, deliberately unclassified: fine.
+        server.register("peek_row", self._handle_peek_row)
+
+    def _handle_get_rows(self, payload):
+        return list(self._rows)
+
+    def _handle_store_row(self, payload):
+        self._rows[payload["k"]] = payload["v"]
+        return True
+
+    def _handle_drop_row(self, payload):
+        self._rows.pop(payload["k"], None)
+        return True
+
+    def _handle_peek_row(self, payload):
+        return self._rows.get(payload["k"])

@@ -1,0 +1,641 @@
+"""Protocol-model extraction for graftcheck v2 (rules R9-R14).
+
+PRs 14-18 grew a distributed-protocol surface held together by
+convention: mutating RPC verbs must be classified in ``rpc/verbs.py``
+to get retry/dedup protection, node-stamped head-bound verbs must pass
+the incarnation fence gate, fault points fire only when the ``arm()``
+string matches a ``hook()`` site, config knobs work only when the
+declared dataclass field and the read site agree on a name, metric
+series silently corrupt when two writers disagree on the type, and
+PR 17's striped locks depend on an at-most-one-stripe discipline.
+
+This module walks the analyzed sources ONCE and builds the registries
+those conventions live in — a protocol model — so the R9-R14 rule
+passes in :mod:`graftcheck.rules` can cross-check both sides of each
+contract.  Extraction is deliberately lighter than the analyzer's
+``Program`` model: string-literal call arguments, dataclass field
+tables, f-string lock names.  Non-literal registrations (e.g. the
+chunked-transfer server's ``f"{prefix}_meta"`` verbs) are recorded as
+*dynamic* and excluded from existence cross-checks rather than
+guessed at.
+
+Suppression: a source line (or the line above a finding) may carry
+``# graftcheck: ok R11 <reason>`` to exempt that line from the named
+rules — used by tests that exercise the fault injector with synthetic
+point names.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+# Attribute-call tails that register an RPC handler.
+_RPC_REGISTER = {"register", "register_async"}
+
+# Fault-injection call tails.  ``disarm`` is deliberately absent: a
+# typo'd disarm always rides with a typo'd arm, and flagging both would
+# double-report one defect.
+_ARM_TAILS = {"arm"}
+_ARM_WIRE_TAILS = {"arm_over_wire", "disarm_over_wire"}
+_FIRED_TAILS = {"fired"}
+_HOOK_TAILS = {"hook", "_hook"}
+
+_METRIC_CTORS = {"Counter": "counter", "Gauge": "gauge",
+                 "Histogram": "histogram"}
+_METRIC_TYPES = {"counter", "gauge", "histogram"}
+
+_VERB_SET_NAMES = ("IDEMPOTENT_VERBS", "DEDUP_VERBS", "CONTROL_VERBS",
+                   "NO_RETRY_VERBS")
+
+_PRAGMA_RE = re.compile(r"#\s*graftcheck:\s*ok\s+([R0-9, ]+)")
+
+_STRIPE_OK_RE = re.compile(r"\[s\d{2}\]$")
+#: a string is stripe-*like* (and therefore subject to the naming
+#: contract) only when a "[s..." tail ends it — not merely anywhere a
+#: "[s" appears (error messages, regexes).
+_STRIPE_CAND_RE = re.compile(r"\[s(NN|\?|\d*)\]?$")
+
+
+@dataclass
+class Site:
+    path: str          # repo-relative
+    line: int
+    symbol: str        # enclosing qualname
+
+
+@dataclass
+class Handler:
+    verb: str
+    site: Site                       # the register(...) call
+    func: Optional[ast.AST] = None   # resolved handler FunctionDef
+    cls: Optional[ast.ClassDef] = None   # class owning the handler
+
+
+@dataclass
+class StripeFamily:
+    base: str                            # e.g. "ReferenceCounter._lock"
+    decl_sites: List[Site] = field(default_factory=list)
+    #: class names whose construction creates a stripe of this family
+    #: (the f-string lock name is passed to the stripe class's __init__)
+    stripe_classes: Set[str] = field(default_factory=set)
+    #: True when the diag_* factory is called directly with the
+    #: stripe-patterned name (no wrapper class)
+    direct: bool = False
+
+
+@dataclass
+class ProtocolModel:
+    #: verb -> handler registrations (server side)
+    server_verbs: Dict[str, List[Handler]] = field(default_factory=dict)
+    #: True when at least one registration used a non-literal verb name
+    #: (dynamic verbs exist; existence cross-checks must stay lenient)
+    dynamic_server_verbs: bool = False
+    #: verb -> client call/call_async sites
+    client_verbs: Dict[str, List[Site]] = field(default_factory=dict)
+    #: verb -> client sites whose payload passed through stamp()
+    stamped_verbs: Dict[str, List[Site]] = field(default_factory=dict)
+    #: verb -> _fence_gate(payload, "verb") sites
+    gated_verbs: Dict[str, List[Site]] = field(default_factory=dict)
+    #: IDEMPOTENT_VERBS / DEDUP_VERBS / CONTROL_VERBS / NO_RETRY_VERBS
+    verb_sets: Dict[str, Set[str]] = field(default_factory=dict)
+    verb_set_sites: Dict[str, Site] = field(default_factory=dict)
+
+    #: fault point -> hook()/fire sites
+    hook_points: Dict[str, List[Site]] = field(default_factory=dict)
+    #: fault point -> arm()/arm_over_wire()/env-literal/fired() sites
+    armed_points: Dict[str, List[Site]] = field(default_factory=dict)
+
+    #: Config dataclass field -> declaration site
+    config_fields: Dict[str, Site] = field(default_factory=dict)
+    #: attr name -> read sites on a get_config()-resolved receiver
+    config_reads: Dict[str, List[Site]] = field(default_factory=dict)
+    #: methods/classvars of the Config class (reads of these are API
+    #: use, not knob reads)
+    config_methods: Set[str] = field(default_factory=set)
+    #: attr names read on receivers merely NAMED like a config
+    #: (``cfg.x`` where cfg is a parameter, or behind a ``_config()``
+    #: wrapper) plus ``getattr(cfg, "x", d)`` literals.  Too weak to
+    #: prove a read names a real field (model configs are also called
+    #: ``cfg``), so these only count toward the "declared but never
+    #: read" direction, never the "read but undeclared" one.
+    config_reads_loose: Set[str] = field(default_factory=set)
+    #: "RAY_TPU_<FIELD>" env literals seen anywhere (a field consumed
+    #: straight off the env still counts as read)
+    env_literals: Set[str] = field(default_factory=set)
+
+    #: metric name -> [(site, declared type)]
+    metric_writes: Dict[str, List[Tuple[Site, str]]] = \
+        field(default_factory=dict)
+    #: metric name -> get_value(...) read sites
+    metric_reads: Dict[str, List[Site]] = field(default_factory=dict)
+
+    #: stripe family base -> StripeFamily
+    stripe_families: Dict[str, StripeFamily] = field(default_factory=dict)
+    #: malformed stripe-like lock names: (site, offending name text)
+    stripe_name_violations: List[Tuple[Site, str]] = \
+        field(default_factory=list)
+
+    #: (relpath, line) -> rules suppressed on that line
+    pragmas: Dict[Tuple[str, int], Set[str]] = field(default_factory=dict)
+
+    #: parsed modules for rule passes that need a structural walk (R14)
+    trees: List[Tuple[str, ast.Module]] = field(default_factory=list)
+
+    def suppressed(self, rule: str, path: str, line: int) -> bool:
+        for ln in (line, line - 1):
+            if rule in self.pragmas.get((path, ln), ()):
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# File collection.
+
+
+def _iter_py_files(paths: List[str], repo_root: str) -> List[str]:
+    out: List[str] = []
+    fixtures = os.path.join("tools", "graftcheck", "fixtures")
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            rel = os.path.relpath(dirpath, repo_root)
+            if rel.startswith(fixtures) or "__pycache__" in dirpath:
+                dirnames[:] = []
+                continue
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    # De-dup while preserving order (a file may be reachable twice).
+    seen: Set[str] = set()
+    uniq = []
+    for f in out:
+        a = os.path.abspath(f)
+        if a not in seen:
+            seen.add(a)
+            uniq.append(f)
+    return uniq
+
+
+def protocol_scan_paths(paths: List[str], repo_root: str) -> List[str]:
+    """The registry scan set for an analysis of ``paths``.
+
+    When the analyzed set covers the repo's ``ray_tpu`` tree (the
+    tier-1 gate shape), the protocol scan additionally walks ``tests/``
+    and ``tools/`` — arm sites, knob reads and metric asserts living in
+    tests are evidence a contract side exists (R6 whole-repo-scan
+    precedent).  A single-file analysis (fixture tests, editor runs)
+    scans only that file, keeping fixtures self-contained.
+    """
+    roots = {os.path.abspath(p) for p in paths}
+    gate_shaped = os.path.abspath(os.path.join(repo_root, "ray_tpu")) \
+        in roots or os.path.abspath(repo_root) in roots
+    if not gate_shaped:
+        return list(paths)
+    extra = []
+    for sub in ("tests", "tools"):
+        d = os.path.join(repo_root, sub)
+        if os.path.isdir(d) and os.path.abspath(d) not in roots:
+            extra.append(d)
+    return list(paths) + extra
+
+
+# ---------------------------------------------------------------------------
+# Small AST helpers (kept local: the protocol pass must not depend on
+# the heavy Program model).
+
+
+def _tail(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _lit(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _fmt_stripe_name(node: ast.AST) -> Optional[str]:
+    """Render a (possibly f-string) lock-name argument to a checkable
+    text, with ``{...:02d}`` placeholders collapsed to ``NN`` and any
+    other placeholder to ``?``.  Returns None for non-strings."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        out = []
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                out.append(str(v.value))
+            elif isinstance(v, ast.FormattedValue):
+                spec = ""
+                if isinstance(v.format_spec, ast.JoinedStr):
+                    spec = "".join(
+                        str(c.value) for c in v.format_spec.values
+                        if isinstance(c, ast.Constant))
+                out.append("NN" if spec == "02d" else "?")
+        return "".join(out)
+    return None
+
+
+def _parse_fault_env(value: str) -> List[str]:
+    """Point names out of a ``RAY_TPU_FAULT_POINTS`` spec string:
+    ``"spill.write:error:2,rpc.send@verb=heartbeat:drop:-1"``."""
+    points = []
+    for part in value.split(","):
+        part = part.strip()
+        if not part or ":" not in part:
+            continue
+        head = part.split(":", 1)[0]
+        head = head.split("@", 1)[0].strip()
+        if head:
+            points.append(head)
+    return points
+
+
+class _Scope:
+    """Tracks the class/function nesting for qualnames and per-function
+    local bindings (config receivers, stamped payload names)."""
+
+    def __init__(self):
+        self.stack: List[str] = []
+
+    @property
+    def qualname(self) -> str:
+        return ".".join(self.stack) or "<module>"
+
+
+# ---------------------------------------------------------------------------
+# Extraction visitor.
+
+
+class _Extractor(ast.NodeVisitor):
+    def __init__(self, model: ProtocolModel, relpath: str):
+        self.m = model
+        self.rel = relpath
+        self.scope = _Scope()
+        self.cls_stack: List[ast.ClassDef] = []
+        # handler-resolution tables, filled on first pass per module
+        self.methods: Dict[Tuple[str, str], Tuple[ast.AST, ast.ClassDef]] = {}
+        self.functions: Dict[str, ast.AST] = {}
+        # per-function state
+        self._cfg_names: List[Set[str]] = []
+        self._stamped_names: List[Set[str]] = []
+        self._reg_names: List[Set[str]] = []
+
+    # -- scope plumbing --------------------------------------------------
+
+    def _site(self, node: ast.AST) -> Site:
+        return Site(self.rel, getattr(node, "lineno", 0),
+                    self.scope.qualname)
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self.scope.stack.append(node.name)
+        self.cls_stack.append(node)
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[(node.name, item.name)] = (item, node)
+        if node.name == "Config" and any(
+                _tail(d) == "dataclass" or
+                (isinstance(d, ast.Call) and _tail(d.func) == "dataclass")
+                for d in node.decorator_list):
+            self._collect_config_fields(node)
+        self.generic_visit(node)
+        self.cls_stack.pop()
+        self.scope.stack.pop()
+
+    def _visit_func(self, node):
+        if not self.cls_stack and not self.scope.stack:
+            self.functions[node.name] = node
+        self.scope.stack.append(node.name)
+        self._cfg_names.append(set())
+        self._stamped_names.append(set())
+        self._reg_names.append(set())
+        self.generic_visit(node)
+        self._reg_names.pop()
+        self._stamped_names.pop()
+        self._cfg_names.pop()
+        self.scope.stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    # -- declarations ----------------------------------------------------
+
+    def _collect_config_fields(self, node: ast.ClassDef):
+        for item in node.body:
+            if isinstance(item, ast.AnnAssign) and \
+                    isinstance(item.target, ast.Name):
+                name = item.target.id
+                if not name.startswith("_"):
+                    self.m.config_fields.setdefault(
+                        name, Site(self.rel, item.lineno, "Config"))
+            elif isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.m.config_methods.add(item.name)
+            elif isinstance(item, ast.Assign):
+                for t in item.targets:
+                    if isinstance(t, ast.Name):
+                        self.m.config_methods.add(t.id)
+
+    def visit_Assign(self, node: ast.Assign):
+        # IDEMPOTENT_VERBS = frozenset({...}) — the classification sets.
+        if len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id in _VERB_SET_NAMES and \
+                isinstance(node.value, ast.Call) and \
+                _tail(node.value.func) == "frozenset":
+            names: Set[str] = set()
+            for sub in ast.walk(node.value):
+                s = _lit(sub)
+                if s is not None:
+                    names.add(s)
+            key = node.targets[0].id
+            self.m.verb_sets.setdefault(key, set()).update(names)
+            self.m.verb_set_sites.setdefault(key, self._site(node))
+        self._track_bindings(node.targets, node.value)
+        # env assignment form: os.environ["RAY_TPU_FAULT_POINTS"] = "..."
+        self._scan_env_literals(node)
+        self.generic_visit(node)
+
+    def _track_bindings(self, targets, value):
+        """Record names bound to get_config() / *.stamp(...) /
+        get_metrics_registry() within the current function."""
+        if not self._cfg_names or not isinstance(value, ast.Call):
+            return
+        names = [t.id for t in targets if isinstance(t, ast.Name)]
+        if not names:
+            return
+        tail = _tail(value.func)
+        if tail == "get_config":
+            self._cfg_names[-1].update(names)
+        elif tail == "stamp":
+            self._stamped_names[-1].update(names)
+        elif tail == "get_metrics_registry":
+            self._reg_names[-1].update(names)
+
+    # -- reads & calls ---------------------------------------------------
+
+    def visit_Attribute(self, node: ast.Attribute):
+        if isinstance(node.ctx, ast.Load):
+            base = node.value
+            is_cfg = (isinstance(base, ast.Call) and
+                      _tail(base.func) == "get_config")
+            if not is_cfg and isinstance(base, ast.Name) and \
+                    self._cfg_names and base.id in self._cfg_names[-1]:
+                is_cfg = True
+            if is_cfg and not node.attr.startswith("__"):
+                self.m.config_reads.setdefault(node.attr, []).append(
+                    self._site(node))
+            elif isinstance(base, ast.Name) and \
+                    base.id in ("cfg", "_cfg", "config", "conf"):
+                self.m.config_reads_loose.add(node.attr)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        tail = _tail(node.func)
+        args = node.args
+
+        # --- RPC server registrations ---
+        if isinstance(node.func, ast.Attribute) and tail in _RPC_REGISTER:
+            verb = _lit(args[0]) if args else None
+            handler = args[1] if len(args) > 1 else None
+            is_metric_decl = (len(args) > 1 and _lit(args[1])
+                              in _METRIC_TYPES)
+            if is_metric_decl:
+                # MetricsRegistry.register(name, mtype, ...)
+                name = _lit(args[0])
+                if name is not None:
+                    self.m.metric_writes.setdefault(name, []).append(
+                        (self._site(node), _lit(args[1])))
+            elif verb is not None and handler is not None:
+                h = Handler(verb, self._site(node))
+                h.func, h.cls = self._resolve_handler(handler)
+                self.m.server_verbs.setdefault(verb, []).append(h)
+            elif handler is not None and verb is None and args:
+                # f-string verb (chunked-transfer prefix verbs)
+                self.m.dynamic_server_verbs = True
+
+        # --- RPC client call sites ---
+        if isinstance(node.func, ast.Attribute) and \
+                tail in ("call", "call_async"):
+            verb = _lit(args[0]) if args else None
+            if verb is not None:
+                site = self._site(node)
+                self.m.client_verbs.setdefault(verb, []).append(site)
+                payload = args[1] if len(args) > 1 else None
+                if payload is not None and self._is_stamped(payload):
+                    self.m.stamped_verbs.setdefault(verb, []).append(site)
+
+        # --- fence gate ---
+        if tail == "_fence_gate" and len(args) >= 2:
+            verb = _lit(args[1])
+            if verb is not None:
+                self.m.gated_verbs.setdefault(verb, []).append(
+                    self._site(node))
+
+        # --- fault points ---
+        if tail in _HOOK_TAILS:
+            point = _lit(args[0]) if args else None
+            if point is None:
+                for kw in node.keywords:
+                    if kw.arg == "point":
+                        point = _lit(kw.value)
+            if point is not None:
+                self.m.hook_points.setdefault(point, []).append(
+                    self._site(node))
+        if tail in _ARM_TAILS or tail in _FIRED_TAILS:
+            point = _lit(args[0]) if args else None
+            if point is not None:
+                self.m.armed_points.setdefault(point, []).append(
+                    self._site(node))
+        if tail in _ARM_WIRE_TAILS and len(args) >= 2:
+            point = _lit(args[1])
+            if point is not None:
+                self.m.armed_points.setdefault(point, []).append(
+                    self._site(node))
+
+        # --- metric writes/reads ---
+        if tail == "record_internal" and args:
+            name = _lit(args[0])
+            if name is not None:
+                mtype = "gauge"
+                if len(args) > 2 and _lit(args[2]) in _METRIC_TYPES:
+                    mtype = _lit(args[2])
+                for kw in node.keywords:
+                    if kw.arg == "mtype" and _lit(kw.value) in _METRIC_TYPES:
+                        mtype = _lit(kw.value)
+                self.m.metric_writes.setdefault(name, []).append(
+                    (self._site(node), mtype))
+        elif tail == "observe_internal" and args:
+            name = _lit(args[0])
+            if name is not None:
+                self.m.metric_writes.setdefault(name, []).append(
+                    (self._site(node), "histogram"))
+        elif tail in _METRIC_CTORS and isinstance(node.func, ast.Name) \
+                and args:
+            name = _lit(args[0])
+            if name is not None:
+                self.m.metric_writes.setdefault(name, []).append(
+                    (self._site(node), _METRIC_CTORS[tail]))
+        elif tail == "get_value" and args:
+            name = _lit(args[0])
+            if name is not None:
+                self.m.metric_reads.setdefault(name, []).append(
+                    self._site(node))
+        elif tail in ("inc", "observe") and args and \
+                isinstance(node.func, ast.Attribute):
+            # Direct registry writes (KeyError at runtime when the name
+            # was never registered) — only when the receiver resolves
+            # to the metrics registry; ``inc`` is too generic otherwise.
+            recv = node.func.value
+            is_reg = (isinstance(recv, ast.Call) and
+                      _tail(recv.func) == "get_metrics_registry")
+            if not is_reg and isinstance(recv, ast.Name) and \
+                    self._reg_names and recv.id in self._reg_names[-1]:
+                is_reg = True
+            name = _lit(args[0])
+            if is_reg and name is not None:
+                mtype = "counter" if tail == "inc" else "histogram"
+                self.m.metric_writes.setdefault(name, []).append(
+                    (self._site(node), mtype))
+
+        # getattr(cfg, "knob", default) — a knob read by literal name.
+        if tail == "getattr" and isinstance(node.func, ast.Name) and \
+                len(args) >= 2:
+            name = _lit(args[1])
+            if name is not None:
+                self.m.config_reads_loose.add(name)
+
+        # --- RAY_TPU_FAULT_POINTS env literals & RAY_TPU_* env reads ---
+        self._scan_env_literals(node)
+
+        # --- stripe lock names ---
+        self._scan_stripe_name(node, tail)
+
+        self.generic_visit(node)
+
+    # -- helpers ---------------------------------------------------------
+
+    def _is_stamped(self, payload: ast.AST) -> bool:
+        if isinstance(payload, ast.Call) and _tail(payload.func) == "stamp":
+            return True
+        if isinstance(payload, ast.Name) and self._stamped_names and \
+                payload.id in self._stamped_names[-1]:
+            return True
+        return False
+
+    def _resolve_handler(self, expr: ast.AST):
+        """``self._handle_x`` / bare name -> (FunctionDef, ClassDef)."""
+        name = None
+        if isinstance(expr, ast.Attribute):
+            name = expr.attr
+        elif isinstance(expr, ast.Name):
+            name = expr.id
+        if name is None:
+            return None, None
+        for cls in reversed(self.cls_stack):
+            hit = self.methods.get((cls.name, name))
+            if hit:
+                return hit
+        # Registration may live outside the owning class (a host object
+        # registering its raylet's methods) — fall back to any class in
+        # this module defining the method, then module functions.
+        for (_cls, meth), hit in self.methods.items():
+            if meth == name:
+                return hit
+        fn = self.functions.get(name)
+        return (fn, None) if fn is not None else (None, None)
+
+    def _scan_env_literals(self, node: ast.AST):
+        strs = [s for s in (_lit(a) for a in ast.walk(node))
+                if s is not None]
+        if any(s == "RAY_TPU_FAULT_POINTS" for s in strs):
+            for s in strs:
+                if s == "RAY_TPU_FAULT_POINTS":
+                    continue
+                for point in _parse_fault_env(s):
+                    self.m.armed_points.setdefault(point, []).append(
+                        self._site(node))
+        for s in strs:
+            if s.startswith("RAY_TPU_") and s.isupper():
+                self.m.env_literals.add(s)
+
+    def _scan_stripe_name(self, node: ast.Call, tail: Optional[str]):
+        """A diag_* factory or a class constructor taking a
+        ``Base._lock[sNN]``-patterned name argument declares a stripe
+        of family ``Base._lock``.
+
+        Scope: diag_* factory string args, plus f-string args to any
+        call (stripe wrapper classes take the formatted name, e.g.
+        ``_EventStripe(f"TaskEventBuffer._lock[s{i:02d}]")``).  Plain
+        constants passed to arbitrary calls are NOT stripe names
+        (regexes, prefix matches, error messages)."""
+        is_diag = tail in ("diag_lock", "diag_rlock", "diag_condition")
+        for arg in node.args:
+            if not is_diag and not isinstance(arg, ast.JoinedStr):
+                continue
+            text = _fmt_stripe_name(arg)
+            if text is None or "[s" not in text or \
+                    not _STRIPE_CAND_RE.search(text):
+                continue
+            site = self._site(node)
+            if not _STRIPE_OK_RE.search(text.replace("NN", "00")):
+                self.m.stripe_name_violations.append((site, text))
+                continue
+            base = text[:text.rindex("[s")]
+            fam = self.m.stripe_families.setdefault(
+                base, StripeFamily(base))
+            fam.decl_sites.append(site)
+            is_diag = tail in ("diag_lock", "diag_rlock", "diag_condition")
+            if is_diag:
+                fam.direct = True
+            elif isinstance(node.func, ast.Name):
+                fam.stripe_classes.add(node.func.id)
+            elif isinstance(node.func, ast.Attribute):
+                fam.stripe_classes.add(node.func.attr)
+
+
+# ---------------------------------------------------------------------------
+# Entry point.
+
+
+def extract_protocol(paths: List[str], repo_root: str) -> ProtocolModel:
+    model = ProtocolModel()
+    for fpath in _iter_py_files(protocol_scan_paths(paths, repo_root),
+                                repo_root):
+        try:
+            with open(fpath, "r", encoding="utf-8", errors="replace") as f:
+                src = f.read()
+            tree = ast.parse(src, filename=fpath)
+        except (OSError, SyntaxError):
+            continue
+        rel = os.path.relpath(fpath, repo_root)
+        for i, text in enumerate(src.splitlines(), start=1):
+            mt = _PRAGMA_RE.search(text)
+            if mt:
+                rules = {r.strip() for r in
+                         re.split(r"[,\s]+", mt.group(1)) if r.strip()}
+                model.pragmas.setdefault((rel, i), set()).update(rules)
+        ex = _Extractor(model, rel)
+        # Pre-pass: method tables must exist before handler resolution,
+        # and registrations can precede handler defs in source order.
+        for sub in ast.walk(tree):
+            if isinstance(sub, ast.ClassDef):
+                for item in sub.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        ex.methods[(sub.name, item.name)] = (item, sub)
+            elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                ex.functions.setdefault(sub.name, sub)
+        ex.visit(tree)
+        model.trees.append((rel, tree))
+    return model

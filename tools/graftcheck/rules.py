@@ -26,6 +26,36 @@ R8        no bare ``threading.Lock/RLock/Condition`` in ray_tpu modules
           (ISSUE 13: a bare lock is invisible to both; new code must
           not silently opt out)
 ========  ==============================================================
+
+R9-R14 are the distributed-protocol families (ISSUE 19): they run on
+the protocol model extracted by :mod:`graftcheck.protocol` and
+cross-check both sides of contracts that PRs 14-18 enforced by
+convention only:
+
+========  ==============================================================
+R9        every mutating RPC handler's verb is classified in
+          ``rpc/verbs.py`` (IDEMPOTENT / DEDUP / CONTROL / NO_RETRY) —
+          an unclassified mutating verb silently loses retry+dedup
+          protection; also flags classified verbs that no longer exist
+R10       every node-stamped head-bound verb passes ``_fence_gate``
+          (the remove_partial_location drift this PR fixed: an
+          unstamped fire-and-forget removal from a stale incarnation
+          could erase a live node's directory row)
+R11       every armed fault point (``arm()``/``arm_over_wire()``/
+          ``RAY_TPU_FAULT_POINTS``/``fired()``) names a real ``hook()``
+          site — a typo'd injection tests nothing, vacuously green
+R12       config-knob hygiene: reads through ``get_config()`` name a
+          declared Config field, and every declared field is read
+          somewhere (or consumed via its RAY_TPU_* env literal)
+R13       metric export parity: one name, one type (first-register
+          wins silently, so a counter re-recorded as a gauge stomps
+          the series); literal ``get_value`` reads name a written
+          series; no two names collide after Prometheus ``.``->``_``
+R14       stripe discipline: ``Base[sNN]`` two-digit naming contract,
+          and at most ONE stripe of a striped lock held per path
+          (nested withs, stripe loops under a held stripe, one-level
+          calls into stripe-acquiring methods)
+========  ==============================================================
 """
 
 from __future__ import annotations
@@ -37,8 +67,15 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from graftcheck.analyzer import (LOOP_POST_METHODS, Finding, FunctionModel,
                                  Program, _call_tail, _is_self_attr)
+from graftcheck.protocol import (ProtocolModel, _fmt_stripe_name,
+                                 extract_protocol)
 
-ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8")
+ALL_RULES = ("R1", "R2", "R3", "R4", "R5", "R6", "R7", "R8",
+             "R9", "R10", "R11", "R12", "R13", "R14")
+
+#: The protocol-model families (run on graftcheck.protocol registries,
+#: not the Program model).
+PROTOCOL_RULES = ("R9", "R10", "R11", "R12", "R13", "R14")
 
 RULE_TITLES = {
     "R1": "lock-order graph must be acyclic",
@@ -49,6 +86,12 @@ RULE_TITLES = {
     "R6": "no pyc-without-source orphan packages",
     "R7": "no silent exception swallowing in pump loops",
     "R8": "bare threading primitives bypass the diag_* witness plane",
+    "R9": "mutating RPC verbs must be classified in rpc/verbs.py",
+    "R10": "node-stamped head-bound verbs must pass the fence gate",
+    "R11": "armed fault points must name a real hook() site",
+    "R12": "config knobs: reads declared, declarations read",
+    "R13": "metric export parity: one name one type, no dead reads",
+    "R14": "stripe locks: [sNN] naming, at most one stripe per path",
 }
 
 
@@ -717,11 +760,466 @@ def check_bare_threading(prog: Program) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# R9 — unclassified mutating verbs.
+
+#: container mutators: a call to one of these on a self-rooted chain
+#: counts as a state mutation.
+_MUTATOR_METHODS = {
+    "append", "appendleft", "add", "update", "pop", "popleft", "popitem",
+    "remove", "discard", "clear", "extend", "insert", "setdefault",
+}
+
+
+def _self_rooted(expr: ast.AST) -> bool:
+    while isinstance(expr, (ast.Attribute, ast.Subscript)):
+        expr = expr.value
+    return isinstance(expr, ast.Name) and expr.id == "self"
+
+
+def _func_mutates(fn: ast.AST) -> bool:
+    """Direct self-state mutation: assignment/del through a self-rooted
+    chain, or a container mutator called on one."""
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)) and \
+                        _self_rooted(t):
+                    return True
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)) and \
+                        _self_rooted(t):
+                    return True
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATOR_METHODS and \
+                _self_rooted(node.func.value):
+            return True
+    return False
+
+
+def _handler_mutates(fn: ast.AST, cls: Optional[ast.ClassDef],
+                     depth: int = 3,
+                     seen: Optional[Set[str]] = None) -> bool:
+    """Transitive (same-class, depth-limited) may-mutate for a handler."""
+    if _func_mutates(fn):
+        return True
+    if depth <= 0 or cls is None:
+        return False
+    seen = seen or {getattr(fn, "name", "")}
+    methods = {item.name: item for item in cls.body
+               if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == "self":
+            name = node.func.attr
+            if name in methods and name not in seen:
+                seen.add(name)
+                if _handler_mutates(methods[name], cls, depth - 1, seen):
+                    return True
+    return False
+
+
+def check_verb_classification(proto: ProtocolModel) -> List[Finding]:
+    findings: List[Finding] = []
+    classified: Set[str] = set()
+    for s in proto.verb_sets.values():
+        classified |= s
+    if not proto.verb_sets:
+        # No classification registry in the analyzed set (single-file
+        # run on a module with no verb sets): nothing to check against.
+        return findings
+    for verb in sorted(proto.server_verbs):
+        if verb in classified:
+            continue
+        for h in proto.server_verbs[verb]:
+            if h.func is None or not _handler_mutates(h.func, h.cls):
+                continue
+            findings.append(Finding(
+                rule="R9", path=h.site.path, line=h.site.line,
+                symbol=h.site.symbol,
+                message=(f"verb {verb!r} mutates state but is not "
+                         f"classified in rpc/verbs.py (IDEMPOTENT / "
+                         f"DEDUP / CONTROL / NO_RETRY) — it silently "
+                         f"gets no retry or dedup protection"),
+                detail=f"unclassified:{verb}"))
+            break
+    # Ghost classifications: a set entry naming a verb that is neither
+    # registered nor called is a typo waiting to mis-protect a rename.
+    known = set(proto.server_verbs) | set(proto.client_verbs)
+    for set_name, verbs in sorted(proto.verb_sets.items()):
+        site = proto.verb_set_sites.get(set_name)
+        if site is None:
+            continue
+        for verb in sorted(verbs - known):
+            findings.append(Finding(
+                rule="R9", path=site.path, line=site.line,
+                symbol=set_name,
+                message=(f"{set_name} lists verb {verb!r} but no "
+                         f"handler registration or call site exists — "
+                         f"stale or typo'd classification"),
+                detail=f"ghost:{verb}"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# R10 — fence-gate coverage.
+
+
+def check_fence_coverage(proto: ProtocolModel) -> List[Finding]:
+    findings: List[Finding] = []
+    if not proto.stamped_verbs and not proto.gated_verbs:
+        return findings
+    control = proto.verb_sets.get("CONTROL_VERBS", set())
+    for verb in sorted(proto.stamped_verbs):
+        if verb in proto.gated_verbs or verb in control:
+            continue
+        site = proto.stamped_verbs[verb][0]
+        findings.append(Finding(
+            rule="R10", path=site.path, line=site.line,
+            symbol=site.symbol,
+            message=(f"verb {verb!r} is sent with a stamp()ed payload "
+                     f"but the head handler never calls "
+                     f"_fence_gate(payload, {verb!r}) — a stale "
+                     f"incarnation's send would be applied"),
+            detail=f"unfenced:{verb}"))
+    for verb in sorted(proto.gated_verbs):
+        if verb in proto.stamped_verbs:
+            continue
+        site = proto.gated_verbs[verb][0]
+        findings.append(Finding(
+            rule="R10", path=site.path, line=site.line,
+            symbol=site.symbol,
+            message=(f"_fence_gate checks verb {verb!r} but no client "
+                     f"site stamps that verb — the gate is dead code "
+                     f"or the sender forgot stamp()"),
+            detail=f"gate_stale:{verb}"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# R11 — fault-point liveness.
+
+
+def check_fault_liveness(proto: ProtocolModel) -> List[Finding]:
+    findings: List[Finding] = []
+    if not proto.armed_points:
+        return findings
+    for point in sorted(proto.armed_points):
+        if point in proto.hook_points:
+            continue
+        for site in proto.armed_points[point]:
+            findings.append(Finding(
+                rule="R11", path=site.path, line=site.line,
+                symbol=site.symbol,
+                message=(f"fault point {point!r} is armed/asserted but "
+                         f"no fault_injection.hook({point!r}) site "
+                         f"exists — the injection silently tests "
+                         f"nothing"),
+                detail=f"dead_point:{point}"))
+            break
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# R12 — config-knob hygiene.
+
+
+def check_knob_hygiene(proto: ProtocolModel) -> List[Finding]:
+    findings: List[Finding] = []
+    if not proto.config_fields:
+        return findings
+    for attr in sorted(proto.config_reads):
+        if attr in proto.config_fields or attr in proto.config_methods:
+            continue
+        site = proto.config_reads[attr][0]
+        findings.append(Finding(
+            rule="R12", path=site.path, line=site.line,
+            symbol=site.symbol,
+            message=(f"get_config().{attr} is read but Config declares "
+                     f"no field {attr!r} — AttributeError at runtime, "
+                     f"or a renamed knob left a stale reader"),
+            detail=f"undeclared_knob:{attr}"))
+    for name, site in sorted(proto.config_fields.items()):
+        if name in proto.config_reads or name in proto.config_reads_loose:
+            continue
+        if f"RAY_TPU_{name.upper()}" in proto.env_literals:
+            continue
+        findings.append(Finding(
+            rule="R12", path=site.path, line=site.line,
+            symbol="Config",
+            message=(f"Config field {name!r} is declared but never "
+                     f"read through get_config() (nor via its "
+                     f"RAY_TPU_* env literal) — a dead knob, or the "
+                     f"consumer reads a misspelled name"),
+            detail=f"dead_knob:{name}"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# R13 — metric export parity.
+
+
+def check_metric_parity(proto: ProtocolModel) -> List[Finding]:
+    findings: List[Finding] = []
+    for name in sorted(proto.metric_writes):
+        entries = proto.metric_writes[name]
+        types = sorted({t for _s, t in entries})
+        if len(types) > 1:
+            # register() is first-wins and record_internal branches on
+            # its OWN mtype argument: the gauge-writer of a counter
+            # series overwrites the accumulated value in place.
+            site = entries[1][0]
+            findings.append(Finding(
+                rule="R13", path=site.path, line=site.line,
+                symbol=site.symbol,
+                message=(f"metric {name!r} is written with conflicting "
+                         f"types {types} — registration is first-wins, "
+                         f"so the late writer silently corrupts the "
+                         f"series"),
+                detail=f"metric_type_conflict:{name}:{'/'.join(types)}"))
+    if proto.metric_writes or proto.metric_reads:
+        for name in sorted(proto.metric_reads):
+            if name in proto.metric_writes:
+                continue
+            site = proto.metric_reads[name][0]
+            findings.append(Finding(
+                rule="R13", path=site.path, line=site.line,
+                symbol=site.symbol,
+                message=(f"get_value({name!r}) reads a series no site "
+                         f"ever writes — get_value returns None "
+                         f"silently, so the read is vacuous"),
+                detail=f"dead_metric_read:{name}"))
+    by_mangled: Dict[str, Set[str]] = {}
+    for name in proto.metric_writes:
+        by_mangled.setdefault(name.replace(".", "_"), set()).add(name)
+    for pname, names in sorted(by_mangled.items()):
+        if len(names) > 1:
+            first = sorted(names)[0]
+            site = proto.metric_writes[first][0][0]
+            findings.append(Finding(
+                rule="R13", path=site.path, line=site.line,
+                symbol=site.symbol,
+                message=(f"metric names {sorted(names)} all render as "
+                         f"Prometheus family {pname!r} — exposition "
+                         f"merges unrelated series"),
+                detail=f"mangle_collision:{pname}"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# R14 — stripe naming + at-most-one-stripe discipline.
+
+
+def _expr_touches(expr: ast.AST, containers: Dict[str, str],
+                  accessors: Dict[str, str],
+                  loop_bindings: Dict[str, str]) -> Set[str]:
+    """Stripe families an expression may select a stripe of."""
+    out: Set[str] = set()
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr in containers \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            out.add(containers[node.attr])
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in accessors and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == "self":
+            out.add(accessors[node.func.attr])
+        elif isinstance(node, ast.Name) and node.id in loop_bindings:
+            out.add(loop_bindings[node.id])
+    return out
+
+
+def _method_acquires(fn: ast.AST, containers, accessors) -> Set[str]:
+    """Families this method acquires a stripe of via any `with`."""
+    loop_bindings = _loop_bindings(fn, containers)
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                out |= _expr_touches(item.context_expr, containers,
+                                     accessors, loop_bindings)
+    return out
+
+
+def _loop_bindings(fn: ast.AST, containers: Dict[str, str]) -> Dict[str, str]:
+    """``for s in self._stripes:`` binds ``s`` to the family."""
+    bindings: Dict[str, str] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.For) and isinstance(node.target, ast.Name):
+            fams = _expr_touches(node.iter, containers, {}, {})
+            if fams:
+                bindings[node.target.id] = sorted(fams)[0]
+    return bindings
+
+
+def check_stripe_discipline(proto: ProtocolModel) -> List[Finding]:
+    findings: List[Finding] = []
+    for site, text in proto.stripe_name_violations:
+        findings.append(Finding(
+            rule="R14", path=site.path, line=site.line,
+            symbol=site.symbol,
+            message=(f"stripe-like lock name {text!r} violates the "
+                     f"PR 17 naming contract: stripes must end in "
+                     f"[sNN] (two-digit index, e.g. "
+                     f"'Base._lock[s{{i:02d}}]')"),
+            detail=f"stripe_name:{text}"))
+    if not proto.stripe_families:
+        return findings
+    stripe_classes: Dict[str, str] = {}
+    for fam in proto.stripe_families.values():
+        for cname in fam.stripe_classes:
+            stripe_classes[cname] = fam.base
+    direct_fams = {f.base for f in proto.stripe_families.values()
+                   if f.direct}
+
+    for rel, tree in proto.trees:
+        for cls in [n for n in ast.walk(tree)
+                    if isinstance(n, ast.ClassDef)]:
+            containers: Dict[str, str] = {}
+            # self.X = [...] whose element expr constructs a stripe
+            # (stripe class call, or a direct diag_* stripe name)
+            for node in ast.walk(cls):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for t in node.targets:
+                    if not (isinstance(t, ast.Attribute) and
+                            isinstance(t.value, ast.Name) and
+                            t.value.id == "self"):
+                        continue
+                    for sub in ast.walk(node.value):
+                        if isinstance(sub, ast.Call):
+                            cname = None
+                            if isinstance(sub.func, ast.Name):
+                                cname = sub.func.id
+                            elif isinstance(sub.func, ast.Attribute):
+                                cname = sub.func.attr
+                            if cname in stripe_classes:
+                                containers[t.attr] = stripe_classes[cname]
+                            elif cname in ("diag_lock", "diag_rlock",
+                                           "diag_condition"):
+                                for a in sub.args:
+                                    txt = _fmt_stripe_name(a)
+                                    if txt and "[s" in txt:
+                                        base = txt[:txt.rindex("[s")]
+                                        if base in direct_fams:
+                                            containers[t.attr] = base
+            if not containers:
+                continue
+            methods = {item.name: item for item in cls.body
+                       if isinstance(item, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef))}
+            # accessor methods: return self.<container>[...]
+            accessors: Dict[str, str] = {}
+            for mname, fn in methods.items():
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Return) and \
+                            node.value is not None:
+                        fams = _expr_touches(node.value, containers,
+                                             {}, {})
+                        if fams:
+                            accessors[mname] = sorted(fams)[0]
+            acquires = {mname: _method_acquires(fn, containers, accessors)
+                        for mname, fn in methods.items()}
+
+            for mname, fn in methods.items():
+                loop_bindings = _loop_bindings(fn, containers)
+                qual = f"{cls.name}.{mname}"
+
+                def walk(node, held: Set[str]):
+                    for child in ast.iter_child_nodes(node):
+                        if isinstance(child, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef,
+                                              ast.Lambda)):
+                            continue
+                        entered = held
+                        if isinstance(child, ast.With):
+                            fams = set()
+                            for item in child.items:
+                                fams |= _expr_touches(
+                                    item.context_expr, containers,
+                                    accessors, loop_bindings)
+                            overlap = fams & held
+                            if overlap:
+                                fam = sorted(overlap)[0]
+                                findings.append(Finding(
+                                    rule="R14", path=rel,
+                                    line=child.lineno, symbol=qual,
+                                    message=(
+                                        f"acquires a second stripe of "
+                                        f"{fam!r} while one is already "
+                                        f"held — the at-most-one-"
+                                        f"stripe discipline makes "
+                                        f"stripe order deadlock-free; "
+                                        f"two held stripes reintroduce "
+                                        f"ABBA"),
+                                    detail=f"stripe_nest:{fam}:{qual}"))
+                            entered = held | fams
+                        elif isinstance(child, ast.Call) and held and \
+                                isinstance(child.func, ast.Attribute) \
+                                and isinstance(child.func.value,
+                                               ast.Name) and \
+                                child.func.value.id == "self":
+                            callee = child.func.attr
+                            inner = acquires.get(callee, set()) & held
+                            if inner and callee != mname:
+                                fam = sorted(inner)[0]
+                                findings.append(Finding(
+                                    rule="R14", path=rel,
+                                    line=child.lineno, symbol=qual,
+                                    message=(
+                                        f"calls self.{callee}() — "
+                                        f"which acquires a {fam!r} "
+                                        f"stripe — while already "
+                                        f"holding one: two stripes of "
+                                        f"one striped lock on a single "
+                                        f"path"),
+                                    detail=(f"stripe_call:{fam}:{qual}"
+                                            f"->{callee}")))
+                        walk(child, entered)
+
+                walk(fn, set())
+    return findings
+
+
+# ---------------------------------------------------------------------------
+
+
+def run_protocol_rules(proto: ProtocolModel,
+                       selected: Set[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    if "R9" in selected:
+        findings += check_verb_classification(proto)
+    if "R10" in selected:
+        findings += check_fence_coverage(proto)
+    if "R11" in selected:
+        findings += check_fault_liveness(proto)
+    if "R12" in selected:
+        findings += check_knob_hygiene(proto)
+    if "R13" in selected:
+        findings += check_metric_parity(proto)
+    if "R14" in selected:
+        findings += check_stripe_discipline(proto)
+    return findings
 
 
 def run_all(prog: Program, paths: List[str], repo_root: str,
-            rules: Optional[Set[str]] = None) -> List[Finding]:
-    selected = rules or set(ALL_RULES)
+            rules: Optional[Set[str]] = None,
+            global_protocol: bool = False) -> List[Finding]:
+    """Run ``rules`` (default all) over the loaded ``prog``.
+
+    ``global_protocol=True`` (the --changed-only fast path) builds the
+    R9-R14 registries from the WHOLE repo regardless of ``paths``: a
+    cross-file contract can't be checked against a diff-shaped slice
+    of itself (the handler may be in the diff while the classification
+    set is not)."""
+    selected = set(rules) if rules else set(ALL_RULES)
     findings: List[Finding] = []
     if "R1" in selected:
         findings += check_lock_order(prog)
@@ -742,6 +1240,22 @@ def run_all(prog: Program, paths: List[str], repo_root: str,
         findings += check_silent_swallow(prog)
     if "R8" in selected:
         findings += check_bare_threading(prog)
+    if selected & set(PROTOCOL_RULES):
+        # Protocol registries are cross-file by nature: the scan set
+        # widens to tests/ and tools/ on gate-shaped runs (see
+        # protocol.protocol_scan_paths) so both sides of each contract
+        # are in evidence, and it always stays global in
+        # --changed-only mode.
+        proto_paths = [os.path.join(repo_root, "ray_tpu")] \
+            if global_protocol else paths
+        proto = extract_protocol(proto_paths, repo_root)
+        findings += run_protocol_rules(proto, selected)
+        # `# graftcheck: ok RN <why>` on (or right above) the flagged
+        # line suppresses that rule there — for code that exercises a
+        # contract's failure mode on purpose (e.g. tests arming
+        # synthetic fault points against the injector itself).
+        findings = [f for f in findings
+                    if not proto.suppressed(f.rule, f.path, f.line)]
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     # Two identical defects in one function (e.g. two unfloored
     # decrements of the same attr) must not collapse to one
