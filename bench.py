@@ -312,6 +312,41 @@ def _envelope_row():
                       f"{(proc.stderr or '')[-400:]}"}
 
 
+def _serve_bench_row():
+    """Run bench_runtime.py --serve-bench in a subprocess (the serving
+    plane on CPU: closed-loop client sweep against an autoscaled,
+    adaptively-batched deployment, plus the relay-vs-naive cold-start
+    arm pair) and return the parsed serve_closed_loop row, or a
+    structured skip dict.  --quick keeps the riding cost down; a full
+    sweep is recorded per-round (BENCH_r09.json onward)."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench_runtime.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        proc = subprocess.run(
+            [sys.executable, path, "--serve-bench", "--quick"],
+            env=env, capture_output=True, text=True, timeout=600)
+    except subprocess.TimeoutExpired:
+        return {"skipped": True, "reason": "serve bench timed out"}
+    # Parse the row even on rc!=0: a lost request or a non-chaining
+    # relay arm prints its data before exiting 1 — the honest failure
+    # must reach the JSON, not collapse into a skip.
+    for line in proc.stdout.strip().splitlines():
+        try:
+            row = json.loads(line)
+        except ValueError:
+            continue
+        if row.get("metric") == "serve_closed_loop":
+            if proc.returncode != 0:
+                row["failed"] = True
+                row["failed_rc"] = proc.returncode
+            return row
+    return {"skipped": True,
+            "reason": f"no serve_closed_loop row in output "
+                      f"(rc={proc.returncode}): "
+                      f"{(proc.stderr or '')[-400:]}"}
+
+
 def main():
     probe = _probe()
     probed_cpu = not probe.get("ok") or probe.get("backend") != "tpu"
@@ -470,6 +505,17 @@ def main():
     res["envelope"] = {
         k: v for k, v in _envelope_row().items()
         if k not in ("metric", "value", "unit")}
+
+    # Serving axis (ISSUE 20): closed-loop p50/p99 vs offered load
+    # with the saturation knee, the autoscaler's decisions, adaptive
+    # batch fill, and the relay-vs-naive cold-start pair — folded as
+    # serve.  The knee throughput rides as serve["knee_rps"].
+    serve_row = _serve_bench_row()
+    res["serve"] = {
+        k: v for k, v in serve_row.items()
+        if k not in ("metric", "value", "unit")}
+    if not serve_row.get("skipped"):
+        res["serve"]["knee_rps"] = serve_row.get("value")
 
     dispatch = _dispatch_latency_rows()
     if dispatch.get("skipped"):

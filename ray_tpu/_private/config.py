@@ -353,6 +353,29 @@ class Config:
     #: remaining files.  0 = unbounded.
     wedge_files_keep: int = 20
 
+    # ------ serve (inference plane) ------
+    #: Replica placement backend for serve deployments: "auto" routes
+    #: replica starts through the pack-mode TPU kernel solve when the
+    #: cluster has at least serve_kernel_min_nodes nodes (DEFAULT
+    #: placement below that, and on any solve failure), "force" always
+    #: solves, "off" always DEFAULT placement.
+    serve_kernel_placement: str = "auto"
+    serve_kernel_min_nodes: int = 2
+    #: Pipeline ingress inputs at least this large are put ONCE into
+    #: the object store and handed to every stage as an ObjectRef (the
+    #: zero-copy object-id handoff) instead of being pickled into each
+    #: stage's task args.  0 forces the handoff for every input;
+    #: negative disables it.
+    serve_zero_copy_threshold_bytes: int = 65_536
+    #: How many times Router.call re-assigns a request whose replica
+    #: died mid-flight before surfacing ReplicaDiedError.  User
+    #: exceptions are NEVER retried.
+    serve_request_retries: int = 3
+    #: Cadence of the router's queue-depth reports to the controller
+    #: (the autoscaler's queue signal).  Idle routers go silent after
+    #: one zero report regardless of cadence.
+    serve_router_report_interval_s: float = 0.25
+
     @classmethod
     def from_env(cls, system_config: Optional[dict] = None) -> "Config":
         cfg = cls()

@@ -29,6 +29,11 @@ Named points wired into the runtime (grep ``fault_injection.hook``):
                           process (ctx: verb, peer, peer_host, peer_port)
 ``rpc.recv``              server side, before an inbound request dispatches
                           (ctx: verb, peer, peer_host, peer_port)
+``serve.request``         serve router, before a request is dispatched to a
+                          replica (ctx: deployment; ``error`` surfaces to
+                          the client attributed, ``delay`` slows dispatch,
+                          ``drop`` loses the dispatch in flight — the
+                          router re-assigns it)
 ========================  ====================================================
 
 Modes:

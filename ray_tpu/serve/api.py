@@ -266,15 +266,16 @@ def shutdown() -> None:
             proxy = ray_tpu.get_actor(_PROXY_NAME)
         except Exception:
             proxy = None
+    from ray_tpu._private.debug import swallow
     if proxy is not None:
         try:
             ray_tpu.get(proxy.stop.remote())
             ray_tpu.kill(proxy)
-        except Exception:
-            pass
+        except Exception as e:
+            swallow.noted("serve.api.shutdown_proxy", e)
     if controller is not None:
         try:
             ray_tpu.get(controller.shutdown.remote())
             ray_tpu.kill(controller)
-        except Exception:
-            pass
+        except Exception as e:
+            swallow.noted("serve.api.shutdown_controller", e)

@@ -8,6 +8,21 @@ creating/stopping replica actors, long-poll change notifications
 scale to ceil(total_queued / target_num_ongoing_requests_per_replica)
 clamped to [min,max]).
 
+Autoscaling (this repo's serving-under-load lever): the queue-depth
+signal is the sum of replica in-flight counts (probed) and router
+queue reports (:meth:`report_router_queue` — callers parked waiting
+for a free replica slot).  The signal is EWMA-smoothed and the policy
+has hysteresis: a scale decision fires only after the pressure
+persists past ``upscale_delay_s`` / ``downscale_delay_s`` (reference
+``autoscaling_policy.py`` delay semantics), so a one-tick burst never
+churns replicas.  New replicas are PLACED through the pack-mode
+kernel solve (``resource_demand_scheduler._pack_mode_solve`` — the
+same device-resident path tasks and placement groups ride) and pinned
+with soft node affinity; the solve is gated by
+``serve_kernel_placement`` and falls back to DEFAULT placement on any
+failure.  Decision series are exported at /metrics
+(``ray_tpu_serve_autoscaler_*``).
+
 Updates are *rolling* (reference ``deployment_state.py`` version-aware
 reconciler): a redeploy that changes code/config marks live replicas as
 old-version; the reconciler surges new-version replicas in, waits for
@@ -29,6 +44,10 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import ray_tpu
 from ray_tpu import exceptions
+from ray_tpu._private.config import get_config
+from ray_tpu._private.debug import swallow
+from ray_tpu._private.debug.lock_order import (diag_condition, diag_lock,
+                                               diag_rlock)
 
 CONTROLLER_NAME = "SERVE_CONTROLLER_ACTOR"
 
@@ -38,6 +57,14 @@ _ROLLING_SURGE_FRACTION = 0.25
 _HEALTH_CHECK_PERIOD_S = 2.5
 _HEALTH_CHECK_FAILURE_THRESHOLD = 2
 _RECONCILE_PERIOD_S = 0.25
+# Router queue reports older than this are ignored when aggregating the
+# queue-depth signal (a stopped router must not pin its last depth).
+_ROUTER_REPORT_TTL_S = 2.0
+# EWMA smoothing for the load signal (per reconcile tick).
+_LOAD_EWMA_ALPHA = 0.5
+# Hysteresis defaults when autoscaling_config doesn't set them.
+_UPSCALE_DELAY_S = 0.3
+_DOWNSCALE_DELAY_S = 2.0
 
 
 class DeploymentInfo:
@@ -82,15 +109,27 @@ class ServeController:
         self._deployments: Dict[str, DeploymentInfo] = {}
         self._replicas: Dict[str, List[_Replica]] = {}
         self._config_version = 0
-        self._lock = threading.RLock()
-        self._cv = threading.Condition(self._lock)
+        self._lock = diag_rlock("serve.ServeController._lock")
+        self._cv = diag_condition(self._lock,
+                                  name="serve.ServeController._cv")
         # Serializes whole reconcile passes (deploy handler vs loop):
         # replica startup blocks on health checks, so two concurrent
         # passes would both see the same deficit and double-start.
-        self._reconcile_mutex = threading.Lock()
+        self._reconcile_mutex = diag_lock(
+            "serve.ServeController._reconcile_mutex")
         self._shutdown = False
         self._last_health_check = 0.0
         self._health_fail_counts: Dict[_Replica, int] = {}
+        # Autoscaler state: router queue reports (deployment ->
+        # router_id -> (queued, ts)), EWMA-smoothed load, and the
+        # hysteresis timestamps (when the scale condition FIRST held).
+        self._router_queues: Dict[str, Dict[str, Tuple[int, float]]] = {}
+        self._load_ewma: Dict[str, float] = {}
+        self._scale_up_since: Dict[str, float] = {}
+        self._scale_down_since: Dict[str, float] = {}
+        self.autoscaler_stats = {"scale_ups": 0, "scale_downs": 0,
+                                 "kernel_placements": 0,
+                                 "kernel_fallbacks": 0}
         self._reconcile_thread = threading.Thread(
             target=self._reconcile_loop, daemon=True, name="serve-reconcile")
         self._reconcile_thread.start()
@@ -152,11 +191,11 @@ class ServeController:
                                 ray_tpu.get(fut, timeout=max(
                                     0.1, deadline - time.monotonic()))
                             rep.version = version
-                        except Exception:
+                        except Exception as e:
                             # Rejected config / hung or dead replica:
                             # stays old-version; the rolling reconciler
                             # replaces it with a fresh replica.
-                            pass
+                            swallow.noted("serve.controller.reconfigure", e)
             with self._lock:
                 self._bump()
         self._reconcile_once()
@@ -169,6 +208,10 @@ class ServeController:
             del self._deployments[name]
             self._stop_replicas(name, len(self._replicas.get(name, [])))
             self._replicas.pop(name, None)
+            self._router_queues.pop(name, None)
+            self._load_ewma.pop(name, None)
+            self._scale_up_since.pop(name, None)
+            self._scale_down_since.pop(name, None)
             self._bump()
         return True
 
@@ -215,6 +258,34 @@ class ServeController:
         # retires them, so the router sees all of them.
         with self._lock:
             return [r.handle for r in self._replicas.get(name, [])]
+
+    def get_autoscaler_stats(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.autoscaler_stats)
+
+    def report_router_queue(self, name: str, router_id: str,
+                            queued: int) -> bool:
+        """Router queue-depth report (callers parked in assign_request)
+        — one half of the autoscaler's queue-depth signal."""
+        with self._lock:
+            self._router_queues.setdefault(name, {})[router_id] = (
+                int(queued), time.monotonic())
+        return True
+
+    def _router_queue_depth(self, name: str) -> int:
+        """Aggregate live router reports for a deployment; stale
+        reports (router stopped or wedged) age out after the TTL."""
+        now = time.monotonic()
+        reports = self._router_queues.get(name)
+        if not reports:
+            return 0
+        total = 0
+        for rid, (queued, ts) in list(reports.items()):
+            if now - ts > _ROUTER_REPORT_TTL_S:
+                del reports[rid]
+            else:
+                total += queued
+        return total
 
     # ---- long poll (reference long_poll.py) ---------------------------
     def listen_for_change(self, known_version: int, timeout: float = 10.0
@@ -263,33 +334,213 @@ class ServeController:
 
     def _target_replicas(self, info: DeploymentInfo,
                          probed: Optional[int] = None) -> int:
+        """Queue-depth autoscaling policy with hysteresis.
+
+        load = replica in-flight (probed) + router queue depth, EWMA
+        smoothed; desired = ceil(load / target_per_replica) clamped to
+        [min, max].  A scale decision fires only after the desire has
+        persisted past upscale_delay_s / downscale_delay_s — pressure
+        must hold, not spike."""
         cfg = info.autoscaling_config
         if not cfg:
             return info.num_replicas
-        n_current = len(self._replicas.get(info.name, []))
+        name = info.name
+        n_current = len(self._replicas.get(name, []))
         if not n_current:
             return max(1, cfg.get("min_replicas", 1))
         if probed is None:
             return n_current      # probe failed: hold steady
-        inflight = probed
+        load = float(probed + self._router_queue_depth(name))
+        prev = self._load_ewma.get(name)
+        ewma = load if prev is None else (
+            _LOAD_EWMA_ALPHA * load + (1 - _LOAD_EWMA_ALPHA) * prev)
+        self._load_ewma[name] = ewma
         target_per = cfg.get("target_num_ongoing_requests_per_replica", 1)
-        want = math.ceil(inflight / max(target_per, 1e-9)) if inflight \
+        want = math.ceil(ewma / max(target_per, 1e-9)) if ewma > 1e-9 \
             else cfg.get("min_replicas", 1)
-        return max(cfg.get("min_replicas", 1),
+        want = max(cfg.get("min_replicas", 1),
                    min(cfg.get("max_replicas", 10), want))
+        now = time.monotonic()
+        decided = n_current
+        if want > n_current:
+            self._scale_down_since.pop(name, None)
+            since = self._scale_up_since.setdefault(name, now)
+            if now - since >= cfg.get("upscale_delay_s",
+                                      _UPSCALE_DELAY_S):
+                self._scale_up_since.pop(name, None)
+                self.autoscaler_stats["scale_ups"] += 1
+                decided = want
+        elif want < n_current:
+            self._scale_up_since.pop(name, None)
+            since = self._scale_down_since.setdefault(name, now)
+            if now - since >= cfg.get("downscale_delay_s",
+                                      _DOWNSCALE_DELAY_S):
+                self._scale_down_since.pop(name, None)
+                self.autoscaler_stats["scale_downs"] += 1
+                decided = want
+        else:
+            self._scale_up_since.pop(name, None)
+            self._scale_down_since.pop(name, None)
+        self._observe_autoscaler(name, ewma, want, n_current, decided)
+        return decided
+
+    def _observe_autoscaler(self, name: str, load: float, want: int,
+                            current: int, decided: int) -> None:
+        """Autoscaler decision series at /metrics: smoothed load,
+        desired vs running replicas, and a decision counter when a
+        scale actually fires."""
+        try:
+            from ray_tpu._private.metrics_agent import get_metrics_registry
+            reg = get_metrics_registry()
+            labels = (("deployment", name),)
+            reg.register("ray_tpu_serve_autoscaler_load", "gauge")
+            reg.set("ray_tpu_serve_autoscaler_load", load, labels)
+            reg.register("ray_tpu_serve_autoscaler_desired", "gauge")
+            reg.set("ray_tpu_serve_autoscaler_desired", float(want), labels)
+            reg.register("ray_tpu_serve_replicas", "gauge")
+            reg.set("ray_tpu_serve_replicas", float(current), labels)
+            if decided != current:
+                reg.register("ray_tpu_serve_autoscaler_decisions", "counter")
+                reg.inc("ray_tpu_serve_autoscaler_decisions", 1.0,
+                        (("deployment", name),
+                         ("direction",
+                          "up" if decided > current else "down")))
+        except Exception as e:
+            swallow.noted("serve.controller.autoscaler_metrics", e)
+
+    def _kernel_place(self, opts: dict, count: int) -> List[Optional[Any]]:
+        """Place ``count`` identical replicas through the pack-mode
+        kernel solve: snapshot the cluster's dense availability view,
+        solve replica-demand x nodes on device, and return one node id
+        per replica (None = no affinity, DEFAULT placement).  Gated by
+        ``serve_kernel_placement``; any failure falls back to DEFAULT
+        — placement is an optimization, never a liveness dependency."""
+        cfg = get_config()
+        mode = cfg.serve_kernel_placement
+        if mode == "off":
+            return [None] * count
+        try:
+            from ray_tpu._private import worker as worker_mod
+            from ray_tpu.autoscaler.resource_demand_scheduler import (
+                _pack_mode_matrices, _pack_mode_solve)
+            w = worker_mod.global_worker()
+            view = w.cluster.gcs.resource_manager.view
+            node_ids, _total, avail, columns = view.snapshot()
+            if not node_ids or (mode == "auto"
+                                and len(node_ids) < cfg.serve_kernel_min_nodes):
+                return [None] * count
+            inv = {i: name for name, i in columns.items()}
+            node_res = [{inv[j]: float(avail[r, j])
+                         for j in range(avail.shape[1]) if avail[r, j] > 0}
+                        for r in range(len(node_ids))]
+            demand = dict(opts.get("resources") or {})
+            demand["CPU"] = float(opts.get("num_cpus", 1) or 0)
+            if opts.get("num_gpus"):
+                demand["GPU"] = float(opts["num_gpus"])
+            demand = {k: v for k, v in demand.items() if v > 0}
+            if not demand:
+                return [None] * count
+            names, runs, dem, counts, avail_m = _pack_mode_matrices(
+                node_res, [demand] * count)
+            _unfulfilled, alloc = _pack_mode_solve(runs, dem, counts,
+                                                   avail_m)
+            placements: List[Optional[Any]] = []
+            for ci in range(alloc.shape[0]):
+                for ni in range(alloc.shape[1]):
+                    placements.extend([node_ids[ni]] *
+                                      int(alloc[ci, ni]))
+            placements = placements[:count]
+            self.autoscaler_stats["kernel_placements"] += len(placements)
+            # Replicas the solve couldn't fit anywhere fall back to
+            # DEFAULT placement (soft affinity would lie about intent).
+            placements.extend([None] * (count - len(placements)))
+            return placements
+        except Exception as e:
+            self.autoscaler_stats["kernel_fallbacks"] += 1
+            swallow.noted("serve.controller.kernel_place", e)
+            return [None] * count
+
+    @staticmethod
+    def _weight_object_ids(info: DeploymentInfo) -> List:
+        """Object ids of ObjectRef init args (deployed model weights)."""
+        from ray_tpu._private.object_ref import ObjectRef
+        _def, init_args, init_kwargs, _cfg = info.serialized_init
+        return [a.object_id()
+                for a in list(init_args or ()) +
+                list((init_kwargs or {}).values())
+                if isinstance(a, ObjectRef)]
+
+    @staticmethod
+    def _stagger_weight_pull(oids: List, baselines: Dict,
+                             timeout: float = 2.0) -> None:
+        """Cold-start relay shaping: before creating the NEXT replica,
+        wait until a weight object has grown a new directory row —
+        the predecessor's pull is in flight (partial row) or done, so
+        the successor's pull chains off it (transfer.relay) instead of
+        opening another full origin stream.  Best-effort: on timeout
+        (e.g. the predecessor landed on the origin's node and never
+        pulled) the start proceeds."""
+        try:
+            from ray_tpu._private import worker as worker_mod
+            directory = worker_mod.global_worker().cluster.object_directory
+        except Exception as e:
+            swallow.noted("serve.controller.stagger_directory", e)
+            return
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                for oid in oids:
+                    if len(directory.get_candidates(oid)) > \
+                            baselines.get(oid, 1):
+                        return
+            except Exception as e:
+                swallow.noted("serve.controller.stagger_probe", e)
+                return
+            time.sleep(0.01)
 
     def _start_replicas(self, info: DeploymentInfo, count: int
                         ) -> List[_Replica]:
         from ray_tpu.serve.replica import ReplicaActor
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy)
         opts = dict(info.ray_actor_options)
         opts.setdefault("num_cpus", 1)
         # +2 headroom so control calls (get_num_inflight, health) never
         # queue behind saturated request slots — the router, not actor
         # concurrency, enforces max_concurrent_queries.
         opts["max_concurrency"] = max(2, info.max_concurrent_queries) + 2
-        cls = ray_tpu.remote(**opts)(ReplicaActor)
-        return [_Replica(cls.remote(info.serialized_init), info.version)
-                for _ in range(count)]
+        weight_oids = self._weight_object_ids(info) if count > 1 else []
+        baselines: Dict = {}
+        if weight_oids:
+            try:
+                from ray_tpu._private import worker as worker_mod
+                directory = \
+                    worker_mod.global_worker().cluster.object_directory
+                baselines = {oid: len(directory.get_candidates(oid))
+                             for oid in weight_oids}
+            except Exception as e:
+                swallow.noted("serve.controller.stagger_baseline", e)
+                weight_oids = []
+        placements = self._kernel_place(opts, count)
+        new = []
+        for i, node_id in enumerate(placements):
+            rep_opts = dict(opts)
+            if node_id is not None:
+                # Soft: the kernel's pick is a preference, not a cage —
+                # if the node filled up since the snapshot the scheduler
+                # may still place elsewhere.
+                rep_opts["scheduling_strategy"] = \
+                    NodeAffinitySchedulingStrategy(node_id, soft=True)
+            cls = ray_tpu.remote(**rep_opts)(ReplicaActor)
+            new.append(_Replica(
+                cls.remote(info.serialized_init,
+                           deployment_name=info.name), info.version))
+            if weight_oids and i == 0:
+                # Only the FIRST gap needs the wait: once one transfer
+                # is in flight (or one extra copy exists), every later
+                # pull has a non-origin source to chain from.
+                self._stagger_weight_pull(weight_oids, baselines)
+        return new
 
     def _adopt_or_kill(self, name: str, version: int,
                        new: List[_Replica]) -> bool:
@@ -303,8 +554,8 @@ class ServeController:
         for rep in new:
             try:
                 ray_tpu.kill(rep.handle)
-            except Exception:
-                pass
+            except Exception as e:
+                swallow.noted("serve.controller.kill_unadopted", e)
         return False
 
     def _wait_healthy(self, reps: List[_Replica], timeout: float = 30.0
@@ -320,11 +571,12 @@ class ServeController:
                 ray_tpu.get(fut, timeout=max(
                     0.1, deadline - time.monotonic()))
                 healthy.append(rep)
-            except Exception:
+            except Exception as e:
+                swallow.noted("serve.controller.unhealthy_start", e)
                 try:
                     ray_tpu.kill(rep.handle)
-                except Exception:
-                    pass
+                except Exception as e2:
+                    swallow.noted("serve.controller.kill_unhealthy", e2)
         return healthy
 
     def _drain_and_kill(self, victims: List[_Replica],
@@ -352,17 +604,35 @@ class ServeController:
                     # Slow to answer != dead: keep draining it until
                     # the overall deadline.
                     still.append(rep)
-                except Exception:
-                    pass   # dead already — nothing to drain
+                except Exception as e:
+                    # Dead already — nothing to drain.
+                    swallow.noted("serve.controller.drain_probe", e)
             pending = still
             if pending:
                 time.sleep(0.05)
+        # Last service before the kill: let each replica fail its
+        # parked @serve.batch requests loudly (callers otherwise hit
+        # their 60s event-wait cap).  Fire-and-forget with a short
+        # gather — a dead replica just errors the ref.
+        shutdown_futs = []
+        for rep in victims:
+            try:
+                shutdown_futs.append(rep.handle.prepare_shutdown.remote())
+            except Exception as e:
+                swallow.noted("serve.controller.prepare_shutdown", e)
+        deadline = time.monotonic() + 2.0
+        for fut in shutdown_futs:
+            try:
+                ray_tpu.get(fut, timeout=max(
+                    0.1, deadline - time.monotonic()))
+            except Exception as e:
+                swallow.noted("serve.controller.prepare_shutdown", e)
         for rep in victims:
             self._health_fail_counts.pop(rep, None)
             try:
                 ray_tpu.kill(rep.handle)
-            except Exception:
-                pass
+            except Exception as e:
+                swallow.noted("serve.controller.kill_retired", e)
 
     def _reconcile_once(self):
         with self._reconcile_mutex:
@@ -473,11 +743,10 @@ class ServeController:
             self._health_fail_counts.pop(rep, None)
             try:
                 ray_tpu.kill(rep.handle)
-            except Exception:
-                pass
+            except Exception as e:
+                swallow.noted("serve.controller.kill_stopped", e)
 
     def _reconcile_loop(self):
-        from ray_tpu._private.debug import swallow
         while not self._shutdown:
             try:
                 self._reconcile_once()

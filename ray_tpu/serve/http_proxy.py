@@ -20,6 +20,8 @@ from typing import Dict, Optional, Tuple
 from urllib.parse import parse_qsl, urlsplit
 
 import ray_tpu
+from ray_tpu._private.debug import swallow
+from ray_tpu._private.debug.lock_order import diag_lock
 
 
 @dataclass
@@ -44,7 +46,7 @@ class HTTPProxyActor:
         self._controller = ray_tpu.get_actor(CONTROLLER_NAME)
         self._routes: Dict[str, str] = {}      # prefix -> deployment name
         self._routers: Dict[str, "Router"] = {}
-        self._routes_lock = threading.Lock()
+        self._routes_lock = diag_lock("serve.HTTPProxyActor._routes_lock")
         self._version = -1
         self._refresh_routes()
         self._stopped = threading.Event()
@@ -121,9 +123,10 @@ class HTTPProxyActor:
                 if version != self._version:
                     self._version = version
                     self._refresh_routes()
-            except Exception:
+            except Exception as e:
                 if self._stopped.is_set() or not controller_alive():
                     return
+                swallow.noted("serve.http_proxy.long_poll", e)
                 self._stopped.wait(backoff)
                 backoff = min(backoff * 2, 2.0)
 
@@ -173,8 +176,11 @@ class HTTPProxyActor:
             headers={k.lower(): v for k, v in handler.headers.items()},
             body=body)
         router = self._router_for(name)
-        ref = router.assign_request("__call__", (request,), {})
-        result = ray_tpu.get(ref)
+        # Router.call re-assigns on replica death (bounded by
+        # serve_request_retries): an HTTP client whose replica is
+        # SIGKILLed mid-request gets a survivor's response, or a 500
+        # naming the deployment — never a silent hang.
+        result = router.call("__call__", (request,), {})
         if isinstance(result, bytes):
             return 200, result, "application/octet-stream"
         if isinstance(result, str):

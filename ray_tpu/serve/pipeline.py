@@ -165,20 +165,67 @@ def _submit(node, input_value, cache):
     return node
 
 
+def _payload_nbytes(value) -> Optional[int]:
+    """Cheap size of a bytes-like / buffer-backed payload (None when
+    the size can't be known without serializing)."""
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        return len(value)
+    nbytes = getattr(value, "nbytes", None)  # numpy/jax arrays
+    if isinstance(nbytes, int):
+        return nbytes
+    return None
+
+
+def _has_input_attr(node, seen: Optional[set] = None) -> bool:
+    """Whether any node indexes the request input driver-side
+    (``InputNode()[i]``) — those DAGs need the literal value."""
+    if seen is None:
+        seen = set()
+    if not isinstance(node, DAGNode) or node._uid in seen:
+        return False
+    seen.add(node._uid)
+    if isinstance(node, _InputAttr):
+        return True
+    children = []
+    if isinstance(node, MethodNode):
+        children = list(node._args) + list(node._kwargs.values())
+    elif isinstance(node, FunctionNode):
+        children = list(node._args) + list(node._kwargs.values())
+    return any(_has_input_attr(c, seen) for c in children)
+
+
 class DAGHandle:
     """The built pipeline: ``remote(input)`` runs one request through
-    the graph and returns a ref to the root's result."""
+    the graph and returns a ref to the root's result.
+
+    Zero-copy ingress: a large buffer-backed input is put ONCE into
+    the object store and every stage receives the ObjectRef (the
+    object-id handoff) — the payload materializes in each replica
+    straight off the shm data plane instead of being pickled into
+    every stage's task args (k stages = 1 serialization, not k).
+    DAGs that index the input driver-side (``InputNode()[i]``) keep
+    the literal value."""
 
     def __init__(self, root: DAGNode, handles: Dict[str, Any],
                  deployments: List):
         self._root = root
         self._handles = handles      # node uid -> DeploymentHandle
         self.deployments = deployments
+        self._indexed_input = _has_input_attr(root)
 
     def remote(self, input_value=None):
-        cache: Dict = {"handles": self._handles}
-        out = self._root._resolve(input_value, cache)
+        from ray_tpu._private.config import get_config
         from ray_tpu._private.object_ref import ObjectRef
+        value = input_value
+        if not self._indexed_input and \
+                not isinstance(input_value, ObjectRef):
+            threshold = get_config().serve_zero_copy_threshold_bytes
+            nbytes = _payload_nbytes(input_value)
+            if threshold >= 0 and nbytes is not None \
+                    and nbytes >= threshold:
+                value = ray_tpu.put(input_value)
+        cache: Dict = {"handles": self._handles}
+        out = self._root._resolve(value, cache)
         if isinstance(out, ObjectRef):
             return out
         return ray_tpu.put(out)

@@ -7,23 +7,35 @@ backpressure signal), runs reconfigure, reports health.
 
 from __future__ import annotations
 
-import threading
+import time
+from typing import Any, Dict, Optional
 
 import ray_tpu
-import time
-from typing import Any, Callable, Dict, Optional
+from ray_tpu._private.debug.lock_order import diag_lock
 
 
 class ReplicaActor:
-    def __init__(self, serialized_init):
+    def __init__(self, serialized_init, deployment_name: str = ""):
         deployment_def, init_args, init_kwargs, user_config = serialized_init
+        # ObjectRef init args materialize HERE, in the replica (cold
+        # start): model weights deploy as `Model.deploy(weights_ref)`
+        # and each replica pulls the object through the data plane —
+        # N replicas starting concurrently on different nodes form a
+        # relay chain (transfer.relay), so the origin serves ~one copy
+        # instead of N head pulls.
+        from ray_tpu._private.object_ref import ObjectRef
+        init_args = tuple(ray_tpu.get(a) if isinstance(a, ObjectRef)
+                          else a for a in (init_args or ()))
+        init_kwargs = {k: ray_tpu.get(v) if isinstance(v, ObjectRef)
+                       else v for k, v in (init_kwargs or {}).items()}
         if isinstance(deployment_def, type):
             self._callable = deployment_def(*init_args, **(init_kwargs or {}))
         else:
             self._callable = deployment_def
         self._is_function = not isinstance(deployment_def, type)
+        self._deployment = deployment_name
         self._inflight = 0
-        self._lock = threading.Lock()
+        self._lock = diag_lock("serve.ReplicaActor._lock")
         self.num_requests = 0
         if user_config is not None:
             self.reconfigure(user_config)
@@ -38,6 +50,7 @@ class ReplicaActor:
         with self._lock:
             self._inflight += 1
             self.num_requests += 1
+        started = time.monotonic()
         try:
             if self._is_function:
                 target = self._callable
@@ -47,16 +60,35 @@ class ReplicaActor:
                 target = getattr(self._callable, method_name)
             # ObjectRef args resolve before the user callable sees them
             # (reference serve handle semantics; the pipeline DAG wires
-            # upstream deployment outputs through as refs).
+            # upstream deployment outputs through as refs — the
+            # zero-copy object-id handoff: the payload materializes
+            # HERE, straight off the data plane, never in the router).
             from ray_tpu._private.object_ref import ObjectRef
             args = [ray_tpu.get(a) if isinstance(a, ObjectRef) else a
                     for a in args]
             kwargs = {k: ray_tpu.get(v) if isinstance(v, ObjectRef)
                       else v for k, v in (kwargs or {}).items()}
-            return target(*args, **kwargs)
+            # Label @serve.batch flush metrics with this deployment for
+            # the duration of the user call (thread-local).
+            from ray_tpu.serve import batching
+            batching.set_batch_context(self._deployment or None)
+            try:
+                return target(*args, **kwargs)
+            finally:
+                batching.set_batch_context(None)
         finally:
             with self._lock:
                 self._inflight -= 1
+            try:
+                from ray_tpu._private.metrics_agent import observe_internal
+                observe_internal(
+                    "ray_tpu_serve_request_seconds",
+                    time.monotonic() - started,
+                    deployment=self._deployment or "?",
+                    method=method_name or "__call__")
+            except Exception as e:
+                from ray_tpu._private.debug import swallow
+                swallow.noted("serve.replica.metrics", e)
 
     def get_num_inflight(self) -> int:
         return self._inflight
@@ -64,6 +96,19 @@ class ReplicaActor:
     def get_metrics(self) -> Dict[str, float]:
         return {"num_requests": self.num_requests,
                 "inflight": self._inflight}
+
+    def prepare_shutdown(self) -> bool:
+        """Best-effort teardown ahead of the controller's kill: fail any
+        requests still parked in @serve.batch queues instead of leaving
+        their callers to time out."""
+        from ray_tpu.serve import batching
+        try:
+            if not self._is_function:
+                batching.close_instance_queues(self._callable)
+        except Exception as e:
+            from ray_tpu._private.debug import swallow
+            swallow.noted("serve.replica.prepare_shutdown", e)
+        return True
 
     def check_health(self) -> bool:
         fn = getattr(self._callable, "check_health", None)

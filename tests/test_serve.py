@@ -483,3 +483,522 @@ class TestDeploymentPipeline:
             headers={"Content-Type": "application/json"})
         body = urllib.request.urlopen(req, timeout=30).read()
         assert json_mod.loads(body) == 11
+
+
+class TestBatchQueueEdgeCases:
+    """_BatchQueue unit coverage (no cluster): the flush-timeout vs
+    max-batch race, per-element errors, teardown with pending
+    requests, and the adaptive latency-budget policy."""
+
+    def test_full_flush_cancels_stale_timer(self):
+        """A timer armed for batch generation G must NOT flush
+        generation G+1: after a full-batch flush, a lone follow-up
+        request waits its OWN full window, not the stale remainder."""
+        from ray_tpu.serve.batching import _BatchQueue
+
+        def fn(xs):
+            return [x * 2 for x in xs]
+
+        q = _BatchQueue(fn, max_batch_size=2, batch_wait_timeout_s=0.5)
+        results = []
+        threads = [threading.Thread(
+            target=lambda i=i: results.append(q.submit(None, i)),
+            daemon=True) for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=5)
+        assert sorted(results) == [0, 2]
+        assert q.stats["full_flushes"] == 1
+        # The gen-0 timer (armed at first submit) would fire ~0.5s
+        # after t=0.  Submit a lone request at ~0.3: if the stale timer
+        # flushed it, it completes well before its own 0.5s window.
+        time.sleep(0.3)
+        started = time.monotonic()
+        assert q.submit(None, 10) == 20
+        elapsed = time.monotonic() - started
+        assert elapsed >= 0.4, \
+            f"stale timer flushed the next batch after {elapsed:.3f}s"
+        assert q.stats["timer_flushes"] == 1
+
+    def test_exception_element_fails_only_that_caller(self):
+        """One poisoned element fails ONLY its own caller; neighbors in
+        the same batch get their results."""
+        from ray_tpu.serve.batching import _BatchQueue
+
+        def fn(xs):
+            return [ValueError(f"bad {x}") if x == 1 else x * 10
+                    for x in xs]
+
+        q = _BatchQueue(fn, max_batch_size=3, batch_wait_timeout_s=5.0)
+        out = {}
+
+        def call(i):
+            try:
+                out[i] = ("ok", q.submit(None, i))
+            except Exception as e:  # noqa: BLE001
+                out[i] = ("err", type(e).__name__, str(e))
+
+        threads = [threading.Thread(target=call, args=(i,), daemon=True)
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert out[0] == ("ok", 0)
+        assert out[2] == ("ok", 20)
+        assert out[1] == ("err", "ValueError", "bad 1")
+        assert q.stats["errors"] == 1
+
+    def test_batch_wide_exception_fails_every_caller(self):
+        from ray_tpu.serve.batching import _BatchQueue
+
+        def fn(xs):
+            raise RuntimeError("whole batch down")
+
+        q = _BatchQueue(fn, max_batch_size=2, batch_wait_timeout_s=5.0)
+        out = {}
+
+        def call(i):
+            try:
+                out[i] = ("ok", q.submit(None, i))
+            except Exception as e:  # noqa: BLE001
+                out[i] = ("err", str(e))
+
+        threads = [threading.Thread(target=call, args=(i,), daemon=True)
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert out[0] == ("err", "whole batch down")
+        assert out[1] == ("err", "whole batch down")
+
+    def test_close_fails_pending_requests(self):
+        """Teardown with requests still queued: every pending caller
+        gets a loud RuntimeError, and later submits are rejected."""
+        from ray_tpu.serve.batching import _BatchQueue
+
+        def fn(xs):
+            return xs
+
+        q = _BatchQueue(fn, max_batch_size=10, batch_wait_timeout_s=30.0)
+        out = {}
+
+        def call():
+            try:
+                out["r"] = ("ok", q.submit(None, 1))
+            except Exception as e:  # noqa: BLE001
+                out["r"] = ("err", type(e).__name__)
+
+        t = threading.Thread(target=call, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 5
+        while not q.stats["requests"] and time.monotonic() < deadline:
+            time.sleep(0.01)
+        q.close()
+        t.join(timeout=5)
+        assert out["r"] == ("err", "RuntimeError")
+        with pytest.raises(RuntimeError, match="shut down"):
+            q.submit(None, 2)
+
+    def test_adaptive_budget_tracks_exec_latency(self):
+        """With latency_budget_s set, the flush delay shrinks by the
+        EWMA of the batch fn's own execution time — the oldest pending
+        request's end-to-end latency tracks the budget."""
+        from ray_tpu.serve.batching import _BatchQueue
+
+        def fn(xs):
+            time.sleep(0.05)
+            return xs
+
+        q = _BatchQueue(fn, max_batch_size=8, batch_wait_timeout_s=9.9,
+                        latency_budget_s=0.2)
+        # Before any flush: no exec sample, wait the full budget (the
+        # fixed batch_wait_timeout_s must NOT be the deadline).
+        assert abs(q._flush_delay() - 0.2) < 1e-6
+        assert q.submit(None, 1) == 1          # timer flush after ~0.2s
+        assert q.stats["timer_flushes"] == 1
+        # One 50ms sample recorded: the next batch flushes early enough
+        # to absorb the expected execution time.
+        assert q._exec_ewma > 0.0
+        assert q._flush_delay() < 0.2
+        assert q._flush_delay() >= 0.0005
+
+
+class TestServeRequestFaultPoint:
+    """serve.request failure point: per-deployment error / drop
+    semantics at the router dispatch site."""
+
+    def test_error_mode_is_attributed_to_the_client(self, serve_instance):
+        from ray_tpu._private import fault_injection
+        from ray_tpu._private.fault_injection import FaultInjectedError
+
+        @serve.deployment(name="faulty")
+        def faulty(req):
+            return "served"
+
+        faulty.deploy()
+        h = faulty.get_handle()
+        assert ray_tpu.get(h.remote(None)) == "served"
+        fault_injection.arm("serve.request", "error", count=1,
+                            match={"deployment": "faulty"})
+        try:
+            with pytest.raises(FaultInjectedError):
+                h.remote(None)
+        finally:
+            fault_injection.disarm("serve.request")
+        # One-shot arming: the next request serves normally.
+        assert ray_tpu.get(h.remote(None)) == "served"
+
+    def test_drop_mode_reassigns_the_dispatch(self, serve_instance):
+        from ray_tpu._private import fault_injection
+
+        @serve.deployment(name="droppy", num_replicas=2)
+        def droppy(req):
+            return req + 1
+
+        droppy.deploy()
+        h = droppy.get_handle()
+        assert ray_tpu.get(h.remote(1)) == 2
+        fault_injection.arm("serve.request", "drop", count=2,
+                            match={"deployment": "droppy"})
+        try:
+            # Both drops land on this one request's dispatch loop: the
+            # router re-assigns until a dispatch survives — the client
+            # still sees exactly one (correct) response.
+            assert ray_tpu.get(h.remote(41), timeout=30) == 42
+        finally:
+            fault_injection.disarm("serve.request")
+        router = serve.api._handle_routers["droppy"]
+        assert router.stats["dropped_dispatches"] == 2
+
+
+class TestChaosReplicaDeath:
+    def test_kill_replica_mid_request_http(self, serve_instance):
+        """SIGKILL a replica with requests in flight, through the real
+        HTTP path: every client gets exactly one 200 (the router
+        re-assigns onto the survivor) and the controller backfills the
+        dead replica."""
+        @serve.deployment(name="victim", num_replicas=2,
+                          max_concurrent_queries=8)
+        def victim(request):
+            time.sleep(0.3)
+            return {"echo": request.json()}
+
+        victim.deploy()
+        port = _proxy_port()
+        controller = ray_tpu.get_actor(serve.controller.CONTROLLER_NAME)
+        handles = ray_tpu.get(
+            controller.get_replica_handles.remote("victim"))
+        assert len(handles) == 2
+
+        results, errors = {}, {}
+
+        def client(i):
+            try:
+                status, body = _http(
+                    port, "/victim", data=json.dumps(i).encode())
+                results[i] = (status, json.loads(body))
+            except Exception as e:  # noqa: BLE001
+                errors[i] = e
+
+        threads = [threading.Thread(target=client, args=(i,),
+                                    daemon=True) for i in range(12)]
+        for t in threads:
+            t.start()
+        time.sleep(0.15)               # requests are now mid-flight
+        ray_tpu.kill(handles[0])
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, f"clients saw errors: {errors}"
+        # Exactly-once: every client got its own echo back, once.
+        assert sorted(results) == list(range(12))
+        for i, (status, body) in results.items():
+            assert status == 200 and body == {"echo": i}
+        # The controller notices the death and restores 2 replicas.
+        deadline = time.monotonic() + 60
+        backfilled = False
+        while time.monotonic() < deadline:
+            info = ray_tpu.get(
+                controller.get_deployment_info.remote("victim"))
+            if info["num_running_replicas"] == 2:
+                live = ray_tpu.get(
+                    controller.get_replica_handles.remote("victim"))
+                try:
+                    ray_tpu.get([h.check_health.remote() for h in live],
+                                timeout=5)
+                    backfilled = True
+                    break
+                except Exception:
+                    pass
+            time.sleep(0.25)
+        assert backfilled, "dead replica never backfilled"
+
+
+class TestAutoscalingKernelPlacement:
+    def test_scale_up_through_kernel_solve_zero_loss(self, serve_instance):
+        """Queue-depth step drives replicas up THROUGH the pack-mode
+        kernel solve (placement forced through the device path), back
+        down after the cooldown, with zero request loss end-to-end."""
+        from ray_tpu._private.config import get_config
+
+        cfg = get_config()
+        prev_mode = cfg.serve_kernel_placement
+        cfg.serve_kernel_placement = "force"
+        try:
+            @serve.deployment(
+                name="ksolve", max_concurrent_queries=2,
+                autoscaling_config={
+                    "min_replicas": 1, "max_replicas": 3,
+                    "target_num_ongoing_requests_per_replica": 1,
+                    "upscale_delay_s": 0.2, "downscale_delay_s": 0.8,
+                })
+            def ksolve(req):
+                time.sleep(0.25)
+                return req
+
+            ksolve.deploy()
+            controller = ray_tpu.get_actor(
+                serve.controller.CONTROLLER_NAME)
+            h = ksolve.get_handle()
+            ok = []
+            failed = []
+            stop = threading.Event()
+
+            def load(i):
+                n = 0
+                while not stop.is_set():
+                    try:
+                        assert ray_tpu.get(
+                            h.remote((i, n)), timeout=60) == (i, n)
+                        ok.append((i, n))
+                    except Exception as e:  # noqa: BLE001
+                        failed.append(e)
+                        return
+                    n += 1
+
+            threads = [threading.Thread(target=load, args=(i,),
+                                        daemon=True) for i in range(6)]
+            for t in threads:
+                t.start()
+            try:
+                deadline = time.monotonic() + 20
+                peak = 1
+                while time.monotonic() < deadline:
+                    info = ray_tpu.get(
+                        controller.get_deployment_info.remote("ksolve"))
+                    peak = max(peak, info["num_running_replicas"])
+                    if peak >= 2:
+                        break
+                    time.sleep(0.1)
+                assert peak >= 2, "queue-depth step never scaled up"
+            finally:
+                stop.set()
+                for t in threads:
+                    t.join(timeout=15)
+            assert not failed, f"requests lost during scale-up: {failed[:3]}"
+            assert len(ok) > 0
+            stats = ray_tpu.get(controller.get_autoscaler_stats.remote())
+            assert stats["scale_ups"] >= 1
+            assert stats["kernel_placements"] >= 1, \
+                f"replicas were not placed via the kernel solve: {stats}"
+            # Load gone: back down to min_replicas after the cooldown,
+            # again with no failed requests (drain, don't drop).
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                info = ray_tpu.get(
+                    controller.get_deployment_info.remote("ksolve"))
+                if info["num_running_replicas"] == 1:
+                    break
+                time.sleep(0.2)
+            assert info["num_running_replicas"] == 1, "never scaled down"
+            stats = ray_tpu.get(controller.get_autoscaler_stats.remote())
+            assert stats["scale_downs"] >= 1
+            # The decision/load series are live at the metrics registry.
+            from ray_tpu._private.metrics_agent import get_metrics_registry
+            text = get_metrics_registry().render_prometheus()
+            assert "ray_tpu_serve_autoscaler_load" in text
+            assert "ray_tpu_serve_autoscaler_desired" in text
+            assert "ray_tpu_serve_autoscaler_decisions" in text
+        finally:
+            cfg.serve_kernel_placement = prev_mode
+
+
+class TestServeSoakMini:
+    def test_soak_200_requests_scale_up_zero_loss(self, serve_instance):
+        """Tier-1 mini soak: 2 starting replicas, 200 closed-loop
+        requests from 8 clients, scale-up asserted, zero silent loss —
+        every request accounted for exactly once."""
+        @serve.deployment(
+            name="soak", max_concurrent_queries=2,
+            autoscaling_config={
+                "min_replicas": 2, "max_replicas": 4,
+                "target_num_ongoing_requests_per_replica": 1,
+                "upscale_delay_s": 0.2, "downscale_delay_s": 5.0,
+            })
+        @serve.batch(max_batch_size=4, latency_budget_s=0.25)
+        def soak(requests):
+            time.sleep(0.05)
+            return [r * 3 for r in requests]
+
+        soak.deploy()
+        controller = ray_tpu.get_actor(serve.controller.CONTROLLER_NAME)
+        h = soak.get_handle()
+        got = {}
+        errors = []
+        peak = {"n": 2}
+        per_client = 25          # 8 clients x 25 = 200 requests
+
+        def client(c):
+            for n in range(per_client):
+                i = c * per_client + n
+                try:
+                    got[i] = ray_tpu.get(h.remote(i), timeout=60)
+                except Exception as e:  # noqa: BLE001
+                    errors.append((i, e))
+
+        def watch():
+            while len(got) + len(errors) < 8 * per_client:
+                info = ray_tpu.get(
+                    controller.get_deployment_info.remote("soak"))
+                peak["n"] = max(peak["n"], info["num_running_replicas"])
+                time.sleep(0.1)
+
+        watcher = threading.Thread(target=watch, daemon=True)
+        watcher.start()
+        threads = [threading.Thread(target=client, args=(c,),
+                                    daemon=True) for c in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        watcher.join(timeout=10)
+        assert not errors, f"soak lost requests: {errors[:3]}"
+        assert len(got) == 200
+        assert all(got[i] == i * 3 for i in got), "wrong response routed"
+        assert peak["n"] > 2, "soak never scaled above the floor"
+        # Adaptive batching actually batched under this load, and its
+        # fill-ratio series is exported.
+        from ray_tpu._private.metrics_agent import get_metrics_registry
+        text = get_metrics_registry().render_prometheus()
+        assert "ray_tpu_serve_batch_fill_ratio" in text
+        assert 'deployment="soak"' in text
+
+
+class TestZeroCopyServe:
+    def test_pipeline_input_single_put(self, serve_instance, monkeypatch):
+        """A large pipeline input rides the object-id handoff: ONE put
+        into the shm data plane, every stage pulls the same object —
+        bytes copied stay ~1x the payload even with two consumers (the
+        naive path re-serializes per stage), and nothing on the path
+        flattens a SerializedObject."""
+        import numpy as np
+
+        from ray_tpu._private.serialization import (SerializedObject,
+                                                    copy_stats)
+        from ray_tpu.serve import pipeline
+        from ray_tpu.serve.pipeline import InputNode
+
+        @serve.deployment
+        class Head:
+            def __init__(self, tag):
+                self.tag = tag
+
+            def run(self, x):
+                return int(x[0]) + int(x[-1]) + self.tag
+
+        @serve.deployment
+        def join(a, b):
+            return a + b
+
+        with InputNode() as inp:
+            dag = join.bind(Head.bind(1).run.bind(inp),
+                            Head.bind(2).run.bind(inp))
+        handle = pipeline.build(dag)
+        arr = np.ones(1024 * 1024, dtype=np.uint8)
+        # Warm the path (deploys done, replicas live) with a payload
+        # below the zero-copy threshold.
+        assert ray_tpu.get(handle.remote(np.ones(8, dtype=np.uint8)),
+                           timeout=60) == 7
+
+        def boom(self):
+            raise AssertionError(
+                "SerializedObject.to_bytes() called on the zero-copy "
+                "pipeline path")
+        monkeypatch.setattr(SerializedObject, "to_bytes", boom)
+        before = copy_stats["bytes_copied"]
+        assert ray_tpu.get(handle.remote(arr), timeout=60) == 7
+        copied = copy_stats["bytes_copied"] - before
+        # One serialization of the payload (the single put), not one
+        # per consuming stage; generous slack for small control data.
+        assert copied <= arr.nbytes + 256 * 1024, \
+            (f"pipeline copied {copied} bytes for a {arr.nbytes}-byte "
+             f"input across 2 stages — the input was re-serialized")
+
+
+class TestRelayColdStartWeights:
+    def test_replica_weights_fetch_via_relay_chain(self, ray_start_cluster):
+        """Cold replica start on N nodes pulls the weights object as a
+        relay chain (PR 12): the origin serves ~one copy, the rest of
+        the bytes relay node-to-node — NOT N origin pulls."""
+        import numpy as np
+
+        from ray_tpu._private import fault_injection
+        from ray_tpu._private.config import get_config
+
+        cluster = ray_start_cluster(num_cpus=0)
+        cfg = get_config()
+        cfg.object_transfer_max_outbound_sessions = 1
+        cfg.object_manager_chunk_size = 256 * 1024
+        _mb = 1024 * 1024
+        workers = [cluster.add_node(num_cpus=2,
+                                    object_store_memory=64 * _mb)
+                   for _ in range(3)]
+        serve.start(http_options={"location": "NoServer"})
+        try:
+            weights = (np.arange(4 * _mb, dtype=np.uint8) % 251)
+            ref = ray_tpu.put(weights)
+            oid = ref.object_id()
+            head = cluster.head_node
+            size = head.object_store.get(oid).size
+            origin_before = \
+                head.object_store.stats["outbound_served_bytes"]
+
+            @serve.deployment(name="model", num_replicas=3,
+                              ray_actor_options={"num_cpus": 2})
+            class Model:
+                def __init__(self, w):
+                    assert isinstance(w, np.ndarray)  # materialized
+                    self.checksum = int(w[:1024].sum())
+
+                def __call__(self, req):
+                    return self.checksum
+
+            # Slow chunks so the three concurrent cold starts overlap
+            # and the chain can form (the broadcast-test idiom).
+            fault_injection.arm("transfer.chunk", "delay", count=-1,
+                                delay_s=0.02)
+            try:
+                Model.deploy(ref)
+            finally:
+                fault_injection.disarm("transfer.chunk")
+            h = Model.get_handle()
+            expected = int(weights[:1024].sum())
+            assert ray_tpu.get(h.remote(None), timeout=60) == expected
+
+            origin_served = \
+                head.object_store.stats["outbound_served_bytes"] \
+                - origin_before
+            relayed = sum(n.object_store.stats["relay_served_bytes"]
+                          for n in workers)
+            relay_pulls = sum(n.object_manager.stats["relay_pulls"]
+                              for n in workers)
+            assert 0 < origin_served <= 2 * size, \
+                (f"origin served {origin_served} bytes for a "
+                 f"{size}-byte weights object — cold start did not "
+                 f"chain ({relay_pulls} relay pulls)")
+            assert relayed > 0 and relay_pulls >= 1, \
+                (origin_served, relayed, relay_pulls)
+        finally:
+            serve.shutdown()
